@@ -1,0 +1,26 @@
+"""cylon_tpu: a TPU-native distributed DataFrame framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Cylon (reference:
+mstaylor/cylon, surveyed in SURVEY.md): Arrow/pandas-interoperable columnar
+tables resident in device HBM, relational operators (join, groupby-aggregate,
+distributed sample sort, set ops, unique, repartition/slice) as jit-compiled
+vector kernels, and the MPI/UCX/Gloo shuffle layer replaced by SPMD mesh
+collectives over ICI/DCN.
+
+User contract preserved from the reference (frame.py:2063 dispatch rule):
+
+    from cylon_tpu import DataFrame, CylonEnv, TPUConfig
+    env = CylonEnv(config=TPUConfig())
+    df = df1.merge(df2, on="key", env=env)   # distributed
+    df = df1.merge(df2, on="key")            # local
+"""
+
+from . import config  # noqa: F401  (applies x64 policy at import)
+from .ctx.context import (CPUMeshConfig, CylonEnv, LocalConfig,  # noqa: F401
+                          TPUConfig)
+from .core.column import Column  # noqa: F401
+from .core.dtypes import LogicalType  # noqa: F401
+from .core.table import Table  # noqa: F401
+from .status import Code, CylonError, Status  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,44 @@
+"""Global configuration for cylon_tpu.
+
+The reference framework is int64-first (Arrow/pandas default integer keys,
+BASELINE.json's 1B int64-key join).  JAX defaults to 32-bit; we enable x64 at
+import so device tables can faithfully hold pandas/Arrow int64/float64 columns.
+Set ``CYLON_TPU_X64=0`` to opt out (columns will then be downcast on transfer).
+
+Reference analog: the CMake/feature-flag + env-var config surface
+(cpp/CMakeLists.txt:129-441, redis_ucx_ucc_oob_context.cpp:104-105) collapses
+into this module plus per-op option dataclasses.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+X64_ENABLED = os.environ.get("CYLON_TPU_X64", "1") != "0"
+if X64_ENABLED:
+    jax.config.update("jax_enable_x64", True)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v not in ("0", "false", "False", "")
+
+
+#: Print [BENCH] timing lines (reference: CYLON_BENCH_TIMER, util/macros.hpp:102).
+BENCH_TIMINGS = _env_flag("CYLON_TPU_BENCH", False)
+
+#: Round variable capacities up to powers of two to bound recompilation.
+POW2_CAPACITIES = _env_flag("CYLON_TPU_POW2_CAPS", True)
+
+
+def pow2ceil(n: int) -> int:
+    """Smallest power of two >= n (>=1). Used to bucket dynamic capacities so
+    the number of distinct compiled shapes stays logarithmic."""
+    n = max(int(n), 1)
+    if not POW2_CAPACITIES:
+        return n
+    return 1 << (n - 1).bit_length()

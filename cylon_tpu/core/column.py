@@ -1,0 +1,110 @@
+"""Device-resident Column.
+
+TPU-native equivalent of the reference ``cylon::Column`` (cpp/src/cylon/
+column.hpp:27, wrapping ``arrow::Array``).  Physical layout follows the GCylon
+pattern (accelerator-resident, cpp/src/gcylon/gtable.hpp): a fixed-width
+device array + an optional boolean validity array (bool array instead of the
+Arrow bitmap — TPU vectors have no cheap bit addressing, and XLA fuses mask
+ops for free).  Variable-width strings are dictionary-encoded: int32 codes on
+device, the value table host-side (the reference likewise flattens non-fixed
+keys to binary before hashing, util/flatten_array.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..status import CylonTypeError, InvalidError
+from .dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
+
+
+class Column:
+    __slots__ = ("data", "validity", "type", "dictionary")
+
+    def __init__(self, data, type: LogicalType, validity=None,
+                 dictionary: Optional[np.ndarray] = None):
+        self.data = data
+        self.type = type
+        self.validity = validity  # bool array, True = valid; None = all valid
+        self.dictionary = dictionary  # host np.ndarray for STRING codes
+        if type == LogicalType.STRING and dictionary is None:
+            raise InvalidError("STRING column requires a dictionary")
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, type: LogicalType | None = None) -> "Column":
+        """Build from a host array; encodes strings/objects, splits NaN into
+        validity for floats is *not* done here (NaN stays a float payload,
+        matching pandas semantics)."""
+        arr = np.asarray(arr)
+        if arr.dtype.kind in ("U", "S", "O"):
+            return Column._encode_strings(arr)
+        lt = type or from_numpy_dtype(arr.dtype)
+        phys = physical_np_dtype(lt)
+        if arr.dtype.kind == "M":
+            # normalize any pandas resolution (s/ms/us) to ns before bitview
+            arr = arr.astype("datetime64[ns]").astype("int64", copy=False)
+        elif arr.dtype.kind == "m":
+            arr = arr.astype("timedelta64[ns]").astype("int64", copy=False)
+        data = jnp.asarray(arr.astype(phys, copy=False))
+        return Column(data, lt)
+
+    @staticmethod
+    def _encode_strings(arr: np.ndarray) -> "Column":
+        mask = np.asarray([v is None or (isinstance(v, float) and np.isnan(v))
+                           for v in arr]) if arr.dtype == object else np.zeros(len(arr), bool)
+        safe = np.where(mask, "", arr.astype(object)) if mask.any() else arr
+        values = np.asarray([str(v) for v in safe], dtype=object)
+        # np.unique returns a *sorted* dictionary so code order == lexical
+        # order: sorts/joins on codes are exact on the decoded values.
+        dictionary, codes = np.unique(values, return_inverse=True)
+        data = jnp.asarray(codes.astype(np.int32))
+        validity = jnp.asarray(~mask) if mask.any() else None
+        return Column(data, LogicalType.STRING, validity, dictionary)
+
+    # -- properties --------------------------------------------------------
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def has_nulls(self) -> bool:
+        return self.validity is not None
+
+    def with_data(self, data, validity="__same__") -> "Column":
+        v = self.validity if validity == "__same__" else validity
+        return Column(data, self.type, v, self.dictionary)
+
+    # -- materialization ---------------------------------------------------
+    def to_numpy(self, n: int | None = None) -> np.ndarray:
+        """Decode to a host array of length n (valid prefix)."""
+        data = np.asarray(self.data)[: n if n is not None else len(self)]
+        valid = (np.asarray(self.validity)[: len(data)]
+                 if self.validity is not None else None)
+        if self.type == LogicalType.STRING:
+            out = self.dictionary[np.clip(data, 0, len(self.dictionary) - 1)]
+            out = out.astype(object)
+            if valid is not None:
+                out[~valid] = None
+            return out
+        if self.type == LogicalType.DATE64:
+            out = data.astype("datetime64[ns]")
+        elif self.type == LogicalType.TIMEDELTA:
+            out = data.astype("timedelta64[ns]")
+        else:
+            out = data.astype(np.dtype(self.type.value), copy=False)
+        if valid is not None:
+            if out.dtype.kind == "f":
+                out = out.copy()
+                out[~valid] = np.nan
+            else:
+                out = out.astype(object)
+                out[~valid] = None
+        return out
+
+    def cast(self, lt: LogicalType) -> "Column":
+        if self.type == LogicalType.STRING or lt == LogicalType.STRING:
+            raise CylonTypeError("cast to/from string not supported on device")
+        return Column(self.data.astype(physical_np_dtype(lt)), lt, self.validity)

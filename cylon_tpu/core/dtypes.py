@@ -1,0 +1,102 @@
+"""Logical type system.
+
+TPU-native equivalent of the reference's ``cylon::DataType`` id layer
+(reference: cpp/src/cylon/data_types.hpp, 225 LoC) which mirrors Arrow types.
+Here a :class:`LogicalType` names the user-visible type while the physical
+representation is always a fixed-width device array (strings/binary are
+dictionary-encoded to int32 codes with a host-side value table — the reference
+itself flattens variable-width keys to binary for hashing,
+util/flatten_array.cpp).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import config
+from ..status import CylonTypeError
+
+
+class LogicalType(enum.Enum):
+    BOOL = "bool"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    FLOAT32 = "float32"
+    FLOAT64 = "float64"
+    STRING = "string"          # dictionary-encoded, codes int32
+    DATE64 = "datetime64[ns]"  # physical int64 nanoseconds
+    TIMEDELTA = "timedelta64[ns]"
+
+
+_NUMERIC_NP = {
+    LogicalType.BOOL: np.bool_,
+    LogicalType.INT8: np.int8,
+    LogicalType.INT16: np.int16,
+    LogicalType.INT32: np.int32,
+    LogicalType.INT64: np.int64,
+    LogicalType.UINT8: np.uint8,
+    LogicalType.UINT16: np.uint16,
+    LogicalType.UINT32: np.uint32,
+    LogicalType.UINT64: np.uint64,
+    LogicalType.FLOAT32: np.float32,
+    LogicalType.FLOAT64: np.float64,
+}
+
+_FLOATS = (LogicalType.FLOAT32, LogicalType.FLOAT64)
+
+
+def physical_np_dtype(lt: LogicalType) -> np.dtype:
+    """The numpy dtype of the device representation of ``lt``."""
+    if lt == LogicalType.STRING:
+        return np.dtype(np.int32)
+    if lt in (LogicalType.DATE64, LogicalType.TIMEDELTA):
+        return np.dtype(np.int64)
+    d = np.dtype(_NUMERIC_NP[lt])
+    if not config.X64_ENABLED and d.itemsize == 8:
+        # x64 disabled: degrade 64-bit to 32-bit device storage.
+        return np.dtype(d.kind + "4")
+    return d
+
+
+def from_numpy_dtype(dt: np.dtype) -> LogicalType:
+    dt = np.dtype(dt)
+    if dt.kind == "M":
+        return LogicalType.DATE64
+    if dt.kind == "m":
+        return LogicalType.TIMEDELTA
+    if dt.kind in ("U", "S", "O"):
+        return LogicalType.STRING
+    try:
+        return LogicalType(dt.name)
+    except ValueError as e:
+        raise CylonTypeError(f"unsupported dtype {dt}") from e
+
+
+def is_floating(lt: LogicalType) -> bool:
+    return lt in _FLOATS
+
+
+def is_integer(lt: LogicalType) -> bool:
+    return lt.value.startswith(("int", "uint"))
+
+
+def is_numeric(lt: LogicalType) -> bool:
+    return lt in _NUMERIC_NP and lt != LogicalType.BOOL
+
+
+@dataclass(frozen=True)
+class Field:
+    """Column schema entry: name + logical type + nullability."""
+
+    name: str
+    type: LogicalType
+    nullable: bool = False

@@ -1,0 +1,214 @@
+"""Device-resident Table.
+
+TPU-native equivalent of ``cylon::Table`` (reference cpp/src/cylon/table.hpp:46
+— a ``shared_ptr<arrow::Table>`` + context) in the GCylon accelerator-resident
+style (cpp/src/gcylon/gtable.hpp: data stays in device memory, the host only
+orchestrates).  Layout:
+
+* every column is a global ``jax.Array`` of identical length ``W * cap``,
+  row-sharded over the env mesh (``P(ROW_AXIS)``);
+* shard ``i`` holds ``valid_counts[i] <= cap`` real rows as a prefix, the rest
+  is padding — XLA collectives are static-shape, so capacity-padding + a
+  row-count sidecar replaces the reference's variable-size Arrow buffer
+  serializer (serialize/table_serialize.hpp:23, SURVEY.md §5.8);
+* global row order == concatenation of shard valid prefixes in rank order
+  (the same contract the reference's order-preserving all-to-all maintains,
+  table.cpp:182-190).
+
+A local table is the world-size-1 special case: one shard, zero padding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from ..ctx.context import CylonEnv, LocalConfig
+from ..status import CylonKeyError, InvalidError
+from .column import Column
+from .dtypes import Field, LogicalType
+
+_default_env: CylonEnv | None = None
+
+
+def default_env() -> CylonEnv:
+    global _default_env
+    if _default_env is None:
+        _default_env = CylonEnv(LocalConfig())
+    return _default_env
+
+
+class Table:
+    __slots__ = ("_cols", "_env", "_valid")
+
+    def __init__(self, cols: Mapping[str, Column], env: CylonEnv | None,
+                 valid_counts: np.ndarray | None = None):
+        self._cols: dict[str, Column] = dict(cols)
+        self._env = env or default_env()
+        n = None
+        for c in self._cols.values():
+            if n is None:
+                n = len(c)
+            elif len(c) != n:
+                raise InvalidError("column length mismatch")
+        n = n or 0
+        w = self._env.world_size
+        if valid_counts is None:
+            if n % w:
+                raise InvalidError(f"rows {n} not divisible by world {w}")
+            valid_counts = np.full(w, n // w, dtype=np.int64)
+        self._valid = np.asarray(valid_counts, dtype=np.int64)
+        if self._valid.shape != (w,):
+            raise InvalidError("valid_counts must have one entry per rank")
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_pydict(data: Mapping[str, np.ndarray], env: CylonEnv | None = None) -> "Table":
+        env = env or default_env()
+        cols = {k: Column.from_numpy(np.asarray(v)) for k, v in data.items()}
+        if env.world_size == 1:
+            return Table(cols, env)
+        return _distribute(cols, env)
+
+    @staticmethod
+    def from_pandas(df, env: CylonEnv | None = None) -> "Table":
+        env = env or default_env()
+        cols = {str(k): Column.from_numpy(df[k].to_numpy()) for k in df.columns}
+        if env.world_size == 1:
+            return Table(cols, env)
+        return _distribute(cols, env)
+
+    @staticmethod
+    def from_arrow(at, env: CylonEnv | None = None) -> "Table":
+        """From a pyarrow.Table (reference Table::FromArrowTable, table.hpp:61)."""
+        return Table.from_pandas(at.to_pandas(), env)
+
+    @staticmethod
+    def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
+                   env: CylonEnv | None = None) -> "Table":
+        return Table.from_pydict(dict(zip(names, arrays)), env)
+
+    # -- schema ------------------------------------------------------------
+    @property
+    def env(self) -> CylonEnv:
+        return self._env
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self._cols)
+
+    @property
+    def columns(self) -> dict[str, Column]:
+        return self._cols
+
+    @property
+    def column_count(self) -> int:
+        return len(self._cols)
+
+    @property
+    def row_count(self) -> int:
+        """Global (world-wide) valid row count."""
+        return int(self._valid.sum())
+
+    @property
+    def valid_counts(self) -> np.ndarray:
+        return self._valid
+
+    @property
+    def capacity(self) -> int:
+        """Per-shard padded capacity."""
+        if not self._cols:
+            return 0
+        return len(next(iter(self._cols.values()))) // self._env.world_size
+
+    @property
+    def schema(self) -> list[Field]:
+        return [Field(k, c.type, c.has_nulls) for k, c in self._cols.items()]
+
+    def column(self, name: str) -> Column:
+        try:
+            return self._cols[name]
+        except KeyError:
+            raise CylonKeyError(f"no column {name!r}; have {self.column_names}")
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    # -- projections (host-side metadata ops, zero device work) ------------
+    def project(self, names: Iterable[str]) -> "Table":
+        return Table({n: self.column(n) for n in names}, self._env, self._valid)
+
+    def drop(self, names: Iterable[str]) -> "Table":
+        drop = set(names)
+        return Table({k: v for k, v in self._cols.items() if k not in drop},
+                     self._env, self._valid)
+
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        return Table({mapping.get(k, k): v for k, v in self._cols.items()},
+                     self._env, self._valid)
+
+    def with_columns(self, extra: Mapping[str, Column]) -> "Table":
+        cols = dict(self._cols)
+        cols.update(extra)
+        return Table(cols, self._env, self._valid)
+
+    # -- materialization ---------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+        w = self._env.world_size
+        cap = self.capacity
+        out = {}
+        for k, c in self._cols.items():
+            host = np.asarray(c.data)
+            valid = np.asarray(c.validity) if c.validity is not None else None
+            sl = [slice(i * cap, i * cap + int(self._valid[i])) for i in range(w)]
+            data = np.concatenate([host[s] for s in sl]) if sl else host[:0]
+            vcat = np.concatenate([valid[s] for s in sl]) if valid is not None else None
+            out[k] = Column(data, c.type, vcat, c.dictionary).to_numpy(len(data))
+        return pd.DataFrame(out)
+
+    def to_arrow(self):
+        import pyarrow as pa
+        return pa.Table.from_pandas(self.to_pandas(), preserve_index=False)
+
+    def to_pylist(self) -> list[dict]:
+        return self.to_pandas().to_dict("records")
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"Table(rows={self.row_count}, cols={self.column_names}, "
+                f"world={self._env.world_size}, cap={self.capacity})")
+
+
+def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:
+    """Split host-built columns into W contiguous row blocks, pad each to the
+    common capacity, and place them sharded on the mesh.  This is the
+    single-controller analog of per-rank partition ingestion (reference:
+    each rank reads its own partition, docs/docs/arch.md:42-47)."""
+    from .. import config
+    n = len(next(iter(cols.values()))) if cols else 0
+    w = env.world_size
+    chunk = -(-n // w)  # contiguous rows per rank (last ranks may get fewer)
+    # pow2-bucketed capacity: bounds the family of compiled shapes across
+    # ingests of varying row counts (config.POW2_CAPACITIES)
+    cap = config.pow2ceil(chunk)
+    valid = np.asarray([max(0, min(chunk, n - i * chunk)) for i in range(w)],
+                       np.int64)
+    sharding = env.sharding()
+    out = {}
+    for k, c in cols.items():
+        host = np.asarray(c.data)
+        padded = np.zeros((w * cap,) + host.shape[1:], host.dtype)
+        vhost = np.asarray(c.validity) if c.validity is not None else None
+        vpad = np.zeros(w * cap, bool) if vhost is not None else None
+        for i in range(w):
+            m = int(valid[i])
+            if m:
+                padded[i * cap: i * cap + m] = host[i * chunk: i * chunk + m]
+                if vpad is not None:
+                    vpad[i * cap: i * cap + m] = vhost[i * chunk: i * chunk + m]
+        data = jax.device_put(padded, sharding)
+        v = jax.device_put(vpad, sharding) if vpad is not None else None
+        out[k] = Column(data, c.type, v, c.dictionary)
+    return Table(out, env, valid)

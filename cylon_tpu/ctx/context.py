@@ -1,0 +1,183 @@
+"""Execution context: ``CylonEnv`` + communicator configs.
+
+TPU-native replacement for the reference's context + communicator bootstrap
+(reference: ctx/cylon_context.hpp:30 ``CylonContext::Init/InitDistributed``,
+net/comm_config.hpp, net/mpi/mpi_communicator.hpp:26 ``MPIConfig``).
+
+Design shift (SURVEY.md §7): the reference is multi-process SPMD bootstrapped
+by MPI/UCX/Gloo; the TPU build is **single-controller SPMD** — one Python
+process drives an N-device ``jax.sharding.Mesh`` and the mesh *is* the world.
+``rank`` becomes a device index, the hand-rolled channel/AllToAll engine
+(net/ops/all_to_all.hpp:78) becomes XLA collectives inside ``shard_map``, and
+MPI_Init becomes ``jax.distributed.initialize`` (multi-host, optional).
+
+Config classes keep the reference's naming so user code reads the same:
+``CylonEnv(config=TPUConfig())`` ~ ``CylonEnv(config=MPIConfig())``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..status import InvalidError
+
+ROW_AXIS = "cyl_rows"  # the mesh axis tables are row-sharded over
+
+
+class CommConfig:
+    """Base communicator config (reference: net/comm_config.hpp)."""
+
+    comm_type = "local"
+
+    def resolve_devices(self) -> list[Any]:
+        raise NotImplementedError
+
+
+class LocalConfig(CommConfig):
+    """Serial context: world size 1, no collectives (reference Init())."""
+
+    comm_type = "local"
+
+    def resolve_devices(self):
+        return [jax.devices()[0]]
+
+
+class TPUConfig(CommConfig):
+    """Bind ranks to accelerator chips via a 1-D device mesh.
+
+    ``world_size=None`` uses every visible device.  ``devices`` may pin an
+    explicit device list.  ``distributed=True`` calls
+    ``jax.distributed.initialize`` first (multi-host DCN bootstrap — the
+    moral slot of the reference's Redis/MPI OOB, §2 C15).
+    """
+
+    comm_type = "tpu"
+
+    def __init__(self, world_size: int | None = None, devices: Sequence[Any] | None = None,
+                 distributed: bool = False, coordinator_address: str | None = None,
+                 process_id: int | None = None, num_processes: int | None = None):
+        self.world_size = world_size
+        self.devices = list(devices) if devices is not None else None
+        self.distributed = distributed
+        self.coordinator_address = coordinator_address
+        self.process_id = process_id
+        self.num_processes = num_processes
+
+    def resolve_devices(self):
+        if self.distributed and not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+        devs = self.devices if self.devices is not None else list(jax.devices())
+        if self.world_size is not None:
+            if self.world_size > len(devs):
+                raise InvalidError(
+                    f"world_size {self.world_size} > visible devices {len(devs)}")
+            devs = devs[: self.world_size]
+        return devs
+
+
+class CPUMeshConfig(TPUConfig):
+    """Host-CPU simulated grid (tests): the analog of the reference's
+    ``mpirun --oversubscribe`` localhost testing (SURVEY.md §4.3).  Requires
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``."""
+
+    comm_type = "cpu-mesh"
+
+    def resolve_devices(self):
+        if self.devices is not None:
+            devs = list(self.devices)
+        else:
+            devs = [d for d in jax.devices() if d.platform == "cpu"]
+            if not devs:
+                devs = list(jax.devices("cpu"))
+        if self.world_size is not None:
+            if self.world_size > len(devs):
+                raise InvalidError(
+                    f"world_size {self.world_size} > visible CPU devices "
+                    f"{len(devs)} — set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={self.world_size}")
+            devs = devs[: self.world_size]
+        return devs
+
+
+_seq = itertools.count()
+
+
+class CylonEnv:
+    """The world handle (reference: python/pycylon frame.py:90 ``CylonEnv``,
+    C++ ``CylonContext``).  Holds the device mesh, rank/world bookkeeping, a
+    string config map, and the per-collective sequence counter."""
+
+    def __init__(self, config: CommConfig | None = None, verbose: bool = False):
+        self.config = config or LocalConfig()
+        self.verbose = verbose
+        devs = self.config.resolve_devices()
+        self._devices = devs
+        self._mesh = Mesh(np.asarray(devs, dtype=object), (ROW_AXIS,))
+        self._conf: dict[str, str] = {}
+        self._finalized = False
+
+    # -- reference CylonContext surface ------------------------------------
+    @property
+    def world_size(self) -> int:
+        return len(self._devices)
+
+    @property
+    def rank(self) -> int:
+        # Single-controller: the controller addresses all ranks; expose the
+        # process index for multi-host parity with GetRank().
+        return jax.process_index()
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def devices(self):
+        return list(self._devices)
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    def sharding(self, spec: P | None = None) -> NamedSharding:
+        """NamedSharding over this env's mesh; default = row-sharded."""
+        return NamedSharding(self._mesh, P(ROW_AXIS) if spec is None else spec)
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self._mesh, P())
+
+    def get_next_sequence(self) -> int:
+        """Monotone op id (reference cylon_context.hpp:135 edge-id allocator;
+        here only used for tracing tags — XLA orders collectives for us)."""
+        return next(_seq)
+
+    def add_config(self, key: str, value: str) -> None:
+        self._conf[key] = value
+
+    def get_config(self, key: str, default: str = "") -> str:
+        return self._conf.get(key, default)
+
+    def barrier(self) -> None:
+        """Block until all queued device work is done (reference Barrier())."""
+        for d in self._devices:
+            try:
+                jax.block_until_ready(
+                    jax.device_put(np.zeros((), np.int32), d))
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+    def finalize(self) -> None:
+        self._finalized = True
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"CylonEnv(world={self.world_size}, comm={self.config.comm_type}, "
+                f"devices={[str(d) for d in self._devices]})")

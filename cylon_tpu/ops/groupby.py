@@ -1,0 +1,200 @@
+"""Segment-reduction groupby kernels.
+
+TPU-native replacement for the reference's groupby engines
+(cpp/src/cylon/groupby/hash_groupby.cpp CRTP agg kernels,
+cpp/src/cylon/mapreduce/mapreduce.hpp:79 ``MapReduceKernel`` with its
+CombineLocally → shuffle intermediates → ReduceShuffledResults → Finalize
+flow, and compute/aggregate_kernels.hpp:43 ``AggregationOpId``).
+
+Design: group identity comes from a dense rank (:mod:`.pack`) instead of a
+hash map; every aggregation is then a ``jax.ops.segment_*`` — an XLA scatter
+that fuses and vectorizes.  The MapReduce decomposition is preserved exactly
+because it is what makes distributed groupby cheap: each op declares
+*intermediate* columns that are themselves segment-reducible (MEAN →
+{sum,count}, VAR/STD → {sum,sumsq,count}), so the distributed path is
+local-combine → hash-shuffle intermediates → combine → finalize
+(reference groupby/groupby.cpp:33 ``DistributedHashGroupBy``).
+
+Masked (padding) rows are routed to one extra trash segment which is sliced
+off — never out-of-bounds scatters.
+
+Supported ops (AggregationOpId parity): sum, count, min, max, mean, var,
+std, nunique, quantile/median (+ first/last index helpers).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: ops whose intermediates are plain segment reductions (associative —
+#: eligible for local pre-combine before the shuffle, groupby.cpp:76-81)
+ASSOCIATIVE = {"sum", "count", "min", "max", "mean", "var", "std"}
+#: ops that must see raw (shuffled) values
+NON_ASSOCIATIVE = {"nunique", "quantile", "median"}
+
+
+def _int_dtype():
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def _route(gids, num_segments, mask):
+    """(effective gids, total segments): masked rows → trash segment."""
+    if mask is None:
+        return gids, num_segments
+    return jnp.where(mask, gids, jnp.int32(num_segments)), num_segments + 1
+
+
+def seg_sum(values, gids, num_segments, mask=None):
+    g, ns = _route(gids, num_segments, mask)
+    return jax.ops.segment_sum(values, g, num_segments=ns)[:num_segments]
+
+
+def seg_count(values, gids, num_segments, mask=None):
+    g, ns = _route(gids, num_segments, mask)
+    ones = jnp.ones(gids.shape[0], _int_dtype())
+    return jax.ops.segment_sum(ones, g, num_segments=ns)[:num_segments]
+
+
+def seg_min(values, gids, num_segments, mask=None):
+    g, ns = _route(gids, num_segments, mask)
+    return jax.ops.segment_min(values, g, num_segments=ns)[:num_segments]
+
+
+def seg_max(values, gids, num_segments, mask=None):
+    g, ns = _route(gids, num_segments, mask)
+    return jax.ops.segment_max(values, g, num_segments=ns)[:num_segments]
+
+
+def _ftype(values):
+    return jnp.float64 if (values.dtype.itemsize == 8
+                           and jax.config.jax_enable_x64) else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# MapReduce decomposition (reference mapreduce.hpp:56-76 six-stage flow)
+# ---------------------------------------------------------------------------
+
+def combine_locally(op: str, values, gids, num_segments, mask=None):
+    """Stage 1: per-group intermediates on local rows.  Returns a dict of
+    named intermediate arrays, each of length num_segments and each further
+    reducible by :func:`reduce_intermediates`."""
+    if op == "sum":
+        return {"sum": seg_sum(values, gids, num_segments, mask)}
+    if op == "count":
+        return {"count": seg_count(values, gids, num_segments, mask)}
+    if op == "min":
+        return {"min": seg_min(values, gids, num_segments, mask),
+                "count": seg_count(values, gids, num_segments, mask)}
+    if op == "max":
+        return {"max": seg_max(values, gids, num_segments, mask),
+                "count": seg_count(values, gids, num_segments, mask)}
+    if op == "mean":
+        f = values.astype(_ftype(values))
+        return {"sum": seg_sum(f, gids, num_segments, mask),
+                "count": seg_count(values, gids, num_segments, mask)}
+    if op in ("var", "std"):
+        f = values.astype(_ftype(values))
+        return {"sum": seg_sum(f, gids, num_segments, mask),
+                "sumsq": seg_sum(f * f, gids, num_segments, mask),
+                "count": seg_count(values, gids, num_segments, mask)}
+    raise ValueError(f"op {op} has no associative decomposition")
+
+
+_REDUCERS = {"sum": seg_sum, "sumsq": seg_sum, "count": seg_sum,
+             "min": seg_min, "max": seg_max}
+
+
+def reduce_intermediates(inter: dict, gids, num_segments, mask=None):
+    """Stage 4: combine shuffled intermediates keyed by new group ids.
+    min/max of empty pre-groups carry sentinel values; their count=0 keeps
+    them out of the final validity."""
+    return {k: _REDUCERS[k](v, gids, num_segments, mask)
+            for k, v in inter.items()}
+
+
+def finalize(op: str, inter: dict, ddof: int = 1):
+    """Stage 5: intermediates → (result_values, result_validity|None)."""
+    cnt = inter.get("count")
+    if op == "sum":
+        return inter["sum"], None
+    if op == "count":
+        return inter["count"], None
+    if op == "min":
+        return inter["min"], (cnt > 0) if cnt is not None else None
+    if op == "max":
+        return inter["max"], (cnt > 0) if cnt is not None else None
+    if op == "mean":
+        c = jnp.maximum(cnt, 1).astype(inter["sum"].dtype)
+        return inter["sum"] / c, cnt > 0
+    if op in ("var", "std"):
+        c = jnp.maximum(cnt, 1).astype(inter["sum"].dtype)
+        mean = inter["sum"] / c
+        var = jnp.maximum(inter["sumsq"] / c - mean * mean, 0.0)
+        denom = jnp.maximum(cnt - ddof, 1).astype(var.dtype)
+        var = var * (c / denom)
+        ok = cnt > ddof
+        return (jnp.sqrt(var) if op == "std" else var), ok
+    raise ValueError(f"unknown associative op {op}")
+
+
+# ---------------------------------------------------------------------------
+# Non-associative ops on raw (possibly shuffled) values
+# ---------------------------------------------------------------------------
+
+def nunique(value_keyops, gids, num_segments, mask=None):
+    """Distinct count per group: sort (gid, value...) tuples, count boundary
+    transitions per segment.  ``value_keyops`` is a
+    :class:`~cylon_tpu.ops.pack.KeyOps` over the value column; pass a mask to
+    exclude padding/null rows (pandas nunique drops nulls)."""
+    from .pack import neighbor_flags
+    g, ns = _route(gids, num_segments, mask)
+    keys = (g,) + value_keyops.ops
+    kinds = ("i",) + value_keyops.kinds
+    srt = jax.lax.sort(keys, num_keys=len(keys), is_stable=False)
+    gs = srt[0]
+    first = jnp.concatenate([jnp.ones(1, jnp.int32),
+                             jnp.zeros(gs.shape[0] - 1, jnp.int32)]) \
+        if gs.shape[0] else jnp.zeros(0, jnp.int32)
+    neq = neighbor_flags(srt, kinds) | first
+    return jax.ops.segment_sum(neq, gs, num_segments=ns)[:num_segments]
+
+
+def quantile(values, gids, num_segments, q: float, mask=None):
+    """Per-group quantile with linear interpolation.  Sorts (gid, value) then
+    indexes each group's sorted run via count prefix sums."""
+    f = values.astype(_ftype(values))
+    g, ns = _route(gids, num_segments, mask)
+    v = f if mask is None else jnp.where(mask, f, jnp.inf)
+    g_s, v_s = jax.lax.sort((g, v), num_keys=2, is_stable=False)
+    cnt_all = jax.ops.segment_sum(jnp.ones_like(g, dtype=_int_dtype()), g,
+                                  num_segments=ns)
+    offs_all = jnp.concatenate(
+        [jnp.zeros(1, cnt_all.dtype), jnp.cumsum(cnt_all)[:-1]])
+    cnt, offs = cnt_all[:num_segments], offs_all[:num_segments]
+    posf = jnp.asarray(q, f.dtype) * jnp.maximum(cnt - 1, 0).astype(f.dtype)
+    lo = jnp.floor(posf).astype(cnt.dtype)
+    hi = jnp.ceil(posf).astype(cnt.dtype)
+    frac = posf - lo.astype(f.dtype)
+    n = v_s.shape[0]
+    take = lambda i: v_s[jnp.clip(offs + i, 0, max(n - 1, 0)).astype(jnp.int32)]
+    vlo, vhi = take(lo), take(hi)
+    return vlo + (vhi - vlo) * frac, cnt > 0
+
+
+def group_first_index(gids, num_segments, mask=None):
+    """Representative (first) source-row index per group — used to gather the
+    key columns of the groupby result."""
+    n = gids.shape[0]
+    g, ns = _route(gids, num_segments, mask)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jax.ops.segment_min(idx, g, num_segments=ns)[:num_segments]
+
+
+def np_result_dtype(op: str, src: np.dtype) -> np.dtype:
+    if op in ("count", "nunique"):
+        return np.dtype(np.int64)
+    if op in ("mean", "var", "std", "quantile", "median"):
+        return np.dtype(np.float64) if src.itemsize == 8 else np.dtype(np.float32)
+    return np.dtype(src)

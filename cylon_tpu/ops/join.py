@@ -1,0 +1,132 @@
+"""Local join kernel: sort-merge on dense key ids.
+
+TPU-native replacement for the reference's local join layer
+(cpp/src/cylon/join/join.cpp:60 ``JoinTables`` dispatch, sort_join.cpp:66
+``do_sorted_join``, hash_join.cpp:22-85).  The reference's default algorithm
+is SORT (join_config.hpp:37); a pointer-chasing hash build/probe doesn't map
+to XLA, so the sort path is *the* design here (SURVEY.md §7 hard-part 2):
+
+    sort right ids → searchsorted(left ids) match ranges →
+    prefix-sum offsets → one vectorized gather expansion.
+
+Inputs are int32 **dense ranks** from :mod:`cylon_tpu.ops.pack` (multi-column
+/ string / null-aware keys all collapse to one id column first), so a single
+int comparison implements full row equality.  Output size is data-dependent;
+callers run the ``*_count`` phase, pick a static capacity (pow2-bucketed),
+then the ``*_indices`` phase — the two-phase static-shape pattern that
+replaces the reference's dynamically-growing Arrow builders.
+
+INNER / LEFT / RIGHT / FULL_OUTER all supported (join_config.hpp:25).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+SENT_L = jnp.int32(1 << 30)
+SENT_R = jnp.int32((1 << 30) + 1)
+
+
+def _effective_ids(l_ids, r_ids, l_mask, r_mask):
+    le = l_ids if l_mask is None else jnp.where(l_mask, l_ids, SENT_L)
+    re_ = r_ids if r_mask is None else jnp.where(r_mask, r_ids, SENT_R)
+    return le, re_
+
+
+def _bounds(sorted_ids, query):
+    lo = jnp.searchsorted(sorted_ids, query, side="left", method="sort")
+    hi = jnp.searchsorted(sorted_ids, query, side="right", method="sort")
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _sort_ids(ids):
+    idx = jnp.arange(ids.shape[0], dtype=jnp.int32)
+    s, perm = jax.lax.sort((ids, idx), num_keys=1, is_stable=True)
+    return s, perm
+
+
+def _counts(le, re_, l_mask, how_left: bool):
+    rs, _ = _sort_ids(re_)
+    lo, hi = _bounds(rs, le)
+    counts = hi - lo
+    out = jnp.maximum(counts, 1) if how_left else counts
+    if l_mask is not None:
+        out = jnp.where(l_mask, out, 0)
+    return counts, out
+
+
+def _unmatched_right(le, re_, r_mask):
+    ls, _ = _sort_ids(le)
+    lo, hi = _bounds(ls, re_)
+    un = lo == hi
+    if r_mask is not None:
+        un = un & r_mask
+    return un
+
+
+@partial(jax.jit, static_argnames=("how",))
+def join_count(l_ids, r_ids, how: str, l_mask=None, r_mask=None):
+    """Exact output row count (device scalar) for the given join type."""
+    if how == "right":
+        return join_count(r_ids, l_ids, "left", r_mask, l_mask)
+    le, re_ = _effective_ids(l_ids, r_ids, l_mask, r_mask)
+    _, eff = _counts(le, re_, l_mask, how_left=how in ("left", "outer"))
+    total = jnp.sum(eff)
+    if how == "outer":
+        total = total + jnp.sum(_unmatched_right(le, re_, r_mask))
+    return total.astype(jnp.int32)
+
+
+def _expand(counts, eff_counts, lo, perm_r, out_cap: int):
+    n = counts.shape[0]
+    csum = jnp.cumsum(eff_counts)
+    offs = jnp.concatenate([jnp.zeros(1, csum.dtype), csum[:-1]])
+    total = jnp.where(n > 0, csum[-1], 0)
+    k = jnp.arange(out_cap, dtype=jnp.int32)
+    li = (jnp.searchsorted(offs, k, side="right", method="sort") - 1).astype(jnp.int32)
+    li = jnp.clip(li, 0, max(n - 1, 0))
+    rel = k - offs[li].astype(jnp.int32)
+    matched = rel < counts[li]
+    rpos = jnp.where(matched, lo[li] + rel, 0)
+    r_take = jnp.where(matched, perm_r[rpos], -1)
+    valid = k < total
+    l_take = jnp.where(valid, li, -1)
+    r_take = jnp.where(valid, r_take, -1)
+    return l_take, r_take, total.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("how", "out_cap"))
+def join_indices(l_ids, r_ids, how: str, out_cap: int, l_mask=None, r_mask=None):
+    """Materialize (l_take, r_take, total): row index pairs of the join
+    result, -1 marking the null side of unmatched outer rows.  ``out_cap``
+    must be >= the count from :func:`join_count`; slots past ``total`` hold
+    (-1, -1)."""
+    if how == "right":
+        r_take, l_take, total = join_indices(
+            r_ids, l_ids, "left", out_cap, r_mask, l_mask)
+        return l_take, r_take, total
+    le, re_ = _effective_ids(l_ids, r_ids, l_mask, r_mask)
+    rs, perm_r = _sort_ids(re_)
+    lo, hi = _bounds(rs, le)
+    counts = hi - lo
+    eff = jnp.maximum(counts, 1) if how in ("left", "outer") else counts
+    if l_mask is not None:
+        eff = jnp.where(l_mask, eff, 0)
+    l_take, r_take, total = _expand(counts, eff, lo, perm_r, out_cap)
+    if how == "outer":
+        un = _unmatched_right(le, re_, r_mask)  # (m,)
+        m = un.shape[0]
+        ridx = jnp.arange(m, dtype=jnp.int32)
+        # compact unmatched right rows preserving order: first n_un of ``src``
+        order = jnp.where(un, ridx, jnp.int32(m))
+        _, src = jax.lax.sort((order, ridx), num_keys=1, is_stable=True)
+        n_un = jnp.sum(un).astype(jnp.int32)
+        pos = total + jnp.arange(m, dtype=jnp.int32)
+        pos = jnp.where(jnp.arange(m) < n_un, pos, jnp.int32(out_cap))
+        l_take = l_take.at[pos].set(jnp.int32(-1), mode="drop")
+        r_take = r_take.at[pos].set(src, mode="drop")
+        total = total + n_un
+    return l_take, r_take, total

@@ -1,0 +1,179 @@
+"""Key canonicalization & dense-rank packing.
+
+TPU-native replacement for the reference's row comparators / hashers
+(cpp/src/cylon/arrow/arrow_comparator.hpp:59 ``ArrayIndexComparator``, :196
+``TableRowIndexHash``, :238/270 dual-table variants) and the multi-column
+flattener (util/flatten_array.cpp).  The reference compares rows via per-type
+virtual comparators and pointer-chasing hash maps; on TPU we instead
+
+1. canonicalize every key column into **sort operands** (``KeyOps``) such
+   that ``jax.lax.sort``'s multi-operand lexicographic order implements the
+   requested row order (ascending/descending, nulls first/last), and
+2. replace "row equality/hash" with a **dense rank**: jointly sort the key
+   tuples and assign consecutive group ids.  Two tables get comparable ids by
+   ranking their concatenation (the dual-table comparator analog).
+
+No 64-bit bitcasts anywhere — XLA's TPU x64 emulation does not implement
+``bitcast-convert`` on u64, so descending order uses arithmetic transforms
+(``~x`` for ints — total, overflow-free — and ``-x`` for floats) and float
+equality is handled by NaN/zero canonicalization plus float-aware compare
+helpers instead of the classic IEEE bit-flip trick.
+
+Every downstream op (join, groupby, set ops, unique) then works on a single
+int32 id column — the moral equivalent of the reference flattening multi-col
+keys to one binary column before hashing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+NULL_FIRST = 0
+NULL_LAST = 2
+
+
+class KeyOps(NamedTuple):
+    """Lexicographic sort operands + per-operand kind ('i' int-like,
+    'f' float — needs NaN-aware equality)."""
+
+    ops: tuple
+    kinds: tuple
+
+    @property
+    def n(self):
+        return self.ops[0].shape[0]
+
+
+def _canon_float(x: jax.Array) -> jax.Array:
+    """Canonicalize float payloads for *equality*: -0.0 → +0.0 and all NaNs
+    → one positive quiet NaN (so sort is deterministic and NaNs group)."""
+    x = jnp.where(x == 0, jnp.zeros_like(x), x)
+    return jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
+
+
+def _sort_value(x: jax.Array, descending: bool) -> tuple[jax.Array, str]:
+    dt = x.dtype
+    if dt == jnp.bool_:
+        v = x.astype(jnp.int32)
+        return (-v if descending else v), "i"
+    if jnp.issubdtype(dt, jnp.integer):
+        # ~x = -x-1: strictly decreasing, total, no overflow (INT_MIN→INT_MAX)
+        return (~x if descending else x), "i"
+    if jnp.issubdtype(dt, jnp.floating):
+        v = -x if descending else x
+        # positive canonical NaN sorts after all numbers in XLA's total order
+        v = _canon_float(v)
+        return v, "f"
+    raise TypeError(f"unsortable dtype {dt}")
+
+
+def key_operands(datas, validities=None, row_mask=None, descendings=None,
+                 nulls_position: int = NULL_LAST, pad_key: int = 4) -> KeyOps:
+    """Build the lexicographic sort-operand list for a key tuple.
+
+    For each key column: a (null-flag, value) operand pair — valid rows get
+    flag 1, nulls get 0 (first) or 2 (last), matching pandas ``na_position``
+    independently of ascending/descending.  A leading row-liveness operand is
+    added when ``row_mask`` is given; padding rows sort last with flag
+    ``pad_key`` (use distinct pad keys per table so padding never matches
+    across tables in a dense rank).
+    """
+    ops, kinds = [], []
+    n = datas[0].shape[0]
+    if row_mask is not None:
+        ops.append(jnp.where(row_mask, jnp.int32(0), jnp.int32(pad_key)))
+        kinds.append("i")
+    for i, d in enumerate(datas):
+        desc = bool(descendings[i]) if descendings is not None else False
+        val, kind = _sort_value(d, desc)
+        v = validities[i] if validities is not None else None
+        if v is None:
+            nf = jnp.zeros(n, jnp.int32)
+        else:
+            nf = jnp.where(v, jnp.int32(1), jnp.int32(nulls_position))
+            val = jnp.where(v, val, jnp.zeros_like(val))
+        ops.append(nf)
+        kinds.append("i")
+        ops.append(val)
+        kinds.append(kind)
+    return KeyOps(tuple(ops), tuple(kinds))
+
+
+def concat_keyops(a: KeyOps, b: KeyOps) -> KeyOps:
+    assert a.kinds == b.kinds
+    return KeyOps(tuple(jnp.concatenate([x, y]) for x, y in zip(a.ops, b.ops)),
+                  a.kinds)
+
+
+# -- float-aware elementwise comparisons (post-canonicalization) ------------
+
+def op_neq(a, b, kind: str):
+    if kind == "f":
+        return (a != b) & ~(jnp.isnan(a) & jnp.isnan(b))
+    return a != b
+
+
+def op_gt(a, b, kind: str):
+    if kind == "f":
+        return (a > b) | (jnp.isnan(a) & ~jnp.isnan(b))
+    return a > b
+
+
+def op_eq(a, b, kind: str):
+    if kind == "f":
+        return (a == b) | (jnp.isnan(a) & jnp.isnan(b))
+    return a == b
+
+
+def neighbor_flags(sorted_ops, kinds):
+    """int32 flags: row i != row i-1 under the key tuple (row 0 → 0)."""
+    n = sorted_ops[0].shape[0]
+    neq = jnp.zeros(n, jnp.int32)
+    for op, kind in zip(sorted_ops, kinds):
+        d = op_neq(op[1:], op[:-1], kind).astype(jnp.int32)
+        neq = neq | jnp.concatenate([jnp.zeros(1, jnp.int32), d])
+    return neq
+
+
+def dense_rank(keyops: KeyOps):
+    """Rank rows by their key tuple: returns ``(gids, n_groups)`` where
+    ``gids[i]`` is the 0-based dense rank of row i's key (ids ordered like
+    the keys — an order-preserving perfect hash over this batch)."""
+    n = keyops.n
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(keyops.ops + (idx,), num_keys=len(keyops.ops),
+                              is_stable=True)
+    sidx = sorted_all[-1]
+    gid_sorted = jnp.cumsum(neighbor_flags(sorted_all[:-1], keyops.kinds))
+    gids = jnp.zeros(n, jnp.int32).at[sidx].set(gid_sorted.astype(jnp.int32))
+    n_groups = (jnp.where(n > 0, gid_sorted[-1] + 1, 0).astype(jnp.int32)
+                if n > 0 else jnp.int32(0))
+    return gids, n_groups
+
+
+def dense_rank_two(l: KeyOps, r: KeyOps):
+    """Comparable dense ranks across two tables (dual-table comparator
+    analog, arrow_comparator.hpp:238): rank the concatenation, split back."""
+    n = l.n
+    gids, n_groups = dense_rank(concat_keyops(l, r))
+    return gids[:n], gids[n:], n_groups
+
+
+def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):
+    """(n, S) bool: row i's key tuple strictly greater than splitter j's.
+    Used by sample-sort range partitioning (reference table.cpp:564-609
+    split-point binary search).  ``splitter_ops`` parallel ``keyops.ops``
+    with shape (S,) each."""
+    n = keyops.n
+    s = splitter_ops[0].shape[0]
+    gt = jnp.zeros((n, s), bool)
+    eq = jnp.ones((n, s), bool)
+    for op, sop, kind in zip(keyops.ops, splitter_ops, keyops.kinds):
+        a = op[:, None]
+        b = sop[None, :]
+        gt = gt | (eq & op_gt(a, b, kind))
+        eq = eq & op_eq(a, b, kind)
+    return gt

@@ -1,0 +1,110 @@
+"""Status / error model.
+
+TPU-native equivalent of the reference's C++ ``Status``/``Code`` pair
+(reference: cpp/src/cylon/status.hpp:65, cpp/src/cylon/code.hpp:19).  The
+reference threads a ``Status{code, msg}`` through every call; in Python the
+idiomatic carrier is an exception hierarchy, but we keep the same code
+vocabulary so bindings and tests can assert on error categories.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Code(enum.IntEnum):
+    """Error codes mirroring reference cpp/src/cylon/code.hpp:19-40."""
+
+    OK = 0
+    OutOfMemory = 1
+    KeyError = 2
+    TypeError = 3
+    Invalid = 4
+    IOError = 5
+    CapacityError = 6
+    IndexError = 7
+    UnknownError = 9
+    NotImplemented = 10
+    SerializationError = 11
+    RError = 12
+    CodeGenError = 40
+    ExpressionValidationError = 41
+    ExecutionError = 42
+    AlreadyExists = 45
+
+
+class CylonError(Exception):
+    """Base error carrying a :class:`Code`."""
+
+    code: Code = Code.UnknownError
+
+    def __init__(self, msg: str = "", code: Code | None = None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code
+
+    @property
+    def msg(self) -> str:
+        return str(self)
+
+
+class InvalidError(CylonError):
+    code = Code.Invalid
+
+
+class CylonTypeError(CylonError):
+    code = Code.TypeError
+
+
+class CylonKeyError(CylonError):
+    code = Code.KeyError
+
+
+class CylonIndexError(CylonError):
+    code = Code.IndexError
+
+
+class CylonIOError(CylonError):
+    code = Code.IOError
+
+
+class NotImplementedCylonError(CylonError):
+    code = Code.NotImplemented
+
+
+class ExecutionError(CylonError):
+    code = Code.ExecutionError
+
+
+class Status:
+    """Value-style status for APIs that prefer returns over raises.
+
+    Mirrors reference ``cylon::Status`` (status.hpp:65): ``is_ok()``,
+    ``get_code()``, ``get_msg()``.
+    """
+
+    __slots__ = ("code", "msg")
+
+    def __init__(self, code: Code = Code.OK, msg: str = ""):
+        self.code = Code(code)
+        self.msg = msg
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(Code.OK)
+
+    def is_ok(self) -> bool:
+        return self.code == Code.OK
+
+    def get_code(self) -> Code:
+        return self.code
+
+    def get_msg(self) -> str:
+        return self.msg
+
+    def raise_if_failed(self) -> None:
+        if not self.is_ok():
+            raise CylonError(self.msg, self.code)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Status({self.code.name}, {self.msg!r})"

@@ -1,0 +1,51 @@
+"""Test rig: force an 8-device virtual CPU mesh.
+
+The moral equivalent of the reference's ``mpirun --oversubscribe`` localhost
+testing (SURVEY.md §4.3): multi-chip is simulated by multi-device on one
+host.  Must run before any test imports jax-heavy modules.
+
+Note: the interpreter may start with a TPU plugin already registered (axon
+sitecustomize imports jax at startup).  ``jax.config.update('jax_platforms')``
+still wins as long as no backend has been initialized, so we set it here
+rather than relying on env vars.
+"""
+
+import os
+
+# read by the CPU client at first backend init
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def env8():
+    """8-rank distributed env (one per virtual CPU device)."""
+    import cylon_tpu as ct
+    from cylon_tpu.ctx.context import CPUMeshConfig
+    return ct.CylonEnv(config=CPUMeshConfig())
+
+
+@pytest.fixture(scope="session")
+def env4():
+    import cylon_tpu as ct
+    from cylon_tpu.ctx.context import CPUMeshConfig
+    return ct.CylonEnv(config=CPUMeshConfig(world_size=4))
+
+
+@pytest.fixture(scope="session")
+def env1():
+    import cylon_tpu as ct
+    return ct.CylonEnv(config=ct.LocalConfig())
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
