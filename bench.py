@@ -1,0 +1,98 @@
+"""Benchmark driver: distributed join + groupby throughput.
+
+The BASELINE.json north-star workload: inner merge on random int64 keys
+followed by groupby-sum, measured as rows/sec/chip.  Runs on every visible
+accelerator chip (or a virtual CPU mesh when no accelerator is present).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/sec/chip", "vs_baseline": N}
+
+vs_baseline anchors to the reference's published weak-scaling join number
+(BASELINE.md: 1M rows/rank at 0.60 s/iter on Summit, 42 ranks/node =>
+~1.67M rows/sec/rank for join alone; we use the same per-worker rows/sec
+denominator for the join+groupby pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# allow virtual-device fallback before jax import
+if "--cpu-mesh" in sys.argv:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+#: reference anchor: Summit weak scaling, 1M rows/rank/iter at 0.60 s
+#: (BASELINE.md summit results-1000000) => rows/sec/worker
+BASELINE_ROWS_PER_SEC_PER_WORKER = 1_000_000 / 0.60
+
+
+def run(rows_per_chip: int = 2_000_000, n_keys_frac: float = 0.5,
+        iters: int = 5) -> dict:
+    import cylon_tpu as ct
+    from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+    from cylon_tpu.relational import groupby_aggregate, join_tables
+
+    devs = jax.devices()
+    on_accel = devs[0].platform != "cpu"
+    cfg = TPUConfig() if on_accel else CPUMeshConfig()
+    env = ct.CylonEnv(config=cfg)
+    w = env.world_size
+
+    n = rows_per_chip * w
+    n_keys = max(int(n * n_keys_frac), 1)
+    rng = np.random.default_rng(42)
+    lk = rng.integers(0, n_keys, n).astype(np.int64)
+    rk = rng.integers(0, n_keys, n).astype(np.int64)
+    lv = rng.random(n)
+    rv = rng.random(n)
+
+    lt = ct.Table.from_pydict({"k": lk, "a": lv}, env)
+    rt = ct.Table.from_pydict({"k": rk, "b": rv}, env)
+
+    def step():
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+        # force completion
+        jax.block_until_ready(next(iter(g.columns.values())).data)
+        return g
+
+    step()  # warmup + compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    # rows processed per iteration = left + right input rows
+    rows_per_sec_per_chip = (2 * n) / best / w
+    return {
+        "metric": "dist join+groupby throughput (int64 keys)",
+        "value": round(rows_per_sec_per_chip, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": round(rows_per_sec_per_chip
+                             / BASELINE_ROWS_PER_SEC_PER_WORKER, 3),
+        "detail": {
+            "world": w,
+            "platform": devs[0].platform,
+            "rows_per_chip": rows_per_chip,
+            "best_iter_s": round(best, 4),
+            "all_iters_s": [round(t, 4) for t in times],
+        },
+    }
+
+
+if __name__ == "__main__":
+    rows = 2_000_000
+    for a in sys.argv[1:]:
+        if a.startswith("--rows="):
+            rows = int(a.split("=", 1)[1])
+    res = run(rows_per_chip=rows)
+    print(json.dumps(res))

@@ -54,10 +54,18 @@ class Column:
 
     @staticmethod
     def _encode_strings(arr: np.ndarray) -> "Column":
+        if arr.dtype.kind == "S":  # binary: decode, don't repr-mangle
+            arr = np.char.decode(arr, "utf-8")
         mask = np.asarray([v is None or (isinstance(v, float) and np.isnan(v))
                            for v in arr]) if arr.dtype == object else np.zeros(len(arr), bool)
         safe = np.where(mask, "", arr.astype(object)) if mask.any() else arr
-        values = np.asarray([str(v) for v in safe], dtype=object)
+
+        def as_str(v):
+            if isinstance(v, (bytes, np.bytes_)):
+                return v.decode("utf-8", "replace")
+            return str(v)
+
+        values = np.asarray([as_str(v) for v in safe], dtype=object)
         # np.unique returns a *sorted* dictionary so code order == lexical
         # order: sorts/joins on codes are exact on the decoded values.
         dictionary, codes = np.unique(values, return_inverse=True)

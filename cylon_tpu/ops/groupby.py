@@ -68,8 +68,10 @@ def seg_max(values, gids, num_segments, mask=None):
 
 
 def _ftype(values):
-    return jnp.float64 if (values.dtype.itemsize == 8
-                           and jax.config.jax_enable_x64) else jnp.float32
+    # accumulate in float64 whenever available: float32 sums over large
+    # groups / large-magnitude ints lose precision visibly (and var via
+    # E[x^2]-mean^2 compounds it with cancellation)
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
 
 # ---------------------------------------------------------------------------
@@ -196,5 +198,8 @@ def np_result_dtype(op: str, src: np.dtype) -> np.dtype:
     if op in ("count", "nunique"):
         return np.dtype(np.int64)
     if op in ("mean", "var", "std", "quantile", "median"):
-        return np.dtype(np.float64) if src.itemsize == 8 else np.dtype(np.float32)
+        # float32 in -> float32 out (pandas parity); everything else f64.
+        # Accumulation happens in _ftype regardless; this is the result cast.
+        return (np.dtype(np.float32) if src == np.dtype(np.float32)
+                else np.dtype(np.float64))
     return np.dtype(src)

@@ -1,0 +1,21 @@
+"""Distributed relational operators (Table-level).
+
+The TPU-native analog of the reference's Table API layer (reference
+cpp/src/cylon/table.hpp:187-527 free functions + table.cpp): every
+distributed operator follows the same skeleton the reference uses —
+``partition locally -> exchange -> local kernel`` (docs/docs/arch.md:42-60) —
+with the exchange being the padded ICI all-to-all in
+:mod:`cylon_tpu.parallel.shuffle` and the local kernels the jit/SPMD vector
+kernels in :mod:`cylon_tpu.ops`.
+
+Local (serial) execution is the world-size-1 special case of the same code
+path, mirroring the reference's ``world==1 -> local op`` dispatch
+(table.cpp:866-868).
+"""
+
+from .join import join_tables  # noqa: F401
+from .groupby import groupby_aggregate  # noqa: F401
+from .sort import sort_table  # noqa: F401
+from .setops import (equals, set_operation, unique_table)  # noqa: F401
+from .repart import (concat_tables, head, repartition, slice_table,  # noqa: F401
+                     shuffle_table, tail)

@@ -1,0 +1,118 @@
+"""Shared plumbing for the table-level operators.
+
+Mirrors the role of the reference's util layer for its Table ops
+(cpp/src/cylon/util/arrow_utils.hpp, join/join_utils.hpp output assembly,
+partition/partition.hpp): key-column canonicalization, string-dictionary
+unification across tables (the reference compares strings via dual-table
+comparators, arrow_comparator.hpp:238 — here both sides must share one code
+space), per-shard liveness masks, and result-table assembly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.column import Column
+from ..core.dtypes import LogicalType, physical_np_dtype
+from ..core.table import Table
+from ..ctx.context import ROW_AXIS, CylonEnv
+from ..status import CylonTypeError, InvalidError
+
+ROW = P(ROW_AXIS)
+REP = P()
+
+#: distinct pad keys per table so padding rows never rank-equal across tables
+PAD_L, PAD_R = 4, 5
+
+
+def live_mask(vc: jax.Array, cap: int) -> jax.Array:
+    """Per-shard row-liveness mask (call inside shard_map): the first
+    ``vc[my_rank]`` rows of the shard are real, the rest padding."""
+    my = jax.lax.axis_index(ROW_AXIS)
+    return jnp.arange(cap) < vc[my]
+
+
+def col_arrays(cols: list[Column]):
+    """Split columns into parallel (datas, valids) tuples; valids entries may
+    be None (all-valid) — None is an empty pytree so it passes through jit."""
+    return tuple(c.data for c in cols), tuple(c.validity for c in cols)
+
+
+def promote_key_pair(a: Column, b: Column) -> tuple[Column, Column]:
+    """Make a cross-table key pair comparable: unify string dictionaries or
+    promote numerics to a common logical type (the reference requires
+    type-equal join keys; we additionally auto-promote numerics)."""
+    if (a.type == LogicalType.STRING) != (b.type == LogicalType.STRING):
+        raise CylonTypeError(f"cannot join {a.type} with {b.type}")
+    if a.type == LogicalType.STRING:
+        return unify_dictionaries(a, b)
+    if a.type == b.type:
+        return a, b
+    common = np.promote_types(physical_np_dtype(a.type), physical_np_dtype(b.type))
+    lt = LogicalType(common.name) if common.name in LogicalType._value2member_map_ \
+        else None
+    if lt is None:
+        raise CylonTypeError(f"no common key type for {a.type}/{b.type}")
+    return a.cast(lt), b.cast(lt)
+
+
+def unify_dictionaries(a: Column, b: Column) -> tuple[Column, Column]:
+    """Re-code two dictionary-encoded string columns into one shared sorted
+    dictionary (codes stay order-isomorphic to the strings, so sorts/joins on
+    codes remain exact)."""
+    if a.dictionary is b.dictionary or (
+            len(a.dictionary) == len(b.dictionary)
+            and np.array_equal(a.dictionary, b.dictionary)):
+        return a, b
+    merged = np.unique(np.concatenate([a.dictionary, b.dictionary]))
+    map_a = jnp.asarray(np.searchsorted(merged, a.dictionary).astype(np.int32))
+    map_b = jnp.asarray(np.searchsorted(merged, b.dictionary).astype(np.int32))
+    ca = Column(map_a[jnp.clip(a.data, 0, len(a.dictionary) - 1)],
+                LogicalType.STRING, a.validity, merged)
+    cb = Column(map_b[jnp.clip(b.data, 0, len(b.dictionary) - 1)],
+                LogicalType.STRING, b.validity, merged)
+    return ca, cb
+
+
+def unify_dictionaries_many(cols: list[Column]) -> list[Column]:
+    """N-way dictionary unification (used by concat / n-way set ops)."""
+    dicts = [c.dictionary for c in cols]
+    if all(d is dicts[0] or np.array_equal(d, dicts[0]) for d in dicts[1:]):
+        return list(cols)
+    merged = np.unique(np.concatenate(dicts))
+    out = []
+    for c in cols:
+        m = jnp.asarray(np.searchsorted(merged, c.dictionary).astype(np.int32))
+        out.append(Column(m[jnp.clip(c.data, 0, len(c.dictionary) - 1)],
+                          LogicalType.STRING, c.validity, merged))
+    return out
+
+
+def build_table(names, out_datas, out_valids, types, dicts,
+                valid_counts: np.ndarray, env: CylonEnv) -> Table:
+    """Assemble an output Table from kernel results (the static-shape analog
+    of the reference's join_utils output builders)."""
+    cols = {}
+    for name, d, v, t, dc in zip(names, out_datas, out_valids, types, dicts):
+        cols[name] = Column(d, t, v, dc)
+    return Table(cols, env, np.asarray(valid_counts, np.int64))
+
+
+def rebuild_like(items, out_datas, out_valids, valid_counts,
+                 env: CylonEnv) -> Table:
+    """build_table with schema (name/type/dictionary) taken from existing
+    (name, Column) pairs — for ops that permute/filter rows of one table."""
+    names = [n for n, _ in items]
+    types = [c.type for _, c in items]
+    dicts = [c.dictionary for _, c in items]
+    return build_table(names, out_datas, out_valids, types, dicts,
+                       valid_counts, env)
+
+
+def check_same_env(a: Table, b: Table) -> CylonEnv:
+    if a.env is not b.env and a.env.mesh is not b.env.mesh:
+        raise InvalidError("tables belong to different CylonEnvs")
+    return a.env

@@ -1,0 +1,302 @@
+"""Table-level groupby-aggregate: local + distributed.
+
+TPU-native equivalent of the reference's groupby engines: the two-phase
+``DistributedHashGroupBy`` (groupby/groupby.cpp:33 — associative ops combine
+locally, shuffle the much smaller per-group intermediates, combine again;
+non-associative ops shuffle raw rows first) and the MapReduce engine's
+six-stage flow (mapreduce/mapreduce.hpp:56-76).  Group identity is a dense
+rank (ops/pack.py) instead of a hash map; aggregations are XLA segment
+reductions (ops/groupby.py).
+
+The intermediate "table" between phases reuses the ordinary shuffle engine —
+intermediates are just columns keyed by the group keys, exactly how the
+reference ships ``MapReduceKernel`` intermediates through ArrowAllToAll.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import config
+from ..core.column import Column
+from ..core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
+from ..core.table import Table
+from ..ops import groupby as gbk
+from ..ops import pack
+from ..status import InvalidError
+from .common import PAD_L, REP, ROW, col_arrays, live_mask
+from .repart import shuffle_table
+
+shard_map = jax.shard_map
+
+_VALID_OPS = gbk.ASSOCIATIVE | gbk.NON_ASSOCIATIVE
+
+#: static intermediate-column order per op (mapreduce.hpp:27 analog: MEAN ->
+#: {sum,count}, VAR/STD -> {sum,sumsq,count})
+INTER_NAMES = {
+    "sum": ("sum",),
+    "count": ("count",),
+    "min": ("count", "min"),
+    "max": ("count", "max"),
+    "mean": ("count", "sum"),
+    "var": ("count", "sum", "sumsq"),
+    "std": ("count", "sum", "sumsq"),
+}
+
+
+def _normalize_aggs(aggs):
+    """aggs: list of (value_col, op) or (value_col, op, q). Returns list of
+    (col, op, q, out_name)."""
+    out, seen = [], set()
+    for a in aggs:
+        col, op = a[0], a[1]
+        q = a[2] if len(a) > 2 else 0.5
+        if op == "median":
+            op, q = "quantile", 0.5
+        if op not in _VALID_OPS:
+            raise InvalidError(f"unknown aggregation {op!r}")
+        name = f"{col}_{a[1]}"
+        if op == "quantile" and a[1] == "quantile" and len(a) > 2:
+            name = f"{col}_quantile_{q:g}"
+        if name in seen:
+            raise InvalidError(f"duplicate aggregation output {name!r}")
+        seen.add(name)
+        out.append((col, op, float(q), name))
+    return out
+
+
+def _group_keys(by_datas, by_valids, vc):
+    """Per-shard dense group ids; padding rows route to trash segment ``cap``
+    and never contribute a group (live rows sort first, so live ranks are a
+    dense prefix 0..n_groups-1)."""
+    cap = by_datas[0].shape[0]
+    mask = live_mask(vc, cap)
+    ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask,
+                           pad_key=PAD_L)
+    gids, _ = pack.dense_rank(ko)
+    n_groups = jnp.max(jnp.where(mask, gids, -1)) + 1
+    gids = jnp.where(mask, gids, cap)
+    return gids, n_groups.astype(jnp.int32), mask
+
+
+def _rep_keys(by_datas, by_valids, gids, seg_cap):
+    """Representative key row per group (first source index)."""
+    rep = gbk.group_first_index(gids, seg_cap)
+    safe = jnp.clip(rep, 0, by_datas[0].shape[0] - 1)
+    key_out = tuple(d[safe] for d in by_datas)
+    kval_out = tuple(v[safe] if v is not None else None for v in by_valids)
+    return key_out, kval_out
+
+
+@lru_cache(maxsize=None)
+def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int):
+    """Phase 1 per shard: dense-rank keys, segment-reduce each (col, op) into
+    intermediate arrays of static length seg_cap (rank-ordered dense prefix),
+    gather per-group key representatives."""
+
+    def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
+        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
+        key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+        inter_out = []
+        for i, op in enumerate(ops):
+            vmask = mask if val_valids[i] is None else (mask & val_valids[i])
+            inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap, vmask)
+            inter_out.append(tuple(inter[k] for k in INTER_NAMES[op]))
+        return key_out, kval_out, tuple(inter_out), n_groups.reshape(1)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW, ROW, ROW),
+                             out_specs=(ROW, ROW, ROW, ROW)))
+
+
+@lru_cache(maxsize=None)
+def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int):
+    """Phase 2 per shard: re-rank shuffled intermediate rows by key,
+    segment-reduce the intermediates, finalize each op."""
+
+    def per_shard(vc, by_datas, by_valids, inter_by_op):
+        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
+        key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+        res_d, res_v = [], []
+        for i, op in enumerate(ops):
+            inter = dict(zip(INTER_NAMES[op], inter_by_op[i]))
+            red = gbk.reduce_intermediates(inter, gids, seg_cap, mask)
+            d, v = gbk.finalize(op, red, ddof)
+            res_d.append(d)
+            res_v.append(v)
+        return key_out, kval_out, tuple(res_d), tuple(res_v), n_groups.reshape(1)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW, ROW),
+                             out_specs=(ROW, ROW, ROW, ROW, ROW)))
+
+
+@lru_cache(maxsize=None)
+def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int):
+    """Single-phase per shard over raw (already co-located) rows — used for
+    non-associative ops and the local path.  specs: ((op, q), ...)."""
+
+    def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
+        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
+        key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+        res_d, res_v = [], []
+        for i, (op, q) in enumerate(specs):
+            vmask = mask if val_valids[i] is None else (mask & val_valids[i])
+            if op in gbk.ASSOCIATIVE:
+                inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap,
+                                            vmask)
+                d, v = gbk.finalize(op, inter, ddof)
+            elif op == "nunique":
+                ko = pack.key_operands([val_datas[i]], [val_valids[i]])
+                d = gbk.nunique(ko, gids, seg_cap, vmask)
+                v = None
+            else:  # quantile
+                d, v = gbk.quantile(val_datas[i], gids, seg_cap, q, vmask)
+            res_d.append(d)
+            res_v.append(v)
+        return key_out, kval_out, tuple(res_d), tuple(res_v), n_groups.reshape(1)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW, ROW, ROW),
+                             out_specs=(ROW, ROW, ROW, ROW, ROW)))
+
+
+@lru_cache(maxsize=None)
+def _shrink_fn(mesh: Mesh, new_cap: int):
+    def per_shard(d):
+        return d[:new_cap]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+                             out_specs=ROW))
+
+
+def _shrink(table: Table, n_rows: np.ndarray) -> Table:
+    """Slice each shard's dense row prefix down to a pow2 cap (cuts the cost
+    of downstream shuffles/sorts on oversized intermediate tables)."""
+    cap = table.capacity
+    new_cap = config.pow2ceil(int(n_rows.max()) if n_rows.size else 1)
+    if new_cap >= cap:
+        return table
+    fn = _shrink_fn(table.env.mesh, new_cap)
+    cols = {}
+    for n, c in table.columns.items():
+        d = fn(c.data)
+        v = fn(c.validity) if c.validity is not None else None
+        cols[n] = Column(d, c.type, v, c.dictionary)
+    return Table(cols, table.env, n_rows)
+
+
+def _result_types(specs, val_cols):
+    """Logical type + dictionary of each aggregation result column."""
+    types, dicts = [], []
+    for (c, op, _, _), col in zip(specs, val_cols):
+        if op in ("count", "nunique"):
+            types.append(LogicalType.INT64)
+            dicts.append(None)
+        elif col.type == LogicalType.STRING:  # min/max of strings = codes
+            types.append(LogicalType.STRING)
+            dicts.append(col.dictionary)
+        else:
+            src = physical_np_dtype(col.type)
+            types.append(from_numpy_dtype(gbk.np_result_dtype(op, src)))
+            dicts.append(None)
+    return types, dicts
+
+
+def _result_table(env, by_names, by_cols, key_out, kval_out, res_names,
+                  res_d, res_v, res_types, res_dicts, n_groups) -> Table:
+    cols = {}
+    for n, c, d, v in zip(by_names, by_cols, key_out, kval_out):
+        cols[n] = Column(d, c.type, v, c.dictionary)
+    for n, d, v, t, dc in zip(res_names, res_d, res_v, res_types, res_dicts):
+        phys = physical_np_dtype(t)
+        if d.dtype != phys:  # f64 accumulators -> declared result dtype
+            d = d.astype(phys)
+        cols[n] = Column(d, t, v, dc)
+    return Table(cols, env, np.asarray(n_groups, np.int64))
+
+
+def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
+    """Group ``table`` by key columns ``by`` and aggregate.
+
+    aggs: list of (value_col, op[, q]) with op in sum/count/min/max/mean/var/
+    std/nunique/quantile/median.  Returns key columns + one column per agg
+    named ``{col}_{op}``.  Null keys form their own group (reference
+    semantics: comparators treat nulls as equal).
+    """
+    env = table.env
+    by = [by] if isinstance(by, str) else list(by)
+    specs = _normalize_aggs(aggs)
+    by_cols = [table.column(n) for n in by]
+    val_cols = [table.column(c) for c, _, _, _ in specs]
+    for (c, op, _, _), col in zip(specs, val_cols):
+        if col.type == LogicalType.STRING and op not in ("count", "nunique",
+                                                         "min", "max"):
+            raise InvalidError(f"agg {op!r} not valid for string column {c!r}")
+    res_types, res_dicts = _result_types(specs, val_cols)
+    res_names = [n for _, _, _, n in specs]
+    all_assoc = all(op in gbk.ASSOCIATIVE for _, op, _, _ in specs)
+    distributed = env.world_size > 1
+
+    if distributed and all_assoc:
+        # phase 1: local pre-combine (reference groupby.cpp:76-81)
+        by_datas, by_valids = col_arrays(by_cols)
+        val_datas = tuple(c.data for c in val_cols)
+        val_valids = tuple(c.validity for c in val_cols)
+        vc = jnp.asarray(table.valid_counts, jnp.int32)
+        ops_t = tuple(op for _, op, _, _ in specs)
+        seg_cap = max(table.capacity, 1)
+        key_out, kval_out, inter_out, n_groups = _combine_fn(
+            env.mesh, ops_t, seg_cap)(vc, by_datas, by_valids, val_datas,
+                                      val_valids)
+        n_groups = np.asarray(n_groups, np.int64)
+        # intermediate table: keys + flat intermediate columns
+        cols = {}
+        for n, c, d, v in zip(by, by_cols, key_out, kval_out):
+            cols[n] = Column(d, c.type, v, c.dictionary)
+        inames_by_op = []
+        for i, (_, op, _, _) in enumerate(specs):
+            inames = []
+            for iname, arr in zip(INTER_NAMES[op], inter_out[i]):
+                cn = f"__i{i}_{iname}"
+                cols[cn] = Column(arr, from_numpy_dtype(np.dtype(arr.dtype)),
+                                  None, None)
+                inames.append(cn)
+            inames_by_op.append(inames)
+        inter_table = _shrink(Table(cols, env, n_groups), n_groups)
+        # phase 2: shuffle intermediates by key hash, final combine
+        shuffled = shuffle_table(inter_table, by)
+        s_by_datas, s_by_valids = col_arrays([shuffled.column(n) for n in by])
+        inter_by_op = tuple(
+            tuple(shuffled.column(cn).data for cn in inames)
+            for inames in inames_by_op)
+        vc2 = jnp.asarray(shuffled.valid_counts, jnp.int32)
+        key2, kval2, res_d, res_v, ng2 = _final_fn(
+            env.mesh, ops_t, max(shuffled.capacity, 1), ddof)(
+                vc2, s_by_datas, s_by_valids, inter_by_op)
+        ng2 = np.asarray(ng2, np.int64)
+        out = _result_table(env, by, by_cols, key2, kval2, res_names, res_d,
+                            res_v, res_types, res_dicts, ng2)
+        return _shrink(out, ng2)
+
+    # non-associative ops (or local): co-locate raw rows first
+    work = table.project(list(dict.fromkeys(by + [c for c, _, _, _ in specs])))
+    if distributed:
+        work = shuffle_table(work, by)
+    by_datas, by_valids = col_arrays([work.column(n) for n in by])
+    val_datas = tuple(work.column(c).data for c, _, _, _ in specs)
+    val_valids = tuple(work.column(c).validity for c, _, _, _ in specs)
+    vc = jnp.asarray(work.valid_counts, jnp.int32)
+    spec_t = tuple((op, q) for _, op, q, _ in specs)
+    key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
+        env.mesh, spec_t, max(work.capacity, 1), ddof)(
+            vc, by_datas, by_valids, val_datas, val_valids)
+    n_groups = np.asarray(n_groups, np.int64)
+    out = _result_table(env, by, by_cols, key_out, kval_out, res_names, res_d,
+                        res_v, res_types, res_dicts, n_groups)
+    return _shrink(out, n_groups)

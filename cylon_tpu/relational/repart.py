@@ -1,0 +1,315 @@
+"""Shuffle / repartition / slice / head / tail / concat.
+
+TPU-native equivalents of the reference's redistribution operators:
+``Shuffle`` (table.cpp:1298), ``Repartition`` (table.cpp:1481 — allgather row
+counts -> compute send ranges -> order-preserving all-to-all, index math in
+repartition.hpp:32-129), ``Slice``/``DistributedSlice`` (indexing/slice.cpp:31)
+and ``DistributedHead/Tail`` (table.hpp:512-527), ``Merge``/concat.
+
+Order preservation falls out of the exchange engine's (source rank, source
+position) receive order (parallel/shuffle.py) exactly as in the reference's
+``all_to_all_arrow_tables_preserve_order`` (table.cpp:182-190): each source
+sends every destination a contiguous global range, so rank-major receive
+order reconstructs global order.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import config
+from ..core.column import Column
+from ..core.table import Table
+from ..ctx.context import CylonEnv
+from ..ops import sort as sortk
+from ..parallel import shuffle
+from ..status import InvalidError
+from .common import ROW, REP, build_table, col_arrays, live_mask, \
+    unify_dictionaries_many
+
+shard_map = jax.shard_map
+
+
+# ---------------------------------------------------------------------------
+# column flattening for the exchange engine
+# ---------------------------------------------------------------------------
+
+def _flatten_for_exchange(table: Table):
+    """Table columns -> flat tuple of device arrays (data then validity for
+    nullable cols) + a rebuild recipe."""
+    flat, recipe = [], []
+    for name, c in table.columns.items():
+        di = len(flat)
+        flat.append(c.data)
+        vi = -1
+        if c.validity is not None:
+            vi = len(flat)
+            flat.append(c.validity)
+        recipe.append((name, di, vi, c.type, c.dictionary))
+    return tuple(flat), recipe
+
+
+def _rebuild(recipe, new_flat, valid_counts, env: CylonEnv) -> Table:
+    cols = {}
+    for name, di, vi, t, dc in recipe:
+        v = new_flat[vi] if vi >= 0 else None
+        cols[name] = Column(new_flat[di], t, v, dc)
+    return Table(cols, env, np.asarray(valid_counts, np.int64))
+
+
+# ---------------------------------------------------------------------------
+# hash shuffle (reference Shuffle, table.cpp:1298)
+# ---------------------------------------------------------------------------
+
+def shuffle_table(table: Table, key_names) -> Table:
+    """Redistribute rows so equal keys land on the same shard (hash
+    partitioning, reference MapToHashPartitions + ArrowAllToAll)."""
+    env = table.env
+    if env.world_size == 1:
+        return table
+    keys = [table.column(n) for n in key_names]
+    datas, valids = col_arrays(keys)
+    tgt = shuffle.hash_targets(env.mesh, datas, valids, table.valid_counts)
+    counts = shuffle.count_targets(env.mesh, tgt)
+    flat, recipe = _flatten_for_exchange(table)
+    new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat)
+    return _rebuild(recipe, new_flat, new_valid, env)
+
+
+def exchange_by_targets(table: Table, tgt, counts: np.ndarray) -> Table:
+    """Exchange with caller-computed per-row targets (range partition etc.)."""
+    flat, recipe = _flatten_for_exchange(table)
+    new_flat, new_valid = shuffle.exchange(table.env.mesh, tgt, counts, flat)
+    return _rebuild(recipe, new_flat, new_valid, table.env)
+
+
+# ---------------------------------------------------------------------------
+# repartition (reference table.cpp:1481, repartition.hpp:94 index math)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _range_targets_fn(mesh: Mesh, cap: int):
+    def per_shard(vc, offs, bounds, _probe):
+        w = vc.shape[0]
+        my = jax.lax.axis_index(shuffle.ROW_AXIS)
+        mask = jnp.arange(cap) < vc[my]
+        gpos = offs[my] + jnp.arange(cap, dtype=jnp.int64)
+        # bounds[d] = last global row index destined to d; first d with
+        # bounds[d] >= gpos owns the row (empty destinations skip naturally)
+        t = jnp.searchsorted(bounds, gpos, side="left").astype(jnp.int32)
+        t = jnp.clip(t, 0, w - 1)
+        return jnp.where(mask, t, jnp.int32(w))
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, REP, REP, ROW), out_specs=ROW))
+
+
+def _order_preserving_targets(table: Table, dest_counts: np.ndarray):
+    """Per-row destination ranks assigning global row i to the destination
+    whose cumulative range contains i (reference DivideRowsEvenly /
+    RowIndicesToAll, repartition.hpp:32-129)."""
+    env = table.env
+    vc = table.valid_counts
+    offs = np.concatenate([[0], np.cumsum(vc)[:-1]]).astype(np.int64)
+    bounds = np.cumsum(dest_counts).astype(np.int64) - 1
+    probe = next(iter(table.columns.values())).data
+    fn = _range_targets_fn(env.mesh, table.capacity)
+    return fn(jnp.asarray(vc, jnp.int32), jnp.asarray(offs),
+              jnp.asarray(bounds), probe)
+
+
+def repartition(table: Table, rows_per_partition=None) -> Table:
+    """Redistribute preserving global row order; default = even split."""
+    env = table.env
+    w = env.world_size
+    total = table.row_count
+    if rows_per_partition is None:
+        base = total // w
+        extra = total - base * w
+        dest = np.asarray([base + (1 if i < extra else 0) for i in range(w)],
+                          np.int64)
+    else:
+        dest = np.asarray(rows_per_partition, np.int64)
+        if dest.shape != (w,) or dest.sum() != total:
+            raise InvalidError(
+                f"rows_per_partition must hold {w} counts summing to {total}")
+    if w == 1 or not table.column_count:
+        return table
+    if np.array_equal(dest, table.valid_counts):
+        return table
+    tgt = _order_preserving_targets(table, dest)
+    # count matrix is fully determined host-side: source s's global range
+    # [offs, offs+vc) intersected with each destination range
+    soff = np.concatenate([[0], np.cumsum(table.valid_counts)[:-1]])
+    dof = np.concatenate([[0], np.cumsum(dest)[:-1]])
+    counts = np.zeros((w, w), np.int64)
+    for s in range(w):
+        lo, hi = soff[s], soff[s] + table.valid_counts[s]
+        for d in range(w):
+            counts[s, d] = max(0, min(hi, dof[d] + dest[d]) - max(lo, dof[d]))
+    return exchange_by_targets(table, tgt, counts)
+
+
+@lru_cache(maxsize=None)
+def _repad_fn(mesh: Mesh, cap: int, new_cap: int):
+    def per_shard(d):
+        if new_cap <= cap:
+            return d[:new_cap]
+        pad = jnp.zeros((new_cap - cap,) + d.shape[1:], d.dtype)
+        return jnp.concatenate([d, pad])
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+                             out_specs=ROW))
+
+
+def repad_table(table: Table, new_cap: int) -> Table:
+    """Change per-shard capacity without moving rows (valid prefixes must fit
+    the new capacity)."""
+    cap = table.capacity
+    if new_cap == cap:
+        return table
+    if int(table.valid_counts.max(initial=0)) > new_cap:
+        raise InvalidError(f"valid rows exceed new capacity {new_cap}")
+    fn = _repad_fn(table.env.mesh, cap, new_cap)
+    cols = {}
+    for n, c in table.columns.items():
+        d = fn(c.data)
+        v = fn(c.validity) if c.validity is not None else None
+        cols[n] = Column(d, c.type, v, c.dictionary)
+    return Table(cols, table.env, table.valid_counts)
+
+
+# ---------------------------------------------------------------------------
+# slice / head / tail (reference indexing/slice.cpp:31, table.hpp:512-527)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, ncols: int):
+    def per_shard(vc, offs, lo, hi, datas, valids):
+        my = jax.lax.axis_index(shuffle.ROW_AXIS)
+        mask = jnp.arange(cap) < vc[my]
+        gpos = offs[my] + jnp.arange(cap, dtype=jnp.int64)
+        keep = mask & (gpos >= lo) & (gpos < hi)
+        idx, _total = sortk.compact_by_flag(keep, out_cap)
+        safe = jnp.clip(idx, 0, max(cap - 1, 0))
+        out_d = tuple(d[safe] for d in datas)
+        out_v = tuple(v[safe] if v is not None else None for v in valids)
+        return out_d, out_v
+
+    return jax.jit(shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(REP, REP, REP, REP, ROW, ROW), out_specs=(ROW, ROW)))
+
+
+def slice_table(table: Table, offset: int, length: int) -> Table:
+    """Global-order row range [offset, offset+length) (distribution-preserving
+    like the reference's DistributedSlice — each rank keeps its overlap)."""
+    env = table.env
+    vc = table.valid_counts
+    offs = np.concatenate([[0], np.cumsum(vc)[:-1]]).astype(np.int64)
+    lo, hi = int(offset), int(offset) + int(length)
+    kept = np.clip(np.minimum(offs + vc, hi) - np.maximum(offs, lo), 0, None)
+    out_cap = config.pow2ceil(int(kept.max()) if kept.size else 1)
+    cols = list(table.columns.items())
+    datas = tuple(c.data for _, c in cols)
+    valids = tuple(c.validity for _, c in cols)
+    fn = _compact_range_fn(env.mesh, table.capacity, out_cap, len(cols))
+    out_d, out_v = fn(jnp.asarray(vc, jnp.int32), jnp.asarray(offs),
+                      jnp.asarray(lo), jnp.asarray(hi), datas, valids)
+    names = [n for n, _ in cols]
+    types = [c.type for _, c in cols]
+    dicts = [c.dictionary for _, c in cols]
+    return build_table(names, out_d, out_v, types, dicts, kept, env)
+
+
+def head(table: Table, n: int) -> Table:
+    return slice_table(table, 0, n)
+
+
+def tail(table: Table, n: int) -> Table:
+    total = table.row_count
+    n = min(n, total)
+    return slice_table(table, total - n, n)
+
+
+# ---------------------------------------------------------------------------
+# concat (reference Merge/concat, frame.py:2295)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _concat_fn(mesh: Mesh, caps: tuple, out_cap: int, with_valid: tuple):
+    k = len(caps)
+
+    def per_shard(vcs, datas_by_t, valids_by_t):
+        my = jax.lax.axis_index(shuffle.ROW_AXIS)
+        off = jnp.zeros((), jnp.int32)
+        ncols = len(datas_by_t[0])
+        outs = [jnp.zeros((out_cap,), datas_by_t[0][c].dtype)
+                for c in range(ncols)]
+        outv = [jnp.zeros((out_cap,), bool) if with_valid[c] else None
+                for c in range(ncols)]
+        for t in range(k):
+            cap_t = caps[t]
+            mask = jnp.arange(cap_t) < vcs[t][my]
+            pos = jnp.where(mask, off + jnp.arange(cap_t, dtype=jnp.int32),
+                            jnp.int32(out_cap))
+            for c in range(ncols):
+                outs[c] = outs[c].at[pos].set(datas_by_t[t][c], mode="drop")
+                if with_valid[c]:
+                    v = valids_by_t[t][c]
+                    v = v if v is not None else jnp.ones(cap_t, bool)
+                    outv[c] = outv[c].at[pos].set(v, mode="drop")
+            off = off + vcs[t][my]
+        return tuple(outs), tuple(outv)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
+
+
+def concat_tables(tables: list[Table]) -> Table:
+    """Row-wise concatenation. Per-shard append order follows input order
+    (the reference's per-rank local Merge has the same per-partition
+    semantics)."""
+    if not tables:
+        raise InvalidError("concat of zero tables")
+    if len(tables) == 1:
+        return tables[0]
+    env = tables[0].env
+    names = tables[0].column_names
+    for t in tables[1:]:
+        if t.column_names != names:
+            raise InvalidError(f"concat schema mismatch: {t.column_names} vs {names}")
+    # unify string dictionaries / promote numerics column-wise
+    from ..core.dtypes import LogicalType
+    from .common import promote_key_pair
+    col_sets = []
+    for n in names:
+        cs = [t.column(n) for t in tables]
+        if cs[0].type == LogicalType.STRING:
+            cs = unify_dictionaries_many(cs)
+        else:
+            for i in range(1, len(cs)):
+                cs[0], cs[i] = promote_key_pair(cs[0], cs[i])
+        col_sets.append(cs)
+    w = env.world_size
+    vcs = [t.valid_counts for t in tables]
+    new_valid = np.sum(vcs, axis=0)
+    out_cap = config.pow2ceil(int(new_valid.max()) if w else 1)
+    caps = tuple(t.capacity for t in tables)
+    with_valid = tuple(any(cs[i].validity is not None for i in range(len(tables)))
+                       for cs in col_sets)
+    datas_by_t = tuple(tuple(col_sets[c][t].data for c in range(len(names)))
+                       for t in range(len(tables)))
+    valids_by_t = tuple(tuple(col_sets[c][t].validity for c in range(len(names)))
+                        for t in range(len(tables)))
+    fn = _concat_fn(env.mesh, caps, out_cap, with_valid)
+    vcs_dev = tuple(jnp.asarray(v, jnp.int32) for v in vcs)
+    out_d, out_v = fn(vcs_dev, datas_by_t, valids_by_t)
+    types = [cs[0].type for cs in col_sets]
+    dicts = [cs[0].dictionary for cs in col_sets]
+    return build_table(names, out_d, out_v, types, dicts, new_valid, env)

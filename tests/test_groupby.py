@@ -1,0 +1,106 @@
+"""Groupby-aggregate tests against the pandas oracle.
+
+Reference analog: cpp/test/groupby_test.cpp, aggregate_test.cpp,
+python test_dist_aggregate.py.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.relational import groupby_aggregate
+
+from utils import assert_frames_equal
+
+
+def df(rng, n=200, nk=15):
+    return pd.DataFrame({
+        "k": rng.integers(0, nk, n),
+        "k2": rng.choice(["x", "y", "z"], n),
+        "v": rng.random(n),
+        "w": rng.integers(-50, 50, n),
+    })
+
+
+@pytest.mark.parametrize("envname", ["env1", "env4", "env8"])
+@pytest.mark.parametrize("op", ["sum", "count", "min", "max", "mean", "var",
+                                "std"])
+def test_associative_ops(request, rng, envname, op):
+    env = request.getfixturevalue(envname)
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env)
+    got = groupby_aggregate(t, "k", [("v", op), ("w", op)]).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(
+        **{f"v_{op}": ("v", op), f"w_{op}": ("w", op)})
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+@pytest.mark.parametrize("envname", ["env1", "env8"])
+def test_multi_key_groupby(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env)
+    got = groupby_aggregate(t, ["k", "k2"], [("v", "sum")]).to_pandas()
+    exp = data.groupby(["k", "k2"], as_index=False).agg(v_sum=("v", "sum"))
+    assert_frames_equal(got, exp, sort_by=["k", "k2"])
+
+
+@pytest.mark.parametrize("envname", ["env1", "env8"])
+def test_nunique(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env)
+    got = groupby_aggregate(t, "k", [("w", "nunique")]).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(w_nunique=("w", "nunique"))
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+@pytest.mark.parametrize("envname", ["env1", "env8"])
+def test_median_quantile(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env)
+    got = groupby_aggregate(t, "k", [("v", "median")]).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(v_median=("v", "median"))
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+def test_string_key_groupby(env8, rng):
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env8)
+    got = groupby_aggregate(t, "k2", [("v", "sum"), ("v", "count")]).to_pandas()
+    exp = data.groupby("k2", as_index=False).agg(v_sum=("v", "sum"),
+                                                 v_count=("v", "count"))
+    assert_frames_equal(got, exp, sort_by=["k2"])
+
+
+def test_groupby_null_values(env4):
+    data = pd.DataFrame({
+        "k": [1, 1, 2, 2, 3, 3, 3, 1],
+        "s": ["a", None, "b", None, None, "c", "c", "a"],
+    })
+    t = ct.Table.from_pandas(data, env4)
+    got = groupby_aggregate(t, "k", [("s", "count"), ("s", "nunique")]
+                            ).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(s_count=("s", "count"),
+                                                s_nunique=("s", "nunique"))
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+def test_mixed_assoc_nonassoc(env8, rng):
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env8)
+    got = groupby_aggregate(t, "k", [("v", "sum"), ("w", "nunique")]
+                            ).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(v_sum=("v", "sum"),
+                                                w_nunique=("w", "nunique"))
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+def test_single_group(env8, rng):
+    data = pd.DataFrame({"k": np.ones(64, np.int64), "v": rng.random(64)})
+    t = ct.Table.from_pandas(data, env8)
+    got = groupby_aggregate(t, "k", [("v", "sum")]).to_pandas()
+    exp = data.groupby("k", as_index=False).agg(v_sum=("v", "sum"))
+    assert_frames_equal(got, exp, sort_by=["k"])

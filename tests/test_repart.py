@@ -1,0 +1,113 @@
+"""Shuffle / repartition / slice / head / tail / concat tests
+(reference cpp/test/repartition_test.cpp, slice_test.cpp analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.relational import (concat_tables, head, repartition,
+                                  shuffle_table, slice_table, tail)
+
+from utils import assert_frames_equal
+
+
+def df(rng, n=120):
+    return pd.DataFrame({"k": rng.integers(0, 20, n), "v": np.arange(n)})
+
+
+@pytest.mark.parametrize("envname", ["env4", "env8"])
+def test_shuffle_preserves_rows(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env)
+    s = shuffle_table(t, ["k"])
+    assert s.row_count == len(data)
+    assert_frames_equal(s.to_pandas(), data, sort_by=["v"])
+
+
+def test_shuffle_colocates_keys(env8, rng):
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env8)
+    s = shuffle_table(t, ["k"])
+    # each key must appear on exactly one shard
+    w = env8.world_size
+    cap = s.capacity
+    kcol = np.asarray(s.column("k").data)
+    owners = {}
+    for i in range(w):
+        ks = set(kcol[i * cap: i * cap + int(s.valid_counts[i])].tolist())
+        for k in ks:
+            assert k not in owners, f"key {k} on shards {owners[k]} and {i}"
+            owners[k] = i
+
+
+@pytest.mark.parametrize("envname", ["env4", "env8"])
+def test_repartition_even(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    data = df(rng, 100)
+    t = ct.Table.from_pandas(data, env)
+    # skew it first via a slice, then rebalance
+    s = slice_table(t, 10, 77)
+    r = repartition(s)
+    w = env.world_size
+    base = 77 // w
+    assert all(c in (base, base + 1) for c in r.valid_counts)
+    # global order preserved
+    pd.testing.assert_frame_equal(
+        r.to_pandas().reset_index(drop=True),
+        data.iloc[10:87].reset_index(drop=True), check_dtype=False)
+
+
+def test_repartition_specified(env4, rng):
+    data = df(rng, 40)
+    t = ct.Table.from_pandas(data, env4)
+    r = repartition(t, (1, 2, 3, 34))
+    assert r.valid_counts.tolist() == [1, 2, 3, 34]
+    pd.testing.assert_frame_equal(r.to_pandas().reset_index(drop=True), data,
+                                  check_dtype=False)
+
+
+@pytest.mark.parametrize("off,length", [(0, 10), (5, 50), (95, 25), (0, 120),
+                                        (119, 1)])
+def test_slice(env8, rng, off, length):
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env8)
+    s = slice_table(t, off, length)
+    exp = data.iloc[off:off + length].reset_index(drop=True)
+    pd.testing.assert_frame_equal(s.to_pandas().reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+def test_head_tail(env8, rng):
+    data = df(rng)
+    t = ct.Table.from_pandas(data, env8)
+    pd.testing.assert_frame_equal(head(t, 7).to_pandas(),
+                                  data.head(7).reset_index(drop=True),
+                                  check_dtype=False)
+    pd.testing.assert_frame_equal(tail(t, 7).to_pandas(),
+                                  data.tail(7).reset_index(drop=True),
+                                  check_dtype=False)
+
+
+@pytest.mark.parametrize("envname", ["env1", "env8"])
+def test_concat(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    a = df(rng, 50)
+    b = df(rng, 30)
+    ta = ct.Table.from_pandas(a, env)
+    tb = ct.Table.from_pandas(b, env)
+    got = concat_tables([ta, tb])
+    assert got.row_count == 80
+    assert_frames_equal(got.to_pandas(), pd.concat([a, b], ignore_index=True),
+                        sort_by=["v", "k"])
+
+
+def test_concat_strings_and_nulls(env4):
+    a = pd.DataFrame({"s": ["a", "b", None, "c"]})
+    b = pd.DataFrame({"s": ["x", None]})
+    ta = ct.Table.from_pandas(a, env4)
+    tb = ct.Table.from_pandas(b, env4)
+    got = concat_tables([ta, tb]).to_pandas()
+    assert sorted([x for x in got["s"] if pd.notna(x)]) == ["a", "b", "c", "x"]
+    assert int(got["s"].isna().sum()) == 2

@@ -1,0 +1,108 @@
+"""Set ops / unique / equals tests (reference cpp/test/set_op_test.cpp,
+equal_test.cpp, python test_dist_rl.py analogs)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.relational import equals, set_operation, unique_table
+
+from utils import assert_frames_equal
+
+
+def two(rng, na=60, nb=40, hi=25):
+    a = pd.DataFrame({"k": rng.integers(0, hi, na),
+                      "g": rng.integers(0, 3, na)})
+    b = pd.DataFrame({"k": rng.integers(0, hi, nb),
+                      "g": rng.integers(0, 3, nb)})
+    return a, b
+
+
+def pd_union(a, b):
+    return pd.concat([a, b]).drop_duplicates().reset_index(drop=True)
+
+
+def pd_intersect(a, b):
+    ad = a.drop_duplicates()
+    return ad.merge(b.drop_duplicates(), on=list(a.columns))
+
+
+def pd_subtract(a, b):
+    ad = a.drop_duplicates()
+    m = ad.merge(b.drop_duplicates(), on=list(a.columns), how="left",
+                 indicator=True)
+    return m[m["_merge"] == "left_only"].drop(columns="_merge")
+
+
+@pytest.mark.parametrize("envname", ["env1", "env4", "env8"])
+@pytest.mark.parametrize("op,oracle", [("union", pd_union),
+                                       ("intersect", pd_intersect),
+                                       ("subtract", pd_subtract)])
+def test_set_ops(request, rng, envname, op, oracle):
+    env = request.getfixturevalue(envname)
+    a, b = two(rng)
+    ta = ct.Table.from_pandas(a, env)
+    tb = ct.Table.from_pandas(b, env)
+    got = set_operation(ta, tb, op).to_pandas()
+    exp = oracle(a, b)
+    assert_frames_equal(got, exp.reset_index(drop=True), sort_by=["k", "g"])
+
+
+def test_set_ops_strings(env8, rng):
+    a = pd.DataFrame({"s": rng.choice(["a", "b", "c", "d"], 40)})
+    b = pd.DataFrame({"s": rng.choice(["c", "d", "e"], 30)})
+    ta = ct.Table.from_pandas(a, env8)
+    tb = ct.Table.from_pandas(b, env8)
+    got = set_operation(ta, tb, "intersect").to_pandas()
+    exp = pd_intersect(a, b)
+    assert_frames_equal(got, exp.reset_index(drop=True), sort_by=["s"])
+
+
+@pytest.mark.parametrize("envname", ["env1", "env8"])
+@pytest.mark.parametrize("keep", ["first", "last"])
+def test_unique(request, rng, envname, keep):
+    env = request.getfixturevalue(envname)
+    df = pd.DataFrame({"k": rng.integers(0, 10, 80), "v": np.arange(80)})
+    t = ct.Table.from_pandas(df, env)
+    got = unique_table(t, subset=["k"], keep=keep).to_pandas()
+    exp = df.drop_duplicates(subset=["k"], keep=keep)
+    assert_frames_equal(got, exp.reset_index(drop=True), sort_by=["k"])
+
+
+def test_unique_all_columns(env8, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 5, 60),
+                       "g": rng.integers(0, 2, 60)})
+    t = ct.Table.from_pandas(df, env8)
+    got = unique_table(t).to_pandas()
+    exp = df.drop_duplicates()
+    assert_frames_equal(got, exp.reset_index(drop=True), sort_by=["k", "g"])
+
+
+@pytest.mark.parametrize("envname", ["env1", "env4", "env8"])
+def test_equals(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    df = pd.DataFrame({"k": rng.integers(0, 10, 50), "v": rng.random(50)})
+    t1 = ct.Table.from_pandas(df, env)
+    t2 = ct.Table.from_pandas(df.copy(), env)
+    assert equals(t1, t2)
+    df3 = df.copy()
+    df3.loc[17, "v"] = -1.0
+    t3 = ct.Table.from_pandas(df3, env)
+    assert not equals(t1, t3)
+
+
+def test_equals_unordered(env4, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 10, 50), "v": rng.random(50)})
+    shuffled = df.sample(frac=1.0, random_state=1).reset_index(drop=True)
+    t1 = ct.Table.from_pandas(df, env4)
+    t2 = ct.Table.from_pandas(shuffled, env4)
+    assert not equals(t1, t2, ordered=True)
+    assert equals(t1, t2, ordered=False)
+
+
+def test_equals_nan(env4):
+    df = pd.DataFrame({"f": [1.0, np.nan, 3.0, np.nan]})
+    t1 = ct.Table.from_pandas(df, env4)
+    t2 = ct.Table.from_pandas(df.copy(), env4)
+    assert equals(t1, t2)

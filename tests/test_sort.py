@@ -1,0 +1,97 @@
+"""Distributed sort tests (reference cpp/test/dist_sort_test.cpp analog:
+numeric types, empty tables, splitter edge cases, multi-key, desc order)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.relational import sort_table
+
+
+@pytest.mark.parametrize("envname", ["env1", "env4", "env8"])
+@pytest.mark.parametrize("dtype", [np.int64, np.int32, np.float64, np.float32,
+                                   np.uint32])
+def test_sort_numeric(request, rng, envname, dtype):
+    env = request.getfixturevalue(envname)
+    data = pd.DataFrame({
+        "k": rng.integers(0, 1000, 300).astype(dtype)
+        if np.issubdtype(dtype, np.integer) else
+        (rng.random(300) * 100).astype(dtype),
+        "v": np.arange(300),
+    })
+    t = ct.Table.from_pandas(data, env)
+    got = sort_table(t, "k").to_pandas()
+    exp = data.sort_values("k", kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+@pytest.mark.parametrize("ascending", [True, False, [True, False]])
+def test_sort_multi_key(env8, rng, ascending):
+    data = pd.DataFrame({
+        "a": rng.integers(0, 10, 200),
+        "b": rng.random(200),
+    })
+    t = ct.Table.from_pandas(data, env8)
+    got = sort_table(t, ["a", "b"], ascending=ascending).to_pandas()
+    exp = data.sort_values(["a", "b"], ascending=ascending,
+                           kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+def test_sort_strings(env8, rng):
+    words = ["kiwi", "fig", "apple", "mango", "pear", "plum", "lime"]
+    data = pd.DataFrame({"s": rng.choice(words, 150), "v": np.arange(150)})
+    t = ct.Table.from_pandas(data, env8)
+    got = sort_table(t, "s").to_pandas()
+    exp = data.sort_values("s", kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+@pytest.mark.parametrize("nulls_position", ["first", "last"])
+def test_sort_nulls(env4, nulls_position):
+    data = pd.DataFrame({"s": ["b", None, "a", None, "c", "a"],
+                         "v": [1, 2, 3, 4, 5, 6]})
+    t = ct.Table.from_pandas(data, env4)
+    got = sort_table(t, "s", nulls_position=nulls_position).to_pandas()
+    exp = data.sort_values("s", na_position=nulls_position,
+                           kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+def test_sort_nans(env4):
+    data = pd.DataFrame({"f": [3.5, np.nan, -1.0, np.nan, 0.0, 99.9, -0.0]})
+    t = ct.Table.from_pandas(data, env4)
+    got = sort_table(t, "f").to_pandas()
+    exp = data.sort_values("f", kind="stable").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+def test_sort_empty(env4):
+    data = pd.DataFrame({"k": np.array([], np.int64)})
+    t = ct.Table.from_pandas(data, env4)
+    got = sort_table(t, "k")
+    assert got.row_count == 0
+
+
+def test_sort_all_equal_keys(env8):
+    # degenerate splitters: every sample identical
+    data = pd.DataFrame({"k": np.full(100, 7), "v": np.arange(100)})
+    t = ct.Table.from_pandas(data, env8)
+    got = sort_table(t, "k").to_pandas()
+    assert got["k"].tolist() == [7] * 100
+    assert sorted(got["v"].tolist()) == list(range(100))
+
+
+def test_sort_skewed(env8, rng):
+    vals = np.where(rng.random(400) < 0.7, 5, rng.integers(0, 1000, 400))
+    data = pd.DataFrame({"k": vals, "v": np.arange(400)})
+    t = ct.Table.from_pandas(data, env8)
+    got = sort_table(t, "k").to_pandas()
+    assert got["k"].is_monotonic_increasing
+    assert sorted(got["v"].tolist()) == list(range(400))

@@ -1,0 +1,76 @@
+"""Table/Column construction + materialization roundtrips.
+
+Reference analog: cpp/test/create_table_test.cpp, table_op_test.cpp.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+
+def make_df(rng, n=100):
+    return pd.DataFrame({
+        "i64": rng.integers(-1000, 1000, n),
+        "i32": rng.integers(0, 100, n).astype(np.int32),
+        "f64": rng.random(n),
+        "f32": rng.random(n).astype(np.float32),
+        "b": rng.integers(0, 2, n).astype(bool),
+        "s": rng.choice(["apple", "banana", "cherry", "date"], n),
+    })
+
+
+@pytest.mark.parametrize("envname", ["env1", "env4", "env8"])
+def test_roundtrip(request, rng, envname):
+    env = request.getfixturevalue(envname)
+    df = make_df(rng)
+    t = ct.Table.from_pandas(df, env)
+    assert t.row_count == len(df)
+    assert t.column_names == list(df.columns)
+    back = t.to_pandas()
+    pd.testing.assert_frame_equal(back, df, check_dtype=False)
+
+
+def test_roundtrip_with_nulls(env8):
+    df = pd.DataFrame({
+        "k": [1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+        "s": ["a", None, "c", None, "e", "f", "g", None, "i", "j"],
+    })
+    t = ct.Table.from_pandas(df, env8)
+    back = t.to_pandas()
+    assert back["s"].tolist() == df["s"].tolist()
+
+
+def test_datetime_roundtrip(env4):
+    df = pd.DataFrame({
+        "t": pd.to_datetime(["2024-01-01", "2024-06-15", "2025-12-31",
+                             "2020-02-29"]),
+        "d": pd.to_timedelta([1, 2, 3, 4], unit="d"),
+    })
+    t = ct.Table.from_pandas(df, env4)
+    back = t.to_pandas()
+    pd.testing.assert_frame_equal(back, df, check_dtype=False)
+
+
+def test_project_drop_rename(env4, rng):
+    df = make_df(rng, 40)
+    t = ct.Table.from_pandas(df, env4)
+    assert t.project(["i64", "s"]).column_names == ["i64", "s"]
+    assert "i64" not in t.drop(["i64"]).column_names
+    assert "x" in t.rename({"i64": "x"}).column_names
+
+
+def test_uneven_rows(env8):
+    # 10 rows over 8 shards: last shards hold fewer
+    df = pd.DataFrame({"a": np.arange(10)})
+    t = ct.Table.from_pandas(df, env8)
+    assert t.row_count == 10
+    pd.testing.assert_frame_equal(t.to_pandas(), df, check_dtype=False)
+
+
+def test_empty_table(env4):
+    df = pd.DataFrame({"a": np.array([], np.int64)})
+    t = ct.Table.from_pandas(df, env4)
+    assert t.row_count == 0
+    assert len(t.to_pandas()) == 0

@@ -1,0 +1,47 @@
+"""Test helpers: pandas-oracle comparison.
+
+Mirrors the reference's correctness oracle (SURVEY.md §4: expected CSVs or
+unordered equality between distributed result and single-rank/pandas result):
+every operator is validated against pandas on the same data, with
+order-insensitive comparison for ops that don't define a global order.
+"""
+
+import numpy as np
+import pandas as pd
+
+
+def normalize(df: pd.DataFrame, sort_by=None) -> pd.DataFrame:
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype == object:
+            # None -> NaN for uniform comparison
+            out[c] = out[c].where(pd.notna(out[c]), np.nan)
+    if sort_by is None:
+        sort_by = list(out.columns)
+    out = out.sort_values(sort_by, kind="mergesort").reset_index(drop=True)
+    return out
+
+
+def assert_frames_equal(got: pd.DataFrame, exp: pd.DataFrame, sort_by=None,
+                        check_dtype=False, check_like=False):
+    assert list(got.columns) == list(exp.columns), \
+        f"columns {list(got.columns)} != {list(exp.columns)}"
+    g = normalize(got, sort_by)
+    e = normalize(exp, sort_by)
+    pd.testing.assert_frame_equal(g, e, check_dtype=check_dtype,
+                                  check_like=check_like)
+
+
+def assert_table_matches(table, exp: pd.DataFrame, sort_by=None,
+                         ordered=False):
+    got = table.to_pandas()
+    if ordered:
+        assert_frames_equal(got.reset_index(drop=True),
+                            exp.reset_index(drop=True),
+                            sort_by=list(exp.columns), check_dtype=False)
+        # also check exact order
+        pd.testing.assert_frame_equal(
+            got.reset_index(drop=True), exp.reset_index(drop=True),
+            check_dtype=False)
+    else:
+        assert_frames_equal(got, exp, sort_by=sort_by)
