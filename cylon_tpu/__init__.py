@@ -21,6 +21,8 @@ from .ctx.context import (CPUMeshConfig, CylonEnv, LocalConfig,  # noqa: F401
 from .core.column import Column  # noqa: F401
 from .core.dtypes import LogicalType  # noqa: F401
 from .core.table import Table  # noqa: F401
+from .frame import DataFrame, GroupByDataFrame, concat, read_pandas  # noqa: F401
+from .series import Series  # noqa: F401
 from .status import Code, CylonError, Status  # noqa: F401
 
 __version__ = "0.1.0"

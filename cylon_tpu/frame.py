@@ -1,0 +1,453 @@
+"""DataFrame: the pandas-like user API.
+
+TPU-native equivalent of PyCylon's ``DataFrame`` veneer (reference
+python/pycylon/pycylon/frame.py:187, GroupByDataFrame :122) preserving the
+reference's dispatch contract (frame.py:2063-2076): every operator takes
+``env: CylonEnv = None`` — ``None`` runs the op locally (serial world), an
+env runs it distributed over that env's device mesh.  A DataFrame built
+without an env lives on the default local device; passing ``env=`` to an op
+(or the constructor) moves/keeps it on the mesh.
+
+Column math and filters go through :class:`cylon_tpu.series.Series`
+(reference compute.pyx engine).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Sequence
+
+import numpy as np
+
+from .core.column import Column
+from .core.table import Table, default_env
+from .ctx.context import CylonEnv
+from .relational import (concat_tables, equals, filter_table,
+                         groupby_aggregate, head, join_tables, repartition,
+                         set_operation, shuffle_table, slice_table,
+                         sort_table, tail, unique_table)
+from .series import Series
+from .status import CylonKeyError, InvalidError
+
+__all__ = ["DataFrame", "GroupByDataFrame", "concat", "read_pandas"]
+
+
+def _resolve_env(df_env: CylonEnv, env: CylonEnv | None) -> CylonEnv:
+    return env if env is not None else df_env
+
+
+class DataFrame:
+    """Columnar distributed dataframe over a device mesh."""
+
+    def __init__(self, data: Any = None, env: CylonEnv | None = None,
+                 _table: Table | None = None):
+        self._index: str | None = None  # label index column (C24 analog)
+        if _table is not None:
+            self._table = _table
+            return
+        if data is None:
+            data = {}
+        if isinstance(data, Table):
+            self._table = data
+        elif isinstance(data, DataFrame):
+            self._table = data._table
+        elif isinstance(data, Mapping):
+            self._table = Table.from_pydict(
+                {k: np.asarray(v) for k, v in data.items()}, env)
+        elif isinstance(data, (list, tuple)):
+            # list of columns (PyCylon accepts list-of-lists)
+            cols = {f"{i}": np.asarray(c) for i, c in enumerate(data)}
+            self._table = Table.from_pydict(cols, env)
+        else:
+            try:
+                import pandas as pd
+                if isinstance(data, pd.DataFrame):
+                    self._table = Table.from_pandas(data, env)
+                else:
+                    raise TypeError
+            except TypeError:
+                raise InvalidError(f"cannot build DataFrame from {type(data)}")
+
+    # -- construction helpers ---------------------------------------------
+    @staticmethod
+    def from_table(table: Table) -> "DataFrame":
+        return DataFrame(_table=table)
+
+    @property
+    def table(self) -> Table:
+        return self._table
+
+    @property
+    def env(self) -> CylonEnv:
+        return self._table.env
+
+    def _to_env(self, env: CylonEnv) -> "DataFrame":
+        """Move this frame onto another env's mesh (host round-trip)."""
+        if env is self._table.env:
+            return self
+        return DataFrame(self.to_pandas(), env=env)
+
+    def _wrap(self, table: Table, keep_index: bool = False) -> "DataFrame":
+        out = DataFrame(_table=table)
+        if keep_index and self._index in table.column_names:
+            out._index = self._index
+        return out
+
+    # -- schema / introspection -------------------------------------------
+    @property
+    def columns(self) -> list[str]:
+        return self._table.column_names
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self._table.row_count, self._table.column_count)
+
+    @property
+    def dtypes(self) -> dict[str, str]:
+        return {f.name: f.type.value for f in self._table.schema}
+
+    def __len__(self) -> int:
+        return self._table.row_count
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._table
+
+    def __repr__(self) -> str:  # pragma: no cover
+        n = len(self)
+        show = self.to_pandas() if n <= 20 else head(self._table, 10).to_pandas()
+        s = repr(show)
+        if n > 20:
+            s += f"\n... ({n} rows x {self._table.column_count} cols, " \
+                 f"world={self.env.world_size})"
+        return s
+
+    # -- index (reference indexing subsystem, indexing/index.hpp) ----------
+    @property
+    def loc(self):
+        from .indexing.indexer import LocIndexer
+        return LocIndexer(self)
+
+    @property
+    def iloc(self):
+        from .indexing.indexer import ILocIndexer
+        return ILocIndexer(self)
+
+    @property
+    def index(self) -> np.ndarray:
+        if self._index is None:
+            return np.arange(len(self))
+        return self[self._index].to_numpy()
+
+    def set_index(self, name: str, drop: bool = False) -> "DataFrame":
+        """Use column ``name`` as the row-label index (reference
+        Table::SetArrowIndex, table.hpp:164; drop semantics from pandas —
+        the column stays addressable unless drop=True materialization)."""
+        if name not in self._table:
+            raise CylonKeyError(f"no column {name!r}")
+        out = DataFrame(_table=self._table)
+        out._index = name
+        return out
+
+    def reset_index(self) -> "DataFrame":
+        out = DataFrame(_table=self._table)
+        return out
+
+    # -- materialization ---------------------------------------------------
+    def to_pandas(self):
+        df = self._table.to_pandas()
+        if self._index is not None:
+            df = df.set_index(self._index)
+        return df
+
+    def to_arrow(self):
+        return self._table.to_arrow()
+
+    def to_numpy(self) -> np.ndarray:
+        return self.to_pandas().to_numpy()
+
+    def to_dict(self) -> dict:
+        return {k: v.tolist()
+                for k, v in self.to_pandas().to_dict("list").items()}
+
+    # -- column access / mutation -----------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            col = self._table.column(key)
+            return Series(key, col, self.env, self._table.valid_counts)
+        if isinstance(key, (list, tuple)) and all(isinstance(k, str)
+                                                  for k in key):
+            return self._wrap(self._table.project(key))
+        if isinstance(key, Series):
+            if key.dtype.value != "bool":
+                raise InvalidError("filter mask must be a bool series")
+            return self._wrap(filter_table(self._table, key.column.data),
+                              keep_index=True)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(len(self))
+            if step != 1:
+                raise InvalidError("slice step not supported")
+            return self._wrap(slice_table(self._table, start, stop - start),
+                              keep_index=True)
+        raise CylonKeyError(f"cannot index DataFrame with {key!r}")
+
+    def __setitem__(self, name: str, value):
+        if not isinstance(name, str):
+            raise CylonKeyError("column name must be a string")
+        if isinstance(value, Series):
+            if value.column.data.shape[0] != self._table.capacity * \
+                    self.env.world_size:
+                raise InvalidError("series layout mismatch")
+            col = value.column
+        elif np.isscalar(value) or isinstance(value, (int, float, bool, str)):
+            n = len(self)
+            col = self._ingest_column(np.full(n, value))
+        else:
+            arr = np.asarray(value)
+            if arr.shape[0] != len(self):
+                raise InvalidError(
+                    f"column length {arr.shape[0]} != rows {len(self)}")
+            col = self._ingest_column(arr)
+        self._table = self._table.with_columns({name: col})
+
+    def _ingest_column(self, arr: np.ndarray) -> Column:
+        """Host array -> column matching this table's shard layout."""
+        tmp = Table.from_pydict({"__c": arr}, self.env)
+        tmp = repartition(tmp, tuple(int(x) for x in self._table.valid_counts))
+        from .relational.repart import repad_table
+        tmp = repad_table(tmp, self._table.capacity)
+        return tmp.column("__c")
+
+    def drop(self, columns: Iterable[str]) -> "DataFrame":
+        if isinstance(columns, str):
+            columns = [columns]
+        return self._wrap(self._table.drop(columns))
+
+    def rename(self, columns: Mapping[str, str]) -> "DataFrame":
+        return self._wrap(self._table.rename(columns))
+
+    # -- relational operators (the reference's Table API surface) ----------
+    def merge(self, right: "DataFrame", how: str = "inner", on=None,
+              left_on=None, right_on=None, suffixes=("_x", "_y"),
+              env: CylonEnv | None = None, algorithm: str = "sort") -> "DataFrame":
+        """pandas.merge parity (reference frame.py:1852 + dispatch :2063)."""
+        env = _resolve_env(self.env, env)
+        lhs, rhs = self._to_env(env), right._to_env(env)
+        if on is not None:
+            left_on = right_on = on
+        if left_on is None or right_on is None:
+            common = [c for c in lhs.columns if c in set(rhs.columns)]
+            if not common:
+                raise InvalidError("no common columns to merge on")
+            left_on = right_on = common
+        t = join_tables(lhs._table, rhs._table, left_on, right_on, how=how,
+                        suffixes=suffixes, coalesce_keys=True)
+        return self._wrap(t)
+
+    def join(self, other: "DataFrame", how: str = "left", on=None,
+             lsuffix: str = "l", rsuffix: str = "r",
+             env: CylonEnv | None = None, algorithm: str = "sort") -> "DataFrame":
+        """Key-based join with suffixed columns (reference frame.py:1723
+        joins add suffixes to every overlapping column, keys kept apart)."""
+        env = _resolve_env(self.env, env)
+        lhs, oth = self._to_env(env), other._to_env(env)
+        if on is None:
+            raise InvalidError("join requires on= key column(s)")
+        on = [on] if isinstance(on, str) else list(on)
+        t = join_tables(lhs._table, oth._table, on, on, how=how,
+                        suffixes=(lsuffix, rsuffix), coalesce_keys=False)
+        return self._wrap(t)
+
+    def sort_values(self, by, ascending=True, nulls_position: str = "last",
+                    env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(sort_table(self._to_env(env)._table, by,
+                                     ascending=ascending,
+                                     nulls_position=nulls_position),
+                          keep_index=True)
+
+    def groupby(self, by, env: CylonEnv | None = None) -> "GroupByDataFrame":
+        env = _resolve_env(self.env, env)
+        by = [by] if isinstance(by, str) else list(by)
+        return GroupByDataFrame(self._to_env(env), by)
+
+    def drop_duplicates(self, subset=None, keep: str = "first",
+                        env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(unique_table(self._to_env(env)._table, subset,
+                                       keep))
+
+    def union(self, other: "DataFrame", env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(set_operation(self._to_env(env)._table,
+                                        other._to_env(env)._table, "union"))
+
+    def intersect(self, other: "DataFrame", env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(set_operation(self._to_env(env)._table,
+                                        other._to_env(env)._table, "intersect"))
+
+    def subtract(self, other: "DataFrame", env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(set_operation(self._to_env(env)._table,
+                                        other._to_env(env)._table, "subtract"))
+
+    def shuffle(self, on, env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        on = [on] if isinstance(on, str) else list(on)
+        return self._wrap(shuffle_table(self._to_env(env)._table, on))
+
+    def repartition(self, rows_per_partition=None,
+                    env: CylonEnv | None = None) -> "DataFrame":
+        env = _resolve_env(self.env, env)
+        return self._wrap(repartition(self._to_env(env)._table,
+                                      rows_per_partition))
+
+    def head(self, n: int = 5) -> "DataFrame":
+        return self._wrap(head(self._table, n), keep_index=True)
+
+    def tail(self, n: int = 5) -> "DataFrame":
+        return self._wrap(tail(self._table, n), keep_index=True)
+
+    def to_csv(self, path, **kw) -> None:
+        from .io import write_csv
+        write_csv(self._table, path, **kw)
+
+    def to_parquet(self, path, **kw) -> None:
+        from .io import write_parquet
+        write_parquet(self._table, path, **kw)
+
+    def to_json(self, path, **kw) -> None:
+        from .io import write_json
+        write_json(self._table, path, **kw)
+
+    def equals(self, other: "DataFrame", ordered: bool = True) -> bool:
+        return equals(self._table, other._to_env(self.env)._table,
+                      ordered=ordered)
+
+    def isin(self, other: "DataFrame") -> bool:
+        """Row-subset test: every row of self appears in other."""
+        diff = set_operation(self._table, other._to_env(self.env)._table,
+                             "subtract")
+        return diff.row_count == 0
+
+    # -- reductions over all columns ---------------------------------------
+    def _agg_all(self, op: str):
+        import pandas as pd
+        out = {}
+        for name in self.columns:
+            s = self[name]
+            try:
+                out[name] = getattr(s, op)()
+            except Exception:
+                continue
+        return pd.Series(out)
+
+    def sum(self):
+        return self._agg_all("sum")
+
+    def min(self):
+        return self._agg_all("min")
+
+    def max(self):
+        return self._agg_all("max")
+
+    def count(self):
+        return self._agg_all("count")
+
+    def mean(self):
+        return self._agg_all("mean")
+
+
+class GroupByDataFrame:
+    """Deferred groupby (reference frame.py:122 GroupByDataFrame): terminal
+    aggregation methods run the distributed two-phase engine."""
+
+    def __init__(self, df: DataFrame, by: list[str]):
+        self._df = df
+        self._by = by
+        self._value_cols = [c for c in df.columns if c not in set(by)]
+
+    def __getitem__(self, cols) -> "GroupByDataFrame":
+        cols = [cols] if isinstance(cols, str) else list(cols)
+        for c in cols:
+            if c not in self._df.columns:
+                raise CylonKeyError(f"no column {c!r}")
+        g = GroupByDataFrame(self._df, self._by)
+        g._value_cols = cols
+        return g
+
+    def _run(self, aggs) -> DataFrame:
+        t = groupby_aggregate(self._df._table, self._by, aggs)
+        return DataFrame(_table=t)
+
+    def _all(self, op: str) -> DataFrame:
+        from .core.dtypes import LogicalType
+        aggs = []
+        for c in self._value_cols:
+            lt = self._df._table.column(c).type
+            if lt == LogicalType.STRING and op not in ("count", "nunique",
+                                                       "min", "max"):
+                continue
+            aggs.append((c, op))
+        if not aggs:
+            raise InvalidError(f"no columns support {op!r}")
+        out = self._run(aggs)
+        # pandas-style: result columns keep the value column name
+        ren = {f"{c}_{op}": c for c, _ in aggs}
+        return DataFrame(_table=out._table.rename(ren))
+
+    def sum(self) -> DataFrame:
+        return self._all("sum")
+
+    def count(self) -> DataFrame:
+        return self._all("count")
+
+    def min(self) -> DataFrame:
+        return self._all("min")
+
+    def max(self) -> DataFrame:
+        return self._all("max")
+
+    def mean(self) -> DataFrame:
+        return self._all("mean")
+
+    def var(self) -> DataFrame:
+        return self._all("var")
+
+    def std(self) -> DataFrame:
+        return self._all("std")
+
+    def nunique(self) -> DataFrame:
+        return self._all("nunique")
+
+    def median(self) -> DataFrame:
+        return self._all("median")
+
+    def quantile(self, q: float = 0.5) -> DataFrame:
+        aggs = [(c, "quantile", q) for c in self._value_cols]
+        out = self._run(aggs)
+        ren = {f"{c}_quantile_{q:g}": c for c in self._value_cols}
+        ren.update({f"{c}_quantile": c for c in self._value_cols})
+        ren = {k: v for k, v in ren.items() if k in out.columns}
+        return DataFrame(_table=out._table.rename(ren))
+
+    def agg(self, spec: Mapping[str, Any]) -> DataFrame:
+        """pandas .agg({'col': 'sum'|['sum','mean']}) spelling."""
+        aggs = []
+        for col, ops in spec.items():
+            ops = [ops] if isinstance(ops, str) else list(ops)
+            for op in ops:
+                aggs.append((col, op))
+        return self._run(aggs)
+
+
+def concat(objs: Sequence[DataFrame], env: CylonEnv | None = None) -> "DataFrame":
+    """Row-wise concat (reference frame.py:2295)."""
+    if not objs:
+        raise InvalidError("concat of nothing")
+    env = _resolve_env(objs[0].env, env)
+    tables = [o._to_env(env)._table for o in objs]
+    return DataFrame(_table=concat_tables(tables))
+
+
+def read_pandas(df, env: CylonEnv | None = None) -> DataFrame:
+    return DataFrame(df, env=env)

@@ -1,0 +1,3 @@
+"""Indexing subsystem (reference cpp/src/cylon/indexing/)."""
+
+from .indexer import ILocIndexer, LocIndexer, RANGE_INDEX  # noqa: F401

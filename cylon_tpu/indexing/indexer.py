@@ -1,0 +1,150 @@
+"""Indexing subsystem: row-label index + loc/iloc indexers.
+
+TPU-native equivalent of the reference's indexing layer
+(cpp/src/cylon/indexing/index.hpp:36 IndexingType RANGE/LINEAR/HASH...,
+indexer.hpp:76 ``ArrowLocIndexer`` / :123 ``ArrowILocIndexer`` with pandas
+loc/iloc semantics; table.hpp:164-169 Set/Get/ResetArrowIndex).
+
+The reference attaches hash/linear index structures to the table for O(1)
+label lookup; on TPU a label lookup is a vectorized compare/filter over the
+(sharded) index column — no side structure beats a fused VPU scan, so
+``IndexingType`` collapses to "which column is the index" plus a RANGE
+default.  loc slices use the reference's contract: both endpoints inclusive.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import LogicalType
+from ..relational import filter_table, slice_table
+from ..status import CylonIndexError, CylonKeyError
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..frame import DataFrame
+
+RANGE_INDEX = "__range__"
+
+
+def _label_mask(col, labels) -> Any:
+    """Device bool mask: row's index value in ``labels``."""
+    if col.type == LogicalType.STRING:
+        codes = []
+        d = col.dictionary
+        for lb in labels:
+            pos = int(np.searchsorted(d, lb))
+            if pos < len(d) and d[pos] == lb:
+                codes.append(pos)
+        if not codes:
+            return jnp.zeros(col.data.shape[0], bool)
+        return jnp.isin(col.data, jnp.asarray(codes, col.data.dtype))
+    arr = jnp.asarray(np.asarray(labels).astype(np.dtype(col.data.dtype)))
+    return jnp.isin(col.data, arr)
+
+
+class LocIndexer:
+    """df.loc[labels] / df.loc[lo:hi] (inclusive) / df.loc[labels, cols]
+    (reference ArrowLocIndexer modes, indexer.hpp:76)."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+
+    def __getitem__(self, key):
+        cols = None
+        if isinstance(key, tuple) and len(key) == 2:
+            key, cols = key
+        df = self._df
+        name = df._index
+        if name is None or name == RANGE_INDEX:
+            out = self._range_loc(key)
+        else:
+            out = self._label_loc(key, name)
+        if cols is not None:
+            cols = [cols] if isinstance(cols, str) else list(cols)
+            keep = ([df._index] if df._index not in (None, RANGE_INDEX) else []
+                    ) + cols
+            out = out._wrap(out._table.project(
+                [c for c in out.columns if c in set(keep)]))
+            out._index = df._index
+        return out
+
+    def _range_loc(self, key):
+        df = self._df
+        if isinstance(key, slice):
+            lo = 0 if key.start is None else int(key.start)
+            hi = len(df) - 1 if key.stop is None else int(key.stop)
+            return df[lo:hi + 1]  # loc slices are inclusive
+        if np.isscalar(key):
+            return df[int(key):int(key) + 1]
+        labels = list(key)
+        # positional filter over the implicit range index
+        return df.iloc[labels]
+
+    def _label_loc(self, key, name: str):
+        df = self._df
+        col = df._table.column(name)
+        if isinstance(key, slice):
+            # inclusive label range: value >= start & value <= stop
+            s = df[name]
+            mask = None
+            if key.start is not None:
+                mask = (s >= key.start)
+            if key.stop is not None:
+                m2 = (s <= key.stop)
+                mask = m2 if mask is None else (mask & m2)
+            if mask is None:
+                return df
+            out = df._wrap(filter_table(df._table, mask.column.data))
+            out._index = df._index
+            return out
+        labels = [key] if np.isscalar(key) or isinstance(key, str) else list(key)
+        mask = _label_mask(col, labels)
+        out = df._wrap(filter_table(df._table, mask))
+        if out._table.row_count == 0:
+            raise CylonKeyError(f"labels {labels!r} not found in index")
+        out._index = df._index
+        return out
+
+
+class ILocIndexer:
+    """df.iloc[pos] — global positional selection (reference
+    ArrowILocIndexer, indexer.hpp:123)."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+
+    def __getitem__(self, key):
+        cols = None
+        if isinstance(key, tuple) and len(key) == 2:
+            key, cols = key
+        df = self._df
+        n = len(df)
+        if isinstance(key, slice):
+            start, stop, step = key.indices(n)
+            if step != 1:
+                raise CylonIndexError("iloc step not supported")
+            out = df._wrap(slice_table(df._table, start, stop - start))
+        elif np.isscalar(key):
+            i = int(key)
+            if i < 0:
+                i += n
+            if not (0 <= i < n):
+                raise CylonIndexError(f"position {key} out of range [0,{n})")
+            out = df._wrap(slice_table(df._table, i, 1))
+        else:
+            # positional list: filter on global position
+            pos = sorted(int(p) + (n if p < 0 else 0) for p in key)
+            if pos and not (0 <= pos[0] and pos[-1] < n):
+                raise CylonIndexError(f"positions out of range [0,{n})")
+            from ..relational import concat_tables
+            parts = [slice_table(df._table, p, 1) for p in pos]
+            out = df._wrap(concat_tables(parts)) if parts else df[0:0]
+        out._index = df._index
+        if cols is not None:
+            cols = [cols] if isinstance(cols, str) else list(cols)
+            out = out._wrap(out._table.project(cols))
+            out._index = None
+        return out

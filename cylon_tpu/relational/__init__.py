@@ -17,5 +17,5 @@ from .join import join_tables  # noqa: F401
 from .groupby import groupby_aggregate  # noqa: F401
 from .sort import sort_table  # noqa: F401
 from .setops import (equals, set_operation, unique_table)  # noqa: F401
-from .repart import (concat_tables, head, repartition, slice_table,  # noqa: F401
-                     shuffle_table, tail)
+from .repart import (concat_tables, filter_table, head, repartition,  # noqa: F401
+                     repad_table, slice_table, shuffle_table, tail)

@@ -238,6 +238,54 @@ def tail(table: Table, n: int) -> Table:
 
 
 # ---------------------------------------------------------------------------
+# row filter (reference: compute.pyx filter path — table[bool_mask])
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _filter_count_fn(mesh: Mesh, cap: int):
+    def per_shard(vc, flag):
+        mask = live_mask(vc, cap)
+        return jnp.sum(flag & mask).astype(jnp.int32).reshape(1)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
+                             out_specs=ROW))
+
+
+@lru_cache(maxsize=None)
+def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int):
+    def per_shard(vc, flag, datas, valids):
+        mask = live_mask(vc, cap)
+        idx, _ = sortk.compact_by_flag(flag & mask, out_cap)
+        safe = jnp.clip(idx, 0, max(cap - 1, 0))
+        out_d = tuple(d[safe] for d in datas)
+        out_v = tuple(v[safe] if v is not None else None for v in valids)
+        return out_d, out_v
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW, ROW),
+                             out_specs=(ROW, ROW)))
+
+
+def filter_table(table: Table, flag) -> Table:
+    """Keep rows whose boolean flag is set (flag: device bool array with the
+    table's row layout).  Row order preserved; distribution keeps each row on
+    its shard (like the reference's local filter ops)."""
+    from .common import rebuild_like
+    env = table.env
+    cap = max(table.capacity, 1)
+    vc = jnp.asarray(table.valid_counts, jnp.int32)
+    counts = np.asarray(_filter_count_fn(env.mesh, cap)(vc, flag)
+                        ).astype(np.int64)
+    out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+    items = list(table.columns.items())
+    datas = tuple(c.data for _, c in items)
+    valids = tuple(c.validity for _, c in items)
+    out_d, out_v = _filter_mat_fn(env.mesh, cap, out_cap)(vc, flag, datas,
+                                                          valids)
+    return rebuild_like(items, out_d, out_v, counts, env)
+
+
+# ---------------------------------------------------------------------------
 # concat (reference Merge/concat, frame.py:2295)
 # ---------------------------------------------------------------------------
 

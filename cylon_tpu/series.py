@@ -1,0 +1,369 @@
+"""Series: a named device-resident column with elementwise compute.
+
+TPU-native equivalent of PyCylon's ``Series`` (python/pycylon/pycylon/
+series.py) and the dual arrow/numpy "compute engine" behind DataFrame math
+and filters (python/pycylon/pycylon/data/compute.pyx:212-218).  The reference
+dispatches per-op to pyarrow.compute or numpy on host memory; here every op
+is a ``jax.numpy`` expression over the (possibly mesh-sharded) column array —
+XLA fuses chains of elementwise ops into single kernels, and padding rows
+simply compute garbage that the valid-prefix convention ignores.
+
+Null semantics: validity propagates through arithmetic/comparison as AND
+(null op x -> null), matching Arrow/pandas nullable behavior.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .core.column import Column
+from .core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
+from .core.table import Table
+from .status import CylonTypeError, InvalidError
+
+shard_map = jax.shard_map
+
+
+def _binop_validity(a: Column, b) -> Any:
+    va = a.validity
+    vb = b.validity if isinstance(b, Column) else None
+    if va is None:
+        return vb
+    if vb is None:
+        return va
+    return va & vb
+
+
+class Series:
+    """A column bound to a table's row layout (env + per-shard valid counts).
+
+    Arithmetic/comparison with scalars or layout-matched Series; boolean
+    Series feed ``DataFrame.__getitem__`` filters.
+    """
+
+    __slots__ = ("name", "_col", "_env", "_valid")
+
+    def __init__(self, name: str, col: Column, env, valid_counts: np.ndarray):
+        self.name = name
+        self._col = col
+        self._env = env
+        self._valid = valid_counts
+
+    # -- basics ------------------------------------------------------------
+    @property
+    def column(self) -> Column:
+        return self._col
+
+    @property
+    def dtype(self) -> LogicalType:
+        return self._col.type
+
+    @property
+    def env(self):
+        return self._env
+
+    @property
+    def valid_counts(self) -> np.ndarray:
+        return self._valid
+
+    def __len__(self) -> int:
+        return int(self._valid.sum())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Series({self.name!r}, {self.dtype.value}, len={len(self)})"
+
+    def to_numpy(self) -> np.ndarray:
+        w = self._valid.shape[0]
+        cap = len(self._col) // max(w, 1)
+        host = np.asarray(self._col.data)
+        valid = (np.asarray(self._col.validity)
+                 if self._col.validity is not None else None)
+        parts = [slice(i * cap, i * cap + int(self._valid[i]))
+                 for i in range(w)]
+        data = np.concatenate([host[s] for s in parts]) if parts else host[:0]
+        vcat = (np.concatenate([valid[s] for s in parts])
+                if valid is not None else None)
+        return Column(data, self._col.type, vcat,
+                      self._col.dictionary).to_numpy(len(data))
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.Series(self.to_numpy(), name=self.name)
+
+    # -- elementwise machinery --------------------------------------------
+    def _wrap(self, data, validity, lt: LogicalType | None = None,
+              dictionary=None, name: str | None = None) -> "Series":
+        lt = lt or from_numpy_dtype(np.dtype(data.dtype))
+        return Series(name or self.name, Column(data, lt, validity, dictionary),
+                      self._env, self._valid)
+
+    def _other_operand(self, other):
+        """-> (device array or scalar, validity or None)."""
+        if isinstance(other, Series):
+            if other._col.data.shape != self._col.data.shape:
+                raise InvalidError("series layouts differ; align first")
+            if (other._col.type == LogicalType.STRING) != (
+                    self._col.type == LogicalType.STRING):
+                raise CylonTypeError("cannot mix string and numeric series")
+            if other._col.type == LogicalType.STRING:
+                from .relational.common import unify_dictionaries
+                a, b = unify_dictionaries(self._col, other._col)
+                return (a, b.data), _binop_validity(a, b)
+            return (self._col, other._col.data), _binop_validity(
+                self._col, other._col)
+        # scalar
+        if isinstance(other, str):
+            raise CylonTypeError("string scalar only valid in comparisons")
+        return (self._col, other), self._col.validity
+
+    def _arith(self, other, fn, name: str) -> "Series":
+        if self._col.type == LogicalType.STRING:
+            raise CylonTypeError(f"{name} not supported for string series")
+        (col, rhs), validity = self._other_operand(other)
+        out = fn(col.data, rhs)
+        return self._wrap(out, validity)
+
+    def _compare(self, other, fn) -> "Series":
+        if isinstance(other, str):
+            if self._col.type != LogicalType.STRING:
+                raise CylonTypeError("string scalar vs numeric series")
+            # dictionary is sorted, so codes are order-isomorphic to values;
+            # absent scalars compare via their insertion point - 0.5 (all
+            # comparisons then resolve exactly in float space)
+            d = self._col.dictionary
+            pos = int(np.searchsorted(d, other))
+            present = pos < len(d) and d[pos] == other
+            rhs = float(pos) if present else pos - 0.5
+            out = fn(self._col.data.astype(jnp.float64), rhs)
+            return self._wrap(out, self._col.validity, LogicalType.BOOL)
+        (col, rhs), validity = self._other_operand(other)
+        out = fn(col.data, rhs)
+        return self._wrap(out, validity, LogicalType.BOOL)
+
+    # arithmetic
+    def __add__(self, o):
+        return self._arith(o, jnp.add, "+")
+
+    def __radd__(self, o):
+        return self._arith(o, jnp.add, "+")
+
+    def __sub__(self, o):
+        return self._arith(o, jnp.subtract, "-")
+
+    def __rsub__(self, o):
+        return self._arith(o, lambda a, b: jnp.subtract(b, a), "-")
+
+    def __mul__(self, o):
+        return self._arith(o, jnp.multiply, "*")
+
+    def __rmul__(self, o):
+        return self._arith(o, jnp.multiply, "*")
+
+    def __truediv__(self, o):
+        return self._arith(o, jnp.true_divide, "/")
+
+    def __rtruediv__(self, o):
+        return self._arith(o, lambda a, b: jnp.true_divide(b, a), "/")
+
+    def __floordiv__(self, o):
+        return self._arith(o, jnp.floor_divide, "//")
+
+    def __mod__(self, o):
+        return self._arith(o, jnp.mod, "%")
+
+    def __pow__(self, o):
+        return self._arith(o, jnp.power, "**")
+
+    def __neg__(self):
+        return self._arith(0, lambda a, _: jnp.negative(a), "neg")
+
+    def __abs__(self):
+        return self._arith(0, lambda a, _: jnp.abs(a), "abs")
+
+    # comparisons
+    def __eq__(self, o):  # type: ignore[override]
+        return self._compare(o, jnp.equal)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._compare(o, jnp.not_equal)
+
+    def __lt__(self, o):
+        return self._compare(o, jnp.less)
+
+    def __le__(self, o):
+        return self._compare(o, jnp.less_equal)
+
+    def __gt__(self, o):
+        return self._compare(o, jnp.greater)
+
+    def __ge__(self, o):
+        return self._compare(o, jnp.greater_equal)
+
+    __hash__ = None  # type: ignore[assignment]
+
+    # logical
+    def _logical(self, other, fn) -> "Series":
+        if self._col.type != LogicalType.BOOL:
+            raise CylonTypeError("logical op on non-bool series")
+        (col, rhs), validity = self._other_operand(other)
+        return self._wrap(fn(col.data, rhs), validity, LogicalType.BOOL)
+
+    def __and__(self, o):
+        return self._logical(o, jnp.logical_and)
+
+    def __or__(self, o):
+        return self._logical(o, jnp.logical_or)
+
+    def __xor__(self, o):
+        return self._logical(o, jnp.logical_xor)
+
+    def __invert__(self):
+        if self._col.type != LogicalType.BOOL:
+            raise CylonTypeError("~ on non-bool series")
+        return self._wrap(jnp.logical_not(self._col.data), self._col.validity,
+                          LogicalType.BOOL)
+
+    # -- null handling -----------------------------------------------------
+    def isna(self) -> "Series":
+        if self._col.validity is None:
+            if self._col.type in (LogicalType.FLOAT32, LogicalType.FLOAT64):
+                return self._wrap(jnp.isnan(self._col.data), None,
+                                  LogicalType.BOOL)
+            return self._wrap(jnp.zeros(self._col.data.shape[0], bool), None,
+                              LogicalType.BOOL)
+        out = jnp.logical_not(self._col.validity)
+        if self._col.type in (LogicalType.FLOAT32, LogicalType.FLOAT64):
+            out = out | jnp.isnan(self._col.data)
+        return self._wrap(out, None, LogicalType.BOOL)
+
+    def notna(self) -> "Series":
+        return ~self.isna()
+
+    def fillna(self, value) -> "Series":
+        if self._col.type == LogicalType.STRING:
+            if not isinstance(value, str):
+                raise CylonTypeError("fillna on string series needs str")
+            d = self._col.dictionary
+            pos = int(np.searchsorted(d, value))
+            if not (pos < len(d) and d[pos] == value):
+                newd = np.insert(d, pos, value)
+                remap = jnp.asarray(
+                    np.searchsorted(newd, d).astype(np.int32))
+                codes = remap[jnp.clip(self._col.data, 0, len(d) - 1)]
+                col = Column(codes, LogicalType.STRING, self._col.validity,
+                             newd)
+            else:
+                col = self._col
+            code = int(np.searchsorted(col.dictionary, value))
+            if col.validity is None:
+                return Series(self.name, col, self._env, self._valid)
+            data = jnp.where(col.validity, col.data, jnp.int32(code))
+            return self._wrap(data, None, LogicalType.STRING, col.dictionary)
+        na = self.isna()._col.data
+        data = jnp.where(na, jnp.asarray(value, self._col.data.dtype),
+                         self._col.data)
+        return self._wrap(data, None, self._col.type)
+
+    def astype(self, dtype) -> "Series":
+        lt = from_numpy_dtype(np.dtype(dtype)) if not isinstance(
+            dtype, LogicalType) else dtype
+        return Series(self.name, self._col.cast(lt), self._env, self._valid)
+
+    # -- reductions --------------------------------------------------------
+    def _reduce(self, kind: str):
+        from .relational.common import live_mask, REP, ROW
+        col, valid, lt = self._col, self._valid, self._col.type
+        if lt == LogicalType.STRING and kind not in ("count", "min", "max"):
+            raise CylonTypeError(f"{kind} on string series")
+        mesh = self._env.mesh
+        cap = len(col) // max(valid.shape[0], 1)
+        partials = _reduce_fn(mesh, kind, max(cap, 1))(
+            jnp.asarray(valid, jnp.int32), col.data,
+            col.validity if col.validity is not None
+            else jnp.ones(len(col), bool))
+        parts = np.asarray(partials)
+        if kind == "sum":
+            if lt not in (LogicalType.FLOAT32, LogicalType.FLOAT64):
+                return int(parts[:, 0].sum())
+            return parts[:, 0].sum()
+        if kind == "count":
+            return int(parts[:, 0].sum())
+        if kind == "min":
+            live = parts[:, 1] > 0
+            if not live.any():
+                return None
+            v = parts[live, 0].min()
+        elif kind == "max":
+            live = parts[:, 1] > 0
+            if not live.any():
+                return None
+            v = parts[live, 0].max()
+        if lt == LogicalType.STRING:
+            return str(self._col.dictionary[int(v)])
+        return v
+
+    def sum(self):
+        return self._reduce("sum")
+
+    def count(self) -> int:
+        return self._reduce("count")
+
+    def min(self):
+        return self._reduce("min")
+
+    def max(self):
+        return self._reduce("max")
+
+    def mean(self):
+        c = self.count()
+        return self.sum() / c if c else float("nan")
+
+    def nunique(self) -> int:
+        import pandas as pd
+        from .relational import unique_table
+        t = Table({self.name: self._col}, self._env, self._valid)
+        vals = unique_table(t, [self.name]).to_pandas()[self.name]
+        return int(pd.notna(vals).sum())  # pandas semantics: drop nulls
+
+    def unique(self) -> np.ndarray:
+        from .relational import unique_table
+        t = Table({self.name: self._col}, self._env, self._valid)
+        return unique_table(t, [self.name]).to_pandas()[self.name].to_numpy()
+
+
+@lru_cache(maxsize=None)
+def _reduce_fn(mesh: Mesh, kind: str, cap: int):
+    from .relational.common import REP, ROW, live_mask
+
+    def per_shard(vc, data, validity):
+        mask = live_mask(vc, cap) & validity
+        if kind == "sum":
+            out = jnp.sum(jnp.where(mask, data, 0)).astype(jnp.float64
+                          if data.dtype.kind == "f" else data.dtype)
+            cnt = jnp.sum(mask)
+        elif kind == "count":
+            out = jnp.sum(mask)
+            cnt = out
+        elif kind == "min":
+            big = jnp.iinfo(data.dtype).max if data.dtype.kind in "iu" \
+                else jnp.inf
+            out = jnp.min(jnp.where(mask, data, big))
+            cnt = jnp.sum(mask)
+        elif kind == "max":
+            small = jnp.iinfo(data.dtype).min if data.dtype.kind in "iu" \
+                else -jnp.inf
+            out = jnp.max(jnp.where(mask, data, small))
+            cnt = jnp.sum(mask)
+        else:
+            raise ValueError(kind)
+        return jnp.stack([out.astype(jnp.float64),
+                          cnt.astype(jnp.float64)]).reshape(1, 2)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
+                             out_specs=ROW))

@@ -1,0 +1,207 @@
+"""DataFrame API tests (reference python test_frame.py — 25 DataFrame cases
+— plus the env-dispatch contract from frame.py:2063)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+
+from utils import assert_frames_equal
+
+
+def pdf(rng, n=80):
+    return pd.DataFrame({
+        "k": rng.integers(0, 12, n),
+        "v": rng.random(n),
+        "s": rng.choice(["red", "green", "blue"], n),
+    })
+
+
+def test_construct_variants(env4, rng):
+    d = {"a": np.arange(10), "b": np.arange(10) * 0.5}
+    df1 = ct.DataFrame(d)
+    assert df1.shape == (10, 2)
+    df2 = ct.DataFrame(pd.DataFrame(d), env=env4)
+    assert df2.shape == (10, 2)
+    assert df2.env.world_size == 4
+    df3 = ct.DataFrame([list(range(5)), list(range(5))])
+    assert df3.columns == ["0", "1"]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_merge_env_dispatch(env8, rng, how):
+    ldf, rdf = pdf(rng), pdf(rng, 40)
+    # local (no env)
+    l_loc, r_loc = ct.DataFrame(ldf), ct.DataFrame(rdf)
+    got_local = l_loc.merge(r_loc, on="k", how=how, suffixes=("_x", "_y"))
+    assert got_local.env.world_size == 1
+    # distributed (env passed at op time, reference contract)
+    got_dist = l_loc.merge(r_loc, on="k", how=how, suffixes=("_x", "_y"),
+                           env=env8)
+    assert got_dist.env.world_size == 8
+    exp = ldf.merge(rdf, on="k", how=how, suffixes=("_x", "_y"))
+    for got in (got_local, got_dist):
+        assert_frames_equal(got.to_pandas(), exp, sort_by=list(exp.columns))
+
+
+def test_join_suffixes(env4, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 8, 30), "v": rng.random(30)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 8, 20), "v": rng.random(20)})
+    l, r = ct.DataFrame(ldf, env=env4), ct.DataFrame(rdf, env=env4)
+    got = l.join(r, on="k", how="inner", lsuffix="l", rsuffix="r")
+    assert set(got.columns) == {"kl", "kr", "vl", "vr"}
+    exp = ldf.merge(rdf, on="k", how="inner", suffixes=("l", "r"))
+    g = got.to_pandas()[["kl", "vl", "vr"]].rename(
+        columns={"kl": "k"})
+    assert_frames_equal(g, exp[["k", "vl", "vr"]], sort_by=["k", "vl"])
+
+
+def test_sort_values_groupby(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    got = df.groupby("k")[["v"]].sum().sort_values("k").to_pandas()
+    exp = data.groupby("k", as_index=False)[["v"]].sum().sort_values(
+        "k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), exp,
+                                  check_dtype=False)
+
+
+def test_groupby_agg_dict(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    got = df.groupby("k").agg({"v": ["sum", "mean"]}).to_pandas()
+    exp = data.groupby("k").agg(v_sum=("v", "sum"), v_mean=("v", "mean")
+                                ).reset_index()
+    assert_frames_equal(got, exp, sort_by=["k"])
+
+
+def test_drop_duplicates(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    got = df.drop_duplicates(subset=["k"]).to_pandas()
+    exp = data.drop_duplicates(subset=["k"])
+    assert_frames_equal(got, exp.reset_index(drop=True), sort_by=["k"])
+
+
+def test_set_ops_methods(env4, rng):
+    a = pd.DataFrame({"x": rng.integers(0, 10, 30)})
+    b = pd.DataFrame({"x": rng.integers(5, 15, 30)})
+    da, db = ct.DataFrame(a, env=env4), ct.DataFrame(b, env=env4)
+    got_u = set(da.union(db).to_pandas()["x"])
+    assert got_u == set(a["x"]) | set(b["x"])
+    got_i = set(da.intersect(db).to_pandas()["x"])
+    assert got_i == set(a["x"]) & set(b["x"])
+    got_s = set(da.subtract(db).to_pandas()["x"])
+    assert got_s == set(a["x"]) - set(b["x"])
+
+
+def test_series_arithmetic(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    df["w"] = df["v"] * 2 + 1
+    got = df.to_pandas()
+    np.testing.assert_allclose(got["w"], data["v"] * 2 + 1)
+    df["r"] = df["w"] - df["v"]
+    np.testing.assert_allclose(df.to_pandas()["r"], data["v"] + 1)
+
+
+def test_filter_mask(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    got = df[df["k"] > 5].to_pandas()
+    exp = data[data["k"] > 5].reset_index(drop=True)
+    assert_frames_equal(got, exp, sort_by=["k", "v"])
+    # compound mask
+    got2 = df[(df["k"] > 3) & (df["v"] < 0.5)].to_pandas()
+    exp2 = data[(data["k"] > 3) & (data["v"] < 0.5)].reset_index(drop=True)
+    assert_frames_equal(got2, exp2, sort_by=["k", "v"])
+
+
+def test_filter_string_compare(env4, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env4)
+    got = df[df["s"] == "red"].to_pandas()
+    exp = data[data["s"] == "red"].reset_index(drop=True)
+    assert_frames_equal(got, exp, sort_by=["k", "v"])
+    # absent scalar: ordered compare via insertion point
+    got2 = df[df["s"] < "green!"].to_pandas()
+    exp2 = data[data["s"] < "green!"].reset_index(drop=True)
+    assert_frames_equal(got2, exp2, sort_by=["k", "v"])
+
+
+def test_series_reductions(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    assert df["k"].sum() == data["k"].sum()
+    assert df["k"].min() == data["k"].min()
+    assert df["k"].max() == data["k"].max()
+    assert df["k"].count() == len(data)
+    np.testing.assert_allclose(df["v"].mean(), data["v"].mean())
+    assert df["s"].nunique() == data["s"].nunique()
+    assert df["s"].min() == data["s"].min()
+
+
+def test_series_isna_fillna(env4):
+    data = pd.DataFrame({"s": ["a", None, "b", None, "c"],
+                         "f": [1.0, np.nan, 3.0, 4.0, np.nan]})
+    df = ct.DataFrame(data, env=env4)
+    assert df["s"].isna().to_numpy().tolist() == [False, True, False, True,
+                                                  False]
+    assert df["f"].isna().to_numpy().tolist() == [False, True, False, False,
+                                                  True]
+    filled = df["s"].fillna("zz")
+    assert filled.to_numpy().tolist() == ["a", "zz", "b", "zz", "c"]
+    ff = df["f"].fillna(0.0)
+    np.testing.assert_allclose(ff.to_numpy(), [1.0, 0.0, 3.0, 4.0, 0.0])
+
+
+def test_head_tail_slice(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    pd.testing.assert_frame_equal(df.head(3).to_pandas(),
+                                  data.head(3).reset_index(drop=True),
+                                  check_dtype=False)
+    pd.testing.assert_frame_equal(df.tail(3).to_pandas(),
+                                  data.tail(3).reset_index(drop=True),
+                                  check_dtype=False)
+    pd.testing.assert_frame_equal(df[10:20].to_pandas(),
+                                  data[10:20].reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_setitem_host_array(env8, rng):
+    data = pdf(rng)
+    df = ct.DataFrame(data, env=env8)
+    df["z"] = np.arange(len(data))
+    got = df.to_pandas()
+    assert got["z"].tolist() == list(range(len(data)))
+    df["c"] = 7
+    assert (df.to_pandas()["c"] == 7).all()
+
+
+def test_concat_frames(env4, rng):
+    a, b = pdf(rng, 30), pdf(rng, 20)
+    da, db = ct.DataFrame(a, env=env4), ct.DataFrame(b, env=env4)
+    got = ct.concat([da, db])
+    assert len(got) == 50
+    assert_frames_equal(got.to_pandas(), pd.concat([a, b], ignore_index=True),
+                        sort_by=["k", "v"])
+
+
+def test_equals_method(env4, rng):
+    data = pdf(rng)
+    d1 = ct.DataFrame(data, env=env4)
+    d2 = ct.DataFrame(data.copy(), env=env4)
+    assert d1.equals(d2)
+    assert d1.equals(ct.DataFrame(data.sample(frac=1.0, random_state=0),
+                                  env=env4), ordered=False)
+
+
+def test_df_reductions(env4, rng):
+    data = pd.DataFrame({"a": rng.integers(0, 50, 40),
+                         "b": rng.random(40)})
+    df = ct.DataFrame(data, env=env4)
+    s = df.sum()
+    assert s["a"] == data["a"].sum()
+    np.testing.assert_allclose(s["b"], data["b"].sum())

@@ -1,0 +1,94 @@
+"""Indexing tests (reference cpp/test/indexing_test.cpp, pycylon
+test_indexing.py loc/iloc semantics)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.status import CylonIndexError, CylonKeyError
+
+
+@pytest.fixture()
+def df(env4, rng):
+    data = pd.DataFrame({
+        "id": [10, 20, 30, 40, 50, 60, 70, 80],
+        "v": np.arange(8) * 1.5,
+        "s": list("abcdefgh"),
+    })
+    return ct.DataFrame(data, env=env4), data
+
+
+def test_iloc_scalar_slice(df):
+    d, data = df
+    assert d.iloc[3].to_pandas()["id"].tolist() == [40]
+    assert d.iloc[-1].to_pandas()["id"].tolist() == [80]
+    got = d.iloc[2:5].to_pandas()
+    pd.testing.assert_frame_equal(got, data.iloc[2:5].reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_iloc_list(df):
+    d, data = df
+    got = d.iloc[[1, 4, 6]].to_pandas()
+    pd.testing.assert_frame_equal(
+        got, data.iloc[[1, 4, 6]].reset_index(drop=True), check_dtype=False)
+
+
+def test_iloc_out_of_range(df):
+    d, _ = df
+    with pytest.raises(CylonIndexError):
+        d.iloc[99]
+
+
+def test_loc_labels(df):
+    d, data = df
+    di = d.set_index("id")
+    got = di.loc[[20, 50]].to_pandas()
+    assert got.index.tolist() == [20, 50]
+    assert got["s"].tolist() == ["b", "e"]
+
+
+def test_loc_label_slice_inclusive(df):
+    d, data = df
+    di = d.set_index("id")
+    got = di.loc[30:60].to_pandas()
+    assert got.index.tolist() == [30, 40, 50, 60]  # both ends inclusive
+
+
+def test_loc_string_index(df):
+    d, _ = df
+    ds = d.set_index("s")
+    got = ds.loc[["c", "f"]].to_pandas()
+    assert got["id"].tolist() == [30, 60]
+
+
+def test_loc_missing_label(df):
+    d, _ = df
+    with pytest.raises(CylonKeyError):
+        d.set_index("id").loc[[999]]
+
+
+def test_loc_column_selection(df):
+    d, _ = df
+    di = d.set_index("id")
+    got = di.loc[[20, 40], "v"].to_pandas()
+    assert list(got.columns) == ["v"]
+    assert got.index.tolist() == [20, 40]
+
+
+def test_index_survives_filter_sort(df):
+    d, data = df
+    di = d.set_index("id")
+    f = di[di["v"] > 3.0].sort_values("v", ascending=False)
+    got = f.to_pandas()
+    exp = data.set_index("id")
+    exp = exp[exp["v"] > 3.0].sort_values("v", ascending=False)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_range_loc(df):
+    d, data = df
+    got = d.loc[2:4].to_pandas()  # inclusive on range index
+    pd.testing.assert_frame_equal(got, data.iloc[2:5].reset_index(drop=True),
+                                  check_dtype=False)

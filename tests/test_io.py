@@ -1,0 +1,93 @@
+"""IO tests (reference python test_io.py, test_parquet.py,
+distributed_io.py read/write semantics)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.io import (read_csv, read_csv_dist, read_json, read_parquet,
+                          read_parquet_dist, write_csv, write_csv_dist,
+                          write_parquet, write_parquet_dist)
+
+
+@pytest.fixture()
+def data(rng):
+    return pd.DataFrame({
+        "k": rng.integers(0, 50, 100),
+        "v": rng.random(100).round(6),
+        "s": rng.choice(["aa", "bb", "cc"], 100),
+    })
+
+
+def test_csv_roundtrip(tmp_path, env4, data):
+    p = tmp_path / "t.csv"
+    data.to_csv(p, index=False)
+    t = read_csv(p, env4)
+    pd.testing.assert_frame_equal(t.to_pandas(), data, check_dtype=False)
+    out = tmp_path / "o.csv"
+    write_csv(t, out)
+    pd.testing.assert_frame_equal(pd.read_csv(out), data, check_dtype=False)
+
+
+def test_csv_glob_multifile(tmp_path, env4, data):
+    for i in range(3):
+        data.iloc[i * 30:(i + 1) * 30].to_csv(tmp_path / f"part{i}.csv",
+                                              index=False)
+    t = read_csv(str(tmp_path / "part*.csv"), env4)
+    assert t.row_count == 90
+
+
+def test_parquet_roundtrip(tmp_path, env4, data):
+    p = tmp_path / "t.parquet"
+    data.to_parquet(p, index=False)
+    t = read_parquet(p, env4)
+    pd.testing.assert_frame_equal(t.to_pandas(), data, check_dtype=False)
+    out = tmp_path / "o.parquet"
+    write_parquet(t, out)
+    pd.testing.assert_frame_equal(pd.read_parquet(out), data,
+                                  check_dtype=False)
+
+
+def test_json_roundtrip(tmp_path, env4, data):
+    p = tmp_path / "t.jsonl"
+    data.to_json(p, orient="records", lines=True)
+    t = read_json(p, env4)
+    got = t.to_pandas()
+    pd.testing.assert_frame_equal(got, data.reset_index(drop=True),
+                                  check_dtype=False, check_exact=False)
+
+
+def test_read_csv_dist_file_division(tmp_path, env4, data):
+    sizes = [40, 25, 20, 15]
+    off = 0
+    for i, s in enumerate(sizes):
+        data.iloc[off:off + s].to_csv(tmp_path / f"f{i}.csv", index=False)
+        off += s
+    t = read_csv_dist(str(tmp_path / "f*.csv"), env4)
+    assert t.row_count == 100
+    # rank i got file i (4 files, 4 ranks)
+    assert t.valid_counts.tolist() == sizes
+
+
+def test_read_parquet_dist_balancing(tmp_path, env4, data):
+    p = tmp_path / "t.parquet"
+    data.to_parquet(p, index=False, row_group_size=10)
+    t = read_parquet_dist(str(p), env4)
+    assert t.row_count == 100
+    # greedy balancing: 10 groups of 10 rows over 4 ranks -> 20..30 each
+    assert max(t.valid_counts) <= 30
+
+
+def test_write_dist(tmp_path, env4, data):
+    t = read_csv_dist_or_pandas = ct.Table.from_pandas(data, env4)
+    files = write_csv_dist(t, str(tmp_path / "out.csv"))
+    assert len(files) == 4
+    back = pd.concat([pd.read_csv(f) for f in files], ignore_index=True)
+    pd.testing.assert_frame_equal(back, data, check_dtype=False)
+    pfiles = write_parquet_dist(t, str(tmp_path / "out.parquet"))
+    back2 = pd.concat([pd.read_parquet(f) for f in pfiles],
+                      ignore_index=True)
+    pd.testing.assert_frame_equal(back2, data, check_dtype=False)
