@@ -36,9 +36,11 @@ class Column:
     # -- construction ------------------------------------------------------
     @staticmethod
     def from_numpy(arr: np.ndarray, type: LogicalType | None = None) -> "Column":
-        """Build from a host array; encodes strings/objects, splits NaN into
-        validity for floats is *not* done here (NaN stays a float payload,
-        matching pandas semantics)."""
+        """Build a HOST column from a host array (data stays numpy — no
+        device/backend is touched; ``Table`` factories place columns onto the
+        env's devices explicitly, so ingestion never initializes the default
+        backend).  Encodes strings/objects; NaN stays a float payload,
+        matching pandas semantics."""
         arr = np.asarray(arr)
         if arr.dtype.kind in ("U", "S", "O"):
             return Column._encode_strings(arr)
@@ -49,8 +51,7 @@ class Column:
             arr = arr.astype("datetime64[ns]").astype("int64", copy=False)
         elif arr.dtype.kind == "m":
             arr = arr.astype("timedelta64[ns]").astype("int64", copy=False)
-        data = jnp.asarray(arr.astype(phys, copy=False))
-        return Column(data, lt)
+        return Column(arr.astype(phys, copy=False), lt)
 
     @staticmethod
     def _encode_strings(arr: np.ndarray) -> "Column":
@@ -69,9 +70,9 @@ class Column:
         # np.unique returns a *sorted* dictionary so code order == lexical
         # order: sorts/joins on codes are exact on the decoded values.
         dictionary, codes = np.unique(values, return_inverse=True)
-        data = jnp.asarray(codes.astype(np.int32))
-        validity = jnp.asarray(~mask) if mask.any() else None
-        return Column(data, LogicalType.STRING, validity, dictionary)
+        validity = ~mask if mask.any() else None
+        return Column(codes.astype(np.int32), LogicalType.STRING, validity,
+                      dictionary)
 
     # -- properties --------------------------------------------------------
     def __len__(self) -> int:

@@ -69,7 +69,7 @@ class Table:
         env = env or default_env()
         cols = {k: Column.from_numpy(np.asarray(v)) for k, v in data.items()}
         if env.world_size == 1:
-            return Table(cols, env)
+            return Table(_place_local(cols, env), env)
         return _distribute(cols, env)
 
     @staticmethod
@@ -77,7 +77,7 @@ class Table:
         env = env or default_env()
         cols = {str(k): Column.from_numpy(df[k].to_numpy()) for k in df.columns}
         if env.world_size == 1:
-            return Table(cols, env)
+            return Table(_place_local(cols, env), env)
         return _distribute(cols, env)
 
     @staticmethod
@@ -89,6 +89,17 @@ class Table:
     def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
                    env: CylonEnv | None = None) -> "Table":
         return Table.from_pydict(dict(zip(names, arrays)), env)
+
+    @staticmethod
+    def from_host_columns(cols: Mapping[str, Column],
+                          env: CylonEnv | None = None) -> "Table":
+        """Place already-typed HOST columns (numpy data/validity, logical
+        type and dictionary preserved) onto the env — the dtype-faithful
+        ingest path (no pandas object round-trip)."""
+        env = env or default_env()
+        if env.world_size == 1:
+            return Table(_place_local(dict(cols), env), env)
+        return _distribute(dict(cols), env)
 
     # -- schema ------------------------------------------------------------
     @property
@@ -179,6 +190,20 @@ class Table:
     def __repr__(self) -> str:  # pragma: no cover
         return (f"Table(rows={self.row_count}, cols={self.column_names}, "
                 f"world={self._env.world_size}, cap={self.capacity})")
+
+
+def _place_local(cols: dict[str, Column], env: CylonEnv) -> dict[str, Column]:
+    """Place host-built columns onto the env's (single) device — only the
+    env's devices are ever touched, never the process default backend (the
+    round-1 multichip dryrun died on exactly that leak)."""
+    sharding = env.sharding()
+    out = {}
+    for k, c in cols.items():
+        data = jax.device_put(np.asarray(c.data), sharding)
+        v = (jax.device_put(np.asarray(c.validity), sharding)
+             if c.validity is not None else None)
+        out[k] = Column(data, c.type, v, c.dictionary)
+    return out
 
 
 def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:

@@ -95,9 +95,10 @@ class CPUMeshConfig(TPUConfig):
         if self.devices is not None:
             devs = list(self.devices)
         else:
-            devs = [d for d in jax.devices() if d.platform == "cpu"]
-            if not devs:
-                devs = list(jax.devices("cpu"))
+            # jax.devices("cpu") initializes ONLY the cpu client — never call
+            # plain jax.devices() here, it would initialize the default
+            # (accelerator) backend just to filter it out again.
+            devs = list(jax.devices("cpu"))
         if self.world_size is not None:
             if self.world_size > len(devs):
                 raise InvalidError(

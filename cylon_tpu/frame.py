@@ -179,7 +179,9 @@ class DataFrame:
         if isinstance(key, Series):
             if key.dtype.value != "bool":
                 raise InvalidError("filter mask must be a bool series")
-            return self._wrap(filter_table(self._table, key.column.data),
+            from .relational.common import valid_flag
+            return self._wrap(filter_table(self._table,
+                                           valid_flag(key.column)),
                               keep_index=True)
         if isinstance(key, slice):
             start, stop, step = key.indices(len(self))
@@ -193,8 +195,12 @@ class DataFrame:
         if not isinstance(name, str):
             raise CylonKeyError("column name must be a string")
         if isinstance(value, Series):
-            if value.column.data.shape[0] != self._table.capacity * \
-                    self.env.world_size:
+            # same capacity is not enough: a column from a differently-
+            # partitioned frame would silently misalign rows across shards
+            if (value.column.data.shape[0] != self._table.capacity *
+                    self.env.world_size
+                    or not np.array_equal(value.valid_counts,
+                                          self._table.valid_counts)):
                 raise InvalidError("series layout mismatch")
             col = value.column
         elif np.isscalar(value) or isinstance(value, (int, float, bool, str)):
@@ -331,14 +337,15 @@ class DataFrame:
 
     # -- reductions over all columns ---------------------------------------
     def _agg_all(self, op: str):
+        from .status import CylonTypeError
         import pandas as pd
         out = {}
         for name in self.columns:
             s = self[name]
             try:
                 out[name] = getattr(s, op)()
-            except Exception:
-                continue
+            except CylonTypeError:
+                continue  # column type doesn't support this reduction
         return pd.Series(out)
 
     def sum(self):

@@ -39,9 +39,9 @@ def _label_mask(col, labels) -> Any:
             if pos < len(d) and d[pos] == lb:
                 codes.append(pos)
         if not codes:
-            return jnp.zeros(col.data.shape[0], bool)
-        return jnp.isin(col.data, jnp.asarray(codes, col.data.dtype))
-    arr = jnp.asarray(np.asarray(labels).astype(np.dtype(col.data.dtype)))
+            return jnp.zeros_like(col.data, dtype=bool)
+        return jnp.isin(col.data, np.asarray(codes, col.data.dtype))
+    arr = np.asarray(labels).astype(np.dtype(col.data.dtype))
     return jnp.isin(col.data, arr)
 
 
@@ -97,14 +97,24 @@ class LocIndexer:
                 mask = m2 if mask is None else (mask & m2)
             if mask is None:
                 return df
-            out = df._wrap(filter_table(df._table, mask.column.data))
+            from ..relational.common import valid_flag
+            out = df._wrap(filter_table(df._table, valid_flag(mask.column)))
             out._index = df._index
             return out
         labels = [key] if np.isscalar(key) or isinstance(key, str) else list(key)
+        # pandas raises when ANY requested label is absent, not only when all
+        # are: check membership against the index column's values
+        values = df[name].to_numpy()
+        try:  # dtype-matched isin takes numpy's sort-based path; the object
+            labels_arr = np.asarray(labels, dtype=values.dtype)
+        except (TypeError, ValueError):  # fallback compares elementwise
+            labels_arr = np.asarray(labels, dtype=object)
+        present = np.isin(labels_arr, values)
+        if not present.all():
+            missing = [lb for lb, ok in zip(labels, present) if not ok]
+            raise CylonKeyError(f"labels {missing!r} not found in index")
         mask = _label_mask(col, labels)
         out = df._wrap(filter_table(df._table, mask))
-        if out._table.row_count == 0:
-            raise CylonKeyError(f"labels {labels!r} not found in index")
         out._index = df._index
         return out
 
@@ -135,13 +145,48 @@ class ILocIndexer:
                 raise CylonIndexError(f"position {key} out of range [0,{n})")
             out = df._wrap(slice_table(df._table, i, 1))
         else:
-            # positional list: filter on global position
-            pos = sorted(int(p) + (n if p < 0 else 0) for p in key)
-            if pos and not (0 <= pos[0] and pos[-1] < n):
+            # positional list: pandas order/duplicate semantics — rows come
+            # back in the REQUESTED order, duplicates repeated.  Device work
+            # slices contiguous runs of the sorted unique positions (not one
+            # launch per position); the k selected rows are then reordered
+            # host-side and re-ingested.
+            pos = [int(p) + (n if int(p) < 0 else 0) for p in key]
+            if any(not 0 <= p < n for p in pos):
                 raise CylonIndexError(f"positions out of range [0,{n})")
-            from ..relational import concat_tables
-            parts = [slice_table(df._table, p, 1) for p in pos]
-            out = df._wrap(concat_tables(parts)) if parts else df[0:0]
+            if not pos:
+                out = df[0:0]
+            else:
+                from ..relational import concat_tables
+                uniq = sorted(set(pos))
+                runs = []
+                lo = prev = uniq[0]
+                for p in uniq[1:]:
+                    if p == prev + 1:
+                        prev = p
+                        continue
+                    runs.append((lo, prev - lo + 1))
+                    lo = prev = p
+                runs.append((lo, prev - lo + 1))
+                parts = [slice_table(df._table, o, ln) for o, ln in runs]
+                picked = parts[0] if len(parts) == 1 else concat_tables(parts)
+                order = {p: i for i, p in enumerate(uniq)}
+                sel = np.asarray([order[p] for p in pos], np.int64)
+                # dtype-faithful host reorder (a pandas round-trip would
+                # stringify nullable int/bool/datetime columns)
+                from ..core.column import Column
+                from ..core.table import Table
+                w = picked.env.world_size
+                cap = picked.capacity
+                gpos = np.concatenate(
+                    [np.arange(i * cap, i * cap + int(picked.valid_counts[i]))
+                     for i in range(w)]) if cap else np.zeros(0, np.int64)
+                host_cols = {}
+                for cn, c in picked.columns.items():
+                    data = np.asarray(c.data)[gpos][sel]
+                    v = (np.asarray(c.validity)[gpos][sel]
+                         if c.validity is not None else None)
+                    host_cols[cn] = Column(data, c.type, v, c.dictionary)
+                out = df._wrap(Table.from_host_columns(host_cols, df.env))
         out._index = df._index
         if cols is not None:
             cols = [cols] if isinstance(cols, str) else list(cols)

@@ -25,9 +25,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-SENT_L = jnp.int32(1 << 30)
-SENT_R = jnp.int32((1 << 30) + 1)
+# numpy scalars, not jnp: a module-level jnp constant would eagerly
+# initialize the default backend at import time (round-1 dryrun crash)
+SENT_L = np.int32(1 << 30)
+SENT_R = np.int32((1 << 30) + 1)
 
 
 def _effective_ids(l_ids, r_ids, l_mask, r_mask):

@@ -69,9 +69,11 @@ def hash_targets(mesh: Mesh, key_datas, key_valids, valid_counts: np.ndarray):
     args = list(key_datas)
     if with_valids:
         cap_total = key_datas[0].shape[0]
-        args += [v if v is not None else jnp.ones(cap_total, bool)
+        # numpy sidecars: jit places them per the shard_map specs on the
+        # mesh; eager jnp.* would create on the default backend
+        args += [v if v is not None else np.ones(cap_total, bool)
                  for v in key_valids]
-    vc = jnp.asarray(valid_counts, jnp.int32)
+    vc = np.asarray(valid_counts, np.int32)
     return _hash_targets_fn(mesh, w, len(key_datas), with_valids)(vc, *args)
 
 
@@ -160,6 +162,5 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple):
     per_dest = counts.sum(axis=0)
     out_cap = config.pow2ceil(int(per_dest.max()) if per_dest.size else 1)
     fn = _exchange_fn(mesh, w, block, out_cap)
-    counts_dev = jnp.asarray(counts, jnp.int32)
-    new_cols = fn(tgt, counts_dev, tuple(cols))
+    new_cols = fn(tgt, np.asarray(counts, np.int32), tuple(cols))
     return new_cols, per_dest.astype(np.int64)

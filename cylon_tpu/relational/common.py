@@ -35,6 +35,16 @@ def live_mask(vc: jax.Array, cap: int) -> jax.Array:
     return jnp.arange(cap) < vc[my]
 
 
+def valid_flag(col: Column):
+    """Boolean filter payload of a bool column with null rows forced False
+    (pandas/Arrow semantics: a null predicate never selects a row).  Every
+    filter-on-bool-column call site must go through this."""
+    flag = col.data
+    if col.validity is not None:
+        flag = flag & col.validity
+    return flag
+
+
 def col_arrays(cols: list[Column]):
     """Split columns into parallel (datas, valids) tuples; valids entries may
     be None (all-valid) — None is an empty pytree so it passes through jit."""
@@ -68,11 +78,13 @@ def unify_dictionaries(a: Column, b: Column) -> tuple[Column, Column]:
             and np.array_equal(a.dictionary, b.dictionary)):
         return a, b
     merged = np.unique(np.concatenate([a.dictionary, b.dictionary]))
-    map_a = jnp.asarray(np.searchsorted(merged, a.dictionary).astype(np.int32))
-    map_b = jnp.asarray(np.searchsorted(merged, b.dictionary).astype(np.int32))
-    ca = Column(map_a[jnp.clip(a.data, 0, len(a.dictionary) - 1)],
+    # recode maps stay numpy; jnp.take anchored on the committed codes runs
+    # on the codes' device (no default-backend array creation)
+    map_a = np.searchsorted(merged, a.dictionary).astype(np.int32)
+    map_b = np.searchsorted(merged, b.dictionary).astype(np.int32)
+    ca = Column(jnp.take(map_a, jnp.clip(a.data, 0, len(a.dictionary) - 1)),
                 LogicalType.STRING, a.validity, merged)
-    cb = Column(map_b[jnp.clip(b.data, 0, len(b.dictionary) - 1)],
+    cb = Column(jnp.take(map_b, jnp.clip(b.data, 0, len(b.dictionary) - 1)),
                 LogicalType.STRING, b.validity, merged)
     return ca, cb
 
@@ -85,8 +97,8 @@ def unify_dictionaries_many(cols: list[Column]) -> list[Column]:
     merged = np.unique(np.concatenate(dicts))
     out = []
     for c in cols:
-        m = jnp.asarray(np.searchsorted(merged, c.dictionary).astype(np.int32))
-        out.append(Column(m[jnp.clip(c.data, 0, len(c.dictionary) - 1)],
+        m = np.searchsorted(merged, c.dictionary).astype(np.int32)
+        out.append(Column(jnp.take(m, jnp.clip(c.data, 0, len(c.dictionary) - 1)),
                           LogicalType.STRING, c.validity, merged))
     return out
 

@@ -84,6 +84,16 @@ def _group_keys(by_datas, by_valids, vc):
     return gids, n_groups.astype(jnp.int32), mask
 
 
+def _value_mask(mask, val, valid):
+    """Row mask for aggregation payloads: live row AND valid AND (for float
+    payloads) not-NaN — pandas skipna=True semantics (NaN is stored as a
+    float payload with validity=None, so validity alone misses it)."""
+    vmask = mask if valid is None else (mask & valid)
+    if jnp.issubdtype(val.dtype, jnp.floating):
+        vmask = vmask & ~jnp.isnan(val)
+    return vmask
+
+
 def _rep_keys(by_datas, by_valids, gids, seg_cap):
     """Representative key row per group (first source index)."""
     rep = gbk.group_first_index(gids, seg_cap)
@@ -104,7 +114,7 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int):
         key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         inter_out = []
         for i, op in enumerate(ops):
-            vmask = mask if val_valids[i] is None else (mask & val_valids[i])
+            vmask = _value_mask(mask, val_datas[i], val_valids[i])
             inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap, vmask)
             inter_out.append(tuple(inter[k] for k in INTER_NAMES[op]))
         return key_out, kval_out, tuple(inter_out), n_groups.reshape(1)
@@ -146,7 +156,7 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int):
         key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         res_d, res_v = [], []
         for i, (op, q) in enumerate(specs):
-            vmask = mask if val_valids[i] is None else (mask & val_valids[i])
+            vmask = _value_mask(mask, val_datas[i], val_valids[i])
             if op in gbk.ASSOCIATIVE:
                 inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap,
                                             vmask)
@@ -248,7 +258,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         by_datas, by_valids = col_arrays(by_cols)
         val_datas = tuple(c.data for c in val_cols)
         val_valids = tuple(c.validity for c in val_cols)
-        vc = jnp.asarray(table.valid_counts, jnp.int32)
+        vc = np.asarray(table.valid_counts, np.int32)
         ops_t = tuple(op for _, op, _, _ in specs)
         seg_cap = max(table.capacity, 1)
         key_out, kval_out, inter_out, n_groups = _combine_fn(
@@ -275,7 +285,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         inter_by_op = tuple(
             tuple(shuffled.column(cn).data for cn in inames)
             for inames in inames_by_op)
-        vc2 = jnp.asarray(shuffled.valid_counts, jnp.int32)
+        vc2 = np.asarray(shuffled.valid_counts, np.int32)
         key2, kval2, res_d, res_v, ng2 = _final_fn(
             env.mesh, ops_t, max(shuffled.capacity, 1), ddof)(
                 vc2, s_by_datas, s_by_valids, inter_by_op)
@@ -291,7 +301,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     by_datas, by_valids = col_arrays([work.column(n) for n in by])
     val_datas = tuple(work.column(c).data for c, _, _, _ in specs)
     val_valids = tuple(work.column(c).validity for c, _, _, _ in specs)
-    vc = jnp.asarray(work.valid_counts, jnp.int32)
+    vc = np.asarray(work.valid_counts, np.int32)
     spec_t = tuple((op, q) for _, op, q, _ in specs)
     key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
         env.mesh, spec_t, max(work.capacity, 1), ddof)(

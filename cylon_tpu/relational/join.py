@@ -137,8 +137,8 @@ def join_tables(left: Table, right: Table, left_on, right_on,
 
     l_datas, l_valids = col_arrays([lwork.column(n) for n in left_on])
     r_datas, r_valids = col_arrays([rwork.column(n) for n in right_on])
-    vcl = jnp.asarray(lwork.valid_counts, jnp.int32)
-    vcr = jnp.asarray(rwork.valid_counts, jnp.int32)
+    vcl = np.asarray(lwork.valid_counts, np.int32)
+    vcr = np.asarray(rwork.valid_counts, np.int32)
 
     counts = np.asarray(_count_fn(env.mesh, how)(
         vcl, vcr, l_datas, l_valids, r_datas, r_valids)).astype(np.int64)

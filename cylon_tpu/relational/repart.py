@@ -119,8 +119,9 @@ def _order_preserving_targets(table: Table, dest_counts: np.ndarray):
     bounds = np.cumsum(dest_counts).astype(np.int64) - 1
     probe = next(iter(table.columns.values())).data
     fn = _range_targets_fn(env.mesh, table.capacity)
-    return fn(jnp.asarray(vc, jnp.int32), jnp.asarray(offs),
-              jnp.asarray(bounds), probe)
+    # sidecars stay numpy: jit places them per the shard_map specs on the
+    # env's mesh; an eager jnp.asarray would land on the default backend
+    return fn(np.asarray(vc, np.int32), offs, bounds, probe)
 
 
 def repartition(table: Table, rows_per_partition=None) -> Table:
@@ -219,8 +220,8 @@ def slice_table(table: Table, offset: int, length: int) -> Table:
     datas = tuple(c.data for _, c in cols)
     valids = tuple(c.validity for _, c in cols)
     fn = _compact_range_fn(env.mesh, table.capacity, out_cap, len(cols))
-    out_d, out_v = fn(jnp.asarray(vc, jnp.int32), jnp.asarray(offs),
-                      jnp.asarray(lo), jnp.asarray(hi), datas, valids)
+    out_d, out_v = fn(np.asarray(vc, np.int32), offs,
+                      np.int64(lo), np.int64(hi), datas, valids)
     names = [n for n, _ in cols]
     types = [c.type for _, c in cols]
     dicts = [c.dictionary for _, c in cols]
@@ -273,7 +274,7 @@ def filter_table(table: Table, flag) -> Table:
     from .common import rebuild_like
     env = table.env
     cap = max(table.capacity, 1)
-    vc = jnp.asarray(table.valid_counts, jnp.int32)
+    vc = np.asarray(table.valid_counts, np.int32)
     counts = np.asarray(_filter_count_fn(env.mesh, cap)(vc, flag)
                         ).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
@@ -356,8 +357,8 @@ def concat_tables(tables: list[Table]) -> Table:
     valids_by_t = tuple(tuple(col_sets[c][t].validity for c in range(len(names)))
                         for t in range(len(tables)))
     fn = _concat_fn(env.mesh, caps, out_cap, with_valid)
-    vcs_dev = tuple(jnp.asarray(v, jnp.int32) for v in vcs)
-    out_d, out_v = fn(vcs_dev, datas_by_t, valids_by_t)
+    vcs_host = tuple(np.asarray(v, np.int32) for v in vcs)
+    out_d, out_v = fn(vcs_host, datas_by_t, valids_by_t)
     types = [cs[0].type for cs in col_sets]
     dicts = [cs[0].dictionary for cs in col_sets]
     return build_table(names, out_d, out_v, types, dicts, new_valid, env)

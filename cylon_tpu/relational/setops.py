@@ -87,7 +87,7 @@ def unique_table(table: Table, subset=None, keep: str = "first") -> Table:
     if env.world_size > 1:
         table = shuffle_table(table, subset)
     key_datas, key_valids = col_arrays([table.column(n) for n in subset])
-    vc = jnp.asarray(table.valid_counts, jnp.int32)
+    vc = np.asarray(table.valid_counts, np.int32)
     counts = np.asarray(_unique_count_fn(env.mesh, keep)(
         vc, key_datas, key_valids)).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
@@ -184,8 +184,8 @@ def set_operation(a: Table, b: Table, op: str) -> Table:
         b = shuffle_table(b, names)
     a_datas, a_valids = col_arrays([a.column(n) for n in names])
     b_datas, b_valids = col_arrays([b.column(n) for n in names])
-    vca = jnp.asarray(a.valid_counts, jnp.int32)
-    vcb = jnp.asarray(b.valid_counts, jnp.int32)
+    vca = np.asarray(a.valid_counts, np.int32)
+    vcb = np.asarray(b.valid_counts, np.int32)
     counts = np.asarray(_setop_count_fn(env.mesh, op)(
         vca, vcb, a_datas, a_valids, b_datas, b_valids)).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
@@ -228,9 +228,12 @@ def equals(a: Table, b: Table, ordered: bool = True) -> bool:
         return False
     if a.row_count == 0:
         return True
+    from ..status import CylonTypeError
     try:
         a, b = _align_schemas(a, b)
-    except Exception:
+    except CylonTypeError:
+        # no common key type => schemas are genuinely incomparable;
+        # any other exception is a real bug and propagates
         return False
     if not ordered:
         from .sort import sort_table
@@ -251,6 +254,6 @@ def equals(a: Table, b: Table, ordered: bool = True) -> bool:
     kinds = tuple("f" if a.column(n).type in (LogicalType.FLOAT32,
                                               LogicalType.FLOAT64) else "i"
                   for n in names)
-    vc = jnp.asarray(a.valid_counts, jnp.int32)
+    vc = np.asarray(a.valid_counts, np.int32)
     res = _equals_fn(env.mesh, kinds)(vc, a_datas, a_valids, b_datas, b_valids)
     return bool(np.asarray(res).all())

@@ -127,7 +127,7 @@ def _pick_splitters(sample_ops, live, w: int):
         pos = min(max((n_live * j) // w, 0), max(n_live - 1, 0))
         take.append(order[pos])
     take = np.asarray(take, np.int64)
-    return tuple(jnp.asarray(o[take]) for o in ops_np)
+    return tuple(o[take] for o in ops_np)
 
 
 def sort_table(table: Table, by, ascending=True,
@@ -142,7 +142,7 @@ def sort_table(table: Table, by, ascending=True,
     npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
     by_cols = [table.column(n) for n in by]
     by_datas, by_valids = col_arrays(by_cols)
-    vc = jnp.asarray(table.valid_counts, jnp.int32)
+    vc = np.asarray(table.valid_counts, np.int32)
     w = env.world_size
 
     if w > 1 and table.row_count > 0:
@@ -157,7 +157,7 @@ def sort_table(table: Table, by, ascending=True,
         table = exchange_by_targets(table, tgt, counts)
         by_cols = [table.column(n) for n in by]
         by_datas, by_valids = col_arrays(by_cols)
-        vc = jnp.asarray(table.valid_counts, jnp.int32)
+        vc = np.asarray(table.valid_counts, np.int32)
 
     # ---- local sort per shard -------------------------------------------
     items = list(table.columns.items())

@@ -235,8 +235,10 @@ class Series:
             if self._col.type in (LogicalType.FLOAT32, LogicalType.FLOAT64):
                 return self._wrap(jnp.isnan(self._col.data), None,
                                   LogicalType.BOOL)
-            return self._wrap(jnp.zeros(self._col.data.shape[0], bool), None,
-                              LogicalType.BOOL)
+            # zeros_like preserves the source's device/sharding (never the
+            # default backend, unlike a bare jnp.zeros)
+            return self._wrap(jnp.zeros_like(self._col.data, dtype=bool),
+                              None, LogicalType.BOOL)
         out = jnp.logical_not(self._col.validity)
         if self._col.type in (LogicalType.FLOAT32, LogicalType.FLOAT64):
             out = out | jnp.isnan(self._col.data)
@@ -253,9 +255,9 @@ class Series:
             pos = int(np.searchsorted(d, value))
             if not (pos < len(d) and d[pos] == value):
                 newd = np.insert(d, pos, value)
-                remap = jnp.asarray(
-                    np.searchsorted(newd, d).astype(np.int32))
-                codes = remap[jnp.clip(self._col.data, 0, len(d) - 1)]
+                remap = np.searchsorted(newd, d).astype(np.int32)
+                codes = jnp.take(remap,
+                                 jnp.clip(self._col.data, 0, len(d) - 1))
                 col = Column(codes, LogicalType.STRING, self._col.validity,
                              newd)
             else:
@@ -266,7 +268,7 @@ class Series:
             data = jnp.where(col.validity, col.data, jnp.int32(code))
             return self._wrap(data, None, LogicalType.STRING, col.dictionary)
         na = self.isna()._col.data
-        data = jnp.where(na, jnp.asarray(value, self._col.data.dtype),
+        data = jnp.where(na, np.asarray(value, self._col.data.dtype),
                          self._col.data)
         return self._wrap(data, None, self._col.type)
 
@@ -283,30 +285,30 @@ class Series:
             raise CylonTypeError(f"{kind} on string series")
         mesh = self._env.mesh
         cap = len(col) // max(valid.shape[0], 1)
-        partials = _reduce_fn(mesh, kind, max(cap, 1))(
-            jnp.asarray(valid, jnp.int32), col.data,
+        out, cnt = _reduce_fn(mesh, kind, max(cap, 1))(
+            np.asarray(valid, np.int32), col.data,
             col.validity if col.validity is not None
-            else jnp.ones(len(col), bool))
-        parts = np.asarray(partials)
+            else np.ones(len(col), bool))
+        # partials keep the accumulator dtype (int64 stays int64 — no float64
+        # round-trip that would lose precision past 2^53)
+        parts = np.asarray(out)
+        cnts = np.asarray(cnt)
         if kind == "sum":
             if lt not in (LogicalType.FLOAT32, LogicalType.FLOAT64):
-                return int(parts[:, 0].sum())
-            return parts[:, 0].sum()
+                return int(parts.sum())
+            return float(parts.sum())
         if kind == "count":
-            return int(parts[:, 0].sum())
-        if kind == "min":
-            live = parts[:, 1] > 0
-            if not live.any():
-                return None
-            v = parts[live, 0].min()
-        elif kind == "max":
-            live = parts[:, 1] > 0
-            if not live.any():
-                return None
-            v = parts[live, 0].max()
+            return int(parts.sum())
+        live = cnts > 0
+        if not live.any():
+            # pandas: min/max of empty / all-NaN numeric series is nan
+            return None if lt == LogicalType.STRING else float("nan")
+        v = parts[live].min() if kind == "min" else parts[live].max()
         if lt == LogicalType.STRING:
             return str(self._col.dictionary[int(v)])
-        return v
+        if lt in (LogicalType.FLOAT32, LogicalType.FLOAT64):
+            return float(v)
+        return int(v)
 
     def sum(self):
         return self._reduce("sum")
@@ -343,9 +345,10 @@ def _reduce_fn(mesh: Mesh, kind: str, cap: int):
 
     def per_shard(vc, data, validity):
         mask = live_mask(vc, cap) & validity
+        if data.dtype.kind == "f":
+            mask = mask & ~jnp.isnan(data)  # pandas skipna=True
         if kind == "sum":
-            out = jnp.sum(jnp.where(mask, data, 0)).astype(jnp.float64
-                          if data.dtype.kind == "f" else data.dtype)
+            out = jnp.sum(jnp.where(mask, data, 0))
             cnt = jnp.sum(mask)
         elif kind == "count":
             out = jnp.sum(mask)
@@ -362,8 +365,8 @@ def _reduce_fn(mesh: Mesh, kind: str, cap: int):
             cnt = jnp.sum(mask)
         else:
             raise ValueError(kind)
-        return jnp.stack([out.astype(jnp.float64),
-                          cnt.astype(jnp.float64)]).reshape(1, 2)
+        # dtype-preserving partials: int64 sums stay exact past 2^53
+        return out.reshape(1), cnt.astype(jnp.int64).reshape(1)
 
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
-                             out_specs=ROW))
+                             out_specs=(ROW, ROW)))

@@ -1,0 +1,129 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.frame import DataFrame
+from cylon_tpu.status import CylonKeyError, InvalidError
+
+
+def _df(data, env):
+    return DataFrame(pd.DataFrame(data), env=env)
+
+
+class TestNullMaskFilter:
+    """frame.py bool-mask filter must treat null predicate rows as False."""
+
+    def test_null_rows_excluded(self, env1):
+        df = _df({"s": ["a", None, "b"], "v": [1, 2, 3]}, env1)
+        out = df[df["s"] < "b"].to_pandas()
+        assert out["v"].tolist() == [1]
+
+    def test_null_rows_excluded_dist(self, env4):
+        df = _df({"s": ["a", None, "b", "c", None, "a", "b", "c"],
+                  "v": list(range(8))}, env4)
+        out = df[df["s"] < "b"].to_pandas()
+        assert sorted(out["v"].tolist()) == [0, 5]
+
+
+class TestNaNSkippingAggs:
+    """groupby + Series reductions skip float NaN like pandas skipna=True."""
+
+    def test_groupby_sum_skips_nan(self, env1):
+        pdf = pd.DataFrame({"k": [0, 0, 1, 1], "x": [1.0, np.nan, 2.0, 3.0]})
+        df = _df(pdf, env1)
+        got = (df.groupby("k").sum().to_pandas()
+               .sort_values("k").reset_index(drop=True))
+        exp = pdf.groupby("k", as_index=False)["x"].sum()
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_groupby_mean_min_count_skip_nan(self, env4):
+        rng = np.random.default_rng(0)
+        x = rng.random(64)
+        x[::5] = np.nan
+        pdf = pd.DataFrame({"k": rng.integers(0, 4, 64), "x": x})
+        df = _df(pdf, env4)
+        got = (df.groupby("k").agg({"x": ["mean", "min", "count"]})
+               .to_pandas().sort_values("k").reset_index(drop=True))
+        exp = (pdf.groupby("k", as_index=False)
+               .agg(x_mean=("x", "mean"), x_min=("x", "min"),
+                    x_count=("x", "count")))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                      check_exact=False)
+
+    def test_series_sum_skips_nan(self, env1):
+        df = _df({"x": [1.0, np.nan, 2.0]}, env1)
+        assert df["x"].sum() == pytest.approx(3.0)
+        assert df["x"].count() == 2
+        assert df["x"].mean() == pytest.approx(1.5)
+        assert df["x"].min() == pytest.approx(1.0)
+
+
+class TestIlocLocSemantics:
+    def test_iloc_list_order_preserved(self, env1):
+        df = _df({"v": [10, 11, 12, 13, 14]}, env1)
+        assert df.iloc[[3, 1]].to_pandas()["v"].tolist() == [13, 11]
+
+    def test_iloc_list_duplicates(self, env4):
+        df = _df({"v": list(range(16))}, env4)
+        assert df.iloc[[5, 5, 2]].to_pandas()["v"].tolist() == [5, 5, 2]
+
+    def test_loc_partially_missing_label_raises(self, env1):
+        df = _df({"k": [1, 2, 3], "v": [10, 20, 30]}, env1).set_index("k")
+        with pytest.raises(CylonKeyError):
+            df.loc[[1, 99]]
+
+    def test_loc_string_missing_label_raises(self, env1):
+        df = _df({"k": ["a", "b"], "v": [1, 2]}, env1).set_index("k")
+        with pytest.raises(CylonKeyError):
+            df.loc[["a", "zz"]]
+
+
+class TestInt64Precision:
+    def test_sum_beyond_2_53(self, env1):
+        big = (1 << 53) + 1
+        df = _df({"x": np.asarray([big, 2], np.int64)}, env1)
+        assert df["x"].sum() == big + 2  # float64 round-trip would lose the +1
+        assert df["x"].max() == big
+
+
+class TestSetitemLayoutCheck:
+    def test_misaligned_series_rejected(self, env4):
+        # same per-shard capacity (8), different valid_counts -> must reject
+        a = _df({"v": list(range(24))}, env4)          # (6, 6, 6, 6) cap 8
+        b = _df({"w": list(range(24))}, env4)
+        from cylon_tpu.relational import repartition
+        t = repartition(b.table, (8, 8, 4, 4))          # cap 8 too
+        misaligned = DataFrame.from_table(t)
+        assert t.capacity == a.table.capacity
+        with pytest.raises(InvalidError):
+            a["w"] = misaligned["w"]
+
+
+class TestReviewFindings:
+    """Round-2 inline code-review findings."""
+
+    def test_iloc_preserves_nullable_int_dtype(self, env1):
+        # nullable int column (e.g. from an outer join) must survive iloc
+        l = _df({"k": [1, 2], "a": [10, 20]}, env1)
+        r = _df({"k": [2, 3], "b": [5, 6]}, env1)
+        m = l.merge(r, on="k", how="outer").sort_values("k")
+        out = m.iloc[[2, 0]]
+        assert out.dtypes["a"] != "str"
+        pdm = m.to_pandas().reset_index(drop=True)
+        got = out.to_pandas().reset_index(drop=True)
+        exp = pdm.iloc[[2, 0]].reset_index(drop=True)
+        import pandas as pd
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_loc_slice_null_index_excluded(self, env1):
+        df = _df({"k": ["a", None, "b"], "v": [1, 2, 3]}, env1).set_index("k")
+        out = df.loc[:"z"].to_pandas()
+        assert sorted(out["v"].tolist()) == [1, 3]  # null label filters False
+
+    def test_min_of_all_nan_is_nan(self, env1):
+        df = _df({"x": [np.nan, np.nan]}, env1)
+        assert np.isnan(df["x"].min())
+        assert np.isnan(df["x"].max())

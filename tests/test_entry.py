@@ -1,0 +1,64 @@
+"""Driver-entry regression tests.
+
+Round-1 lesson (VERDICT.md): the driver's multichip dryrun must be exercised
+by the suite itself, and it must never touch any backend other than cpu —
+the round-1 dryrun died because ingestion staged arrays on the default
+(accelerator) backend before distributing.  The subprocess test reproduces
+the driver environment (host-device-count flag only, no JAX_PLATFORMS pin)
+and asserts the cpu client is the ONLY initialized backend.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_8():
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_entry_jit_compiles_and_runs():
+    import jax
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        jax.block_until_ready(out)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_touches_only_cpu_backend():
+    """Run the dryrun in a clean subprocess (driver-style env: device-count
+    flag, NO platform pin) and assert no non-cpu backend got initialized."""
+    code = """
+import jax, sys
+sys.path.insert(0, {repo!r})
+import __graft_entry__
+__graft_entry__.dryrun_multichip(8)
+try:
+    from jax._src import xla_bridge
+    backends = set(xla_bridge._backends)
+except Exception:
+    backends = set()  # private probe gone in this jax version: skip assert
+assert backends <= {{"cpu"}}, f"non-cpu backends initialized: {{backends}}"
+print("OK")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run([sys.executable, "-c", code.format(repo=REPO)],
+                       capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
