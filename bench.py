@@ -1,16 +1,24 @@
 """Benchmark driver: distributed join + groupby throughput.
 
 The BASELINE.json north-star workload: inner merge on random int64 keys
-followed by groupby-sum, measured as rows/sec/chip.  Runs on every visible
-accelerator chip (or a virtual CPU mesh when no accelerator is present).
+followed by groupby-sum, measured as rows/sec/chip.  The table shape follows
+the reference's scaling driver (rivanna/scripts/cylon_scaling.py:31-37): two
+int64 columns per side — a key column and a value column — with keys drawn
+from [0, total_rows * 0.9) ("uniqueness factor" u = 0.9), per-rank rows =
+rows_per_chip.  Our pipeline additionally groupby-sums the joined values
+(BASELINE.json: join+groupby).
 
-Prints ONE JSON line:
+Runs on every visible accelerator chip (or a virtual CPU mesh when no
+accelerator is present).  Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "rows/sec/chip", "vs_baseline": N}
 
 vs_baseline anchors to the reference's published weak-scaling join number
 (BASELINE.md: 1M rows/rank at 0.60 s/iter on Summit, 42 ranks/node =>
 ~1.67M rows/sec/rank for join alone; we use the same per-worker rows/sec
 denominator for the join+groupby pipeline).
+
+Flags: --rows=N (per chip; default 32M on TPU, 1M on CPU), --unique=F,
+--iters=K, --cpu-mesh, --tpch (TPC-H Q3/Q5 instead, see cylon_tpu.tpch).
 """
 
 from __future__ import annotations
@@ -34,11 +42,25 @@ import numpy as np  # noqa: E402
 BASELINE_ROWS_PER_SEC_PER_WORKER = 1_000_000 / 0.60
 
 
-def run(rows_per_chip: int = 2_000_000, n_keys_frac: float = 0.5,
-        iters: int = 5) -> dict:
+_sync_fn = None
+
+
+def _sync(arr):
+    """Force execution and wait (block_until_ready is unreliable over the
+    axon tunnel — a tiny host pull is the only real barrier)."""
+    global _sync_fn
+    import jax.numpy as jnp
+    if _sync_fn is None:
+        _sync_fn = jax.jit(lambda x: jnp.sum(x[:4].astype(jnp.float32)))
+    np.asarray(_sync_fn(arr))
+
+
+def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:
     import cylon_tpu as ct
+    from cylon_tpu import config
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
     from cylon_tpu.relational import groupby_aggregate, join_tables
+    from cylon_tpu.utils import timing
 
     devs = jax.devices()
     on_accel = devs[0].platform != "cpu"
@@ -47,31 +69,34 @@ def run(rows_per_chip: int = 2_000_000, n_keys_frac: float = 0.5,
     w = env.world_size
 
     n = rows_per_chip * w
-    n_keys = max(int(n * n_keys_frac), 1)
+    max_val = max(int(n * unique), 1)
     rng = np.random.default_rng(42)
-    lk = rng.integers(0, n_keys, n).astype(np.int64)
-    rk = rng.integers(0, n_keys, n).astype(np.int64)
-    lv = rng.random(n)
-    rv = rng.random(n)
-
-    lt = ct.Table.from_pydict({"k": lk, "a": lv}, env)
-    rt = ct.Table.from_pydict({"k": rk, "b": rv}, env)
+    lt = ct.Table.from_pydict(
+        {"k": rng.integers(0, max_val, n).astype(np.int64),
+         "a": rng.integers(0, max_val, n).astype(np.int64)}, env)
+    rt = ct.Table.from_pydict(
+        {"k": rng.integers(0, max_val, n).astype(np.int64),
+         "b": rng.integers(0, max_val, n).astype(np.int64)}, env)
 
     def step():
         j = join_tables(lt, rt, "k", "k", how="inner")
         g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
-        # force completion
-        jax.block_until_ready(next(iter(g.columns.values())).data)
+        _sync(next(iter(g.columns.values())).data)
         return g
 
-    step()  # warmup + compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        step()
-        times.append(time.perf_counter() - t0)
+    prev_flag = config.BENCH_TIMINGS
+    config.BENCH_TIMINGS = True
+    try:
+        step()  # warmup + compile
+        timing.reset()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            step()
+            times.append(time.perf_counter() - t0)
+    finally:
+        config.BENCH_TIMINGS = prev_flag
     best = min(times)
-    # rows processed per iteration = left + right input rows
     rows_per_sec_per_chip = (2 * n) / best / w
     return {
         "metric": "dist join+groupby throughput (int64 keys)",
@@ -83,16 +108,44 @@ def run(rows_per_chip: int = 2_000_000, n_keys_frac: float = 0.5,
             "world": w,
             "platform": devs[0].platform,
             "rows_per_chip": rows_per_chip,
+            "unique": unique,
             "best_iter_s": round(best, 4),
             "all_iters_s": [round(t, 4) for t in times],
+            "phases_s": {k: v["s"] for k, v in timing.snapshot().items()},
         },
     }
 
 
-if __name__ == "__main__":
-    rows = 2_000_000
+def main() -> dict:
+    rows = None
+    unique = 0.9
+    iters = 4
     for a in sys.argv[1:]:
         if a.startswith("--rows="):
             rows = int(a.split("=", 1)[1])
-    res = run(rows_per_chip=rows)
-    print(json.dumps(res))
+        elif a.startswith("--unique="):
+            unique = float(a.split("=", 1)[1])
+        elif a.startswith("--iters="):
+            iters = int(a.split("=", 1)[1])
+
+    if "--tpch" in sys.argv:
+        from cylon_tpu.tpch import bench_tpch
+        return bench_tpch(scale=rows or 1)
+
+    if rows is None:
+        rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
+    # halve on device OOM so the driver always gets a number
+    while True:
+        try:
+            return run(rows_per_chip=rows, unique=unique, iters=iters)
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)
+            if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                    or "out of memory" in msg) and rows > 1_000_000:
+                rows //= 2
+                continue
+            raise
+
+
+if __name__ == "__main__":
+    print(json.dumps(main()))

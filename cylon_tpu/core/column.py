@@ -22,14 +22,21 @@ from .dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 
 
 class Column:
-    __slots__ = ("data", "validity", "type", "dictionary")
+    __slots__ = ("data", "validity", "type", "dictionary", "bounds")
 
     def __init__(self, data, type: LogicalType, validity=None,
-                 dictionary: Optional[np.ndarray] = None):
+                 dictionary: Optional[np.ndarray] = None,
+                 bounds: Optional[tuple] = None):
         self.data = data
         self.type = type
         self.validity = validity  # bool array, True = valid; None = all valid
         self.dictionary = dictionary  # host np.ndarray for STRING codes
+        #: host-known (lo, hi) value bounds for integer columns, or None.
+        #: Conservative: any subset/permutation of the values keeps them
+        #: valid; ops that create new values must drop them.  Consulted by
+        #: sort-operand packing: int64 keys within int32 range sort as ONE
+        #: native operand (ops/pack.py narrow32).
+        self.bounds = bounds
         if type == LogicalType.STRING and dictionary is None:
             raise InvalidError("STRING column requires a dictionary")
 
@@ -51,7 +58,11 @@ class Column:
             arr = arr.astype("datetime64[ns]").astype("int64", copy=False)
         elif arr.dtype.kind == "m":
             arr = arr.astype("timedelta64[ns]").astype("int64", copy=False)
-        return Column(arr.astype(phys, copy=False), lt)
+        arr = arr.astype(phys, copy=False)
+        bounds = None
+        if arr.dtype.kind in ("i", "u") and arr.size:
+            bounds = (int(arr.min()), int(arr.max()))
+        return Column(arr, lt, bounds=bounds)
 
     @staticmethod
     def _encode_strings(arr: np.ndarray) -> "Column":
@@ -116,4 +127,9 @@ class Column:
     def cast(self, lt: LogicalType) -> "Column":
         if self.type == LogicalType.STRING or lt == LogicalType.STRING:
             raise CylonTypeError("cast to/from string not supported on device")
-        return Column(self.data.astype(physical_np_dtype(lt)), lt, self.validity)
+        phys = physical_np_dtype(lt)
+        keep = (self.bounds is not None and phys.kind in ("i", "u")
+                and np.can_cast(np.min_scalar_type(self.bounds[0]), phys)
+                and np.can_cast(np.min_scalar_type(self.bounds[1]), phys))
+        return Column(self.data.astype(phys), lt, self.validity,
+                      bounds=self.bounds if keep else None)

@@ -41,12 +41,18 @@ def default_env() -> CylonEnv:
 
 
 class Table:
-    __slots__ = ("_cols", "_env", "_valid")
+    __slots__ = ("_cols", "_env", "_valid", "grouped_by")
 
     def __init__(self, cols: Mapping[str, Column], env: CylonEnv | None,
                  valid_counts: np.ndarray | None = None):
         self._cols: dict[str, Column] = dict(cols)
         self._env = env or default_env()
+        #: names of key columns this table is known to be GROUPED by: equal
+        #: keys are contiguous within each shard and co-located across
+        #: shards.  Set by ops that establish the property (join output,
+        #: global sort, groupby output); every other constructor path leaves
+        #: it None.  Lets groupby skip its shuffle + rank sort.
+        self.grouped_by: tuple | None = None
         n = None
         for c in self._cols.values():
             if n is None:
@@ -202,7 +208,7 @@ def _place_local(cols: dict[str, Column], env: CylonEnv) -> dict[str, Column]:
         data = jax.device_put(np.asarray(c.data), sharding)
         v = (jax.device_put(np.asarray(c.validity), sharding)
              if c.validity is not None else None)
-        out[k] = Column(data, c.type, v, c.dictionary)
+        out[k] = Column(data, c.type, v, c.dictionary, bounds=c.bounds)
     return out
 
 
@@ -235,5 +241,9 @@ def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:
                     vpad[i * cap: i * cap + m] = vhost[i * chunk: i * chunk + m]
         data = jax.device_put(padded, sharding)
         v = jax.device_put(vpad, sharding) if vpad is not None else None
-        out[k] = Column(data, c.type, v, c.dictionary)
+        # padding rows are zeros — covered by widening bounds to include 0
+        b = c.bounds
+        if b is not None:
+            b = (min(b[0], 0), max(b[1], 0))
+        out[k] = Column(data, c.type, v, c.dictionary, bounds=b)
     return Table(out, env, valid)

@@ -1,135 +1,172 @@
-"""Local join kernel: sort-merge on dense key ids.
+"""Local join kernel: single-sort merge + segmented-scan geometry.
 
 TPU-native replacement for the reference's local join layer
 (cpp/src/cylon/join/join.cpp:60 ``JoinTables`` dispatch, sort_join.cpp:66
 ``do_sorted_join``, hash_join.cpp:22-85).  The reference's default algorithm
 is SORT (join_config.hpp:37); a pointer-chasing hash build/probe doesn't map
-to XLA, so the sort path is *the* design here (SURVEY.md §7 hard-part 2):
+to XLA, so the sort path is *the* design here (SURVEY.md §7 hard-part 2),
+engineered around the measured v5e cost model: ``lax.sort`` is cheap
+(~7 ns/row), random gathers are expensive (~20 ns/row/lane), segment
+reductions with large segment counts are expensive — prefix scans are cheap.
 
-    sort right ids → searchsorted(left ids) match ranges →
-    prefix-sum offsets → one vectorized gather expansion.
+  1. ``join_sort_state``: ONE stable sort of the concatenated (left ++
+     right) packed key tuples (u32 lanes, :mod:`.pack`).  Stability makes
+     left rows precede right rows within every equal-key run, so the sorted
+     order itself encodes the merge.
+  2. ``join_carry``: per-position geometry from *segmented scans* only
+     (``associative_scan`` — no segment reductions, no group-space gather):
+     reverse segmented counts give every left row its group's right-count
+     and the position where its matches start; forward counts give right
+     rows their left-count (for right/outer emission).
+  3. ``join_take``: output expansion — a scatter + ``cummax`` reconstructs
+     "which emitting row owns output slot k" (offsets are strictly
+     increasing over emitting rows), then ONE stacked (out, 4) meta gather +
+     ONE 1-D gather produce the (l_take, r_take) index pairs.
 
-Inputs are int32 **dense ranks** from :mod:`cylon_tpu.ops.pack` (multi-column
-/ string / null-aware keys all collapse to one id column first), so a single
-int comparison implements full row equality.  Output size is data-dependent;
-callers run the ``*_count`` phase, pick a static capacity (pow2-bucketed),
-then the ``*_indices`` phase — the two-phase static-shape pattern that
-replaces the reference's dynamically-growing Arrow builders.
+Output size is data-dependent; callers run phase 1 (sort + carry + exact
+count), pick a static pow2 capacity, then phase 2 — with the carry arrays
+passed between the two compiled programs as device residents so the sort
+and scans run once.
 
-INNER / LEFT / RIGHT / FULL_OUTER all supported (join_config.hpp:25).
+INNER / LEFT / RIGHT / FULL_OUTER all supported (join_config.hpp:25);
+"right" emits from the right side over the same sorted state (left rows
+lead every group, so right-row matches start at the group start).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-# numpy scalars, not jnp: a module-level jnp constant would eagerly
-# initialize the default backend at import time (round-1 dryrun crash)
-SENT_L = np.int32(1 << 30)
-SENT_R = np.int32((1 << 30) + 1)
+from .pack import KeyOps, concat_keyops, neighbor_flags
 
 
-def _effective_ids(l_ids, r_ids, l_mask, r_mask):
-    le = l_ids if l_mask is None else jnp.where(l_mask, l_ids, SENT_L)
-    re_ = r_ids if r_mask is None else jnp.where(r_mask, r_ids, SENT_R)
-    return le, re_
+class JoinCarry(NamedTuple):
+    """Per-sorted-position state carried from count to materialize phase.
+    All (n_l + n_r,) int32 device arrays."""
+    offs: jax.Array    # exclusive prefix sum of eff (output offset)
+    eff: jax.Array     # output rows this position emits
+    cnt: jax.Array     # match count of the position's group (other side)
+    mstart: jax.Array  # sorted position where this row's matches start
+    idx_s: jax.Array   # concat-row index at this sorted position
+    un: jax.Array      # outer only: 1 = unmatched right row (else zeros)
 
 
-def _bounds(sorted_ids, query):
-    lo = jnp.searchsorted(sorted_ids, query, side="left", method="sort")
-    hi = jnp.searchsorted(sorted_ids, query, side="right", method="sort")
-    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+def join_sort_state(ko_l: KeyOps, ko_r: KeyOps):
+    """THE sort: stable lexicographic sort of the concatenated key tuples.
+
+    Returns ``(bnd, idx_s)`` — both (n_l + n_r,) int32.  ``idx_s[p]`` is the
+    concat-row index occupying sorted position p (values < n_l are left
+    rows); ``bnd[p]`` = 1 iff position p starts a new key group (p=0 -> 0).
+    Stability ⇒ within a group, left rows come first, each side in source
+    order.
+    """
+    cat = concat_keyops(ko_l, ko_r)
+    n = cat.n
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_all = jax.lax.sort(cat.ops + (idx,), num_keys=len(cat.ops),
+                              is_stable=True)
+    idx_s = sorted_all[-1]
+    bnd = neighbor_flags(sorted_all[:-1], cat.kinds)
+    return bnd, idx_s
 
 
-def _sort_ids(ids):
-    idx = jnp.arange(ids.shape[0], dtype=jnp.int32)
-    s, perm = jax.lax.sort((ids, idx), num_keys=1, is_stable=True)
-    return s, perm
+def join_carry(bnd, idx_s, live_cat, n_l: int, how: str) -> tuple:
+    """Phase-1 geometry: returns ``(total, JoinCarry)`` with ``total`` the
+    exact output row count (device scalar int32).
 
+    Segmented counts come from plain prefix sums + ONE stacked monotone
+    gather at the group end/start positions — NOT ``associative_scan``,
+    whose XLA:TPU compile time explodes superlinearly with array size
+    (~200 s at 2M rows, measured)."""
+    n = bnd.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    side = idx_s >= n_l
+    live = live_cat[idx_s]
+    lefts = ((~side) & live).astype(jnp.int32)
+    rights = (side & live).astype(jnp.int32)
+    first = bnd.astype(bool) | (pos == 0)
 
-def _counts(le, re_, l_mask, how_left: bool):
-    rs, _ = _sort_ids(re_)
-    lo, hi = _bounds(rs, le)
-    counts = hi - lo
-    out = jnp.maximum(counts, 1) if how_left else counts
-    if l_mask is not None:
-        out = jnp.where(l_mask, out, 0)
-    return counts, out
+    s_l = jnp.cumsum(lefts).astype(jnp.int32)    # inclusive prefix counts
+    s_r = jnp.cumsum(rights).astype(jnp.int32)
 
+    emit_right = how == "right"
+    keep_unmatched = how in ("left", "right", "outer")
+    need_fwd = emit_right or how == "outer"
 
-def _unmatched_right(le, re_, r_mask):
-    ls, _ = _sort_ids(le)
-    lo, hi = _bounds(ls, re_)
-    un = lo == hi
-    if r_mask is not None:
-        un = un & r_mask
-    return un
+    if need_fwd:
+        # lefts in the whole group, via the group-start prefix state
+        start = jax.lax.cummax(jnp.where(first, pos, 0))
+        at_start = jnp.stack([s_l, lefts], 1)[start]       # monotone gather
 
+    if emit_right:
+        # group left-count = S_l[end] - S_l[start-1]; for a right row p all
+        # group lefts precede it, so S_l[p] already includes them all
+        cnt = (s_l - (at_start[:, 0] - at_start[:, 1])).astype(jnp.int32)
+        mstart = start
+        emits = side & live
+    else:
+        # group END position = next boundary - 1 (reverse min of marks)
+        ebnd = jnp.concatenate([first[1:], jnp.ones(1, bool)])
+        end = jax.lax.cummin(jnp.where(ebnd, pos, jnp.int32(n)), reverse=True)
+        at_end = jnp.stack([s_l, s_r], 1)[end]             # monotone gather
+        t_l = at_end[:, 0] - (s_l - lefts)   # lefts in [p .. end]
+        t_r = at_end[:, 1] - (s_r - rights)  # rights in [p .. end]
+        cnt = t_r
+        mstart = pos + t_l                   # first right position of group
+        emits = (~side) & live
 
-@partial(jax.jit, static_argnames=("how",))
-def join_count(l_ids, r_ids, how: str, l_mask=None, r_mask=None):
-    """Exact output row count (device scalar) for the given join type."""
-    if how == "right":
-        return join_count(r_ids, l_ids, "left", r_mask, l_mask)
-    le, re_ = _effective_ids(l_ids, r_ids, l_mask, r_mask)
-    _, eff = _counts(le, re_, l_mask, how_left=how in ("left", "outer"))
-    total = jnp.sum(eff)
+    eff = jnp.where(emits,
+                    jnp.maximum(cnt, 1) if keep_unmatched else cnt,
+                    0).astype(jnp.int32)
+    csum = jnp.cumsum(eff)
+    offs = (csum - eff).astype(jnp.int32)
+    total = (csum[-1] if n > 0 else jnp.int32(0)).astype(jnp.int32)
+
     if how == "outer":
-        total = total + jnp.sum(_unmatched_right(le, re_, r_mask))
-    return total.astype(jnp.int32)
+        grp_l = (s_l - (at_start[:, 0] - at_start[:, 1])).astype(jnp.int32)
+        un = (side & live & (grp_l == 0)).astype(jnp.int32)
+        total = total + jnp.sum(un)
+    else:
+        un = jnp.zeros(n, jnp.int32)
+    return total, JoinCarry(offs, eff, cnt, mstart, idx_s, un)
 
 
-def _expand(counts, eff_counts, lo, perm_r, out_cap: int):
-    n = counts.shape[0]
-    csum = jnp.cumsum(eff_counts)
-    offs = jnp.concatenate([jnp.zeros(1, csum.dtype), csum[:-1]])
-    total = jnp.where(n > 0, csum[-1], 0)
+def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int):
+    """Phase-2 materialization: (l_take, r_take, total) — row index pairs of
+    the join result (l_take indexes left rows 0..n_l-1, r_take right rows
+    0..n_r-1), -1 marking the null side of unmatched outer rows.  ``out_cap``
+    must be >= phase 1's total; slots past ``total`` hold (-1, -1)."""
+    offs, eff, cnt, mstart, idx_s, un = carry
+    n = offs.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    total_main = (offs[-1] + eff[-1] if n > 0 else jnp.int32(0)).astype(
+        jnp.int32)
+
+    scat = jnp.where(eff > 0, offs, jnp.int32(out_cap))
+    p0 = jnp.zeros(out_cap, jnp.int32).at[scat].max(pos, mode="drop")
+    p_of_k = jax.lax.cummax(p0)
+
+    meta = jnp.stack([offs, cnt, mstart, idx_s], axis=1)[p_of_k]  # (out, 4)
     k = jnp.arange(out_cap, dtype=jnp.int32)
-    li = (jnp.searchsorted(offs, k, side="right", method="sort") - 1).astype(jnp.int32)
-    li = jnp.clip(li, 0, max(n - 1, 0))
-    rel = k - offs[li].astype(jnp.int32)
-    matched = rel < counts[li]
-    rpos = jnp.where(matched, lo[li] + rel, 0)
-    r_take = jnp.where(matched, perm_r[rpos], -1)
-    valid = k < total
-    l_take = jnp.where(valid, li, -1)
-    r_take = jnp.where(valid, r_take, -1)
-    return l_take, r_take, total.astype(jnp.int32)
-
-
-@partial(jax.jit, static_argnames=("how", "out_cap"))
-def join_indices(l_ids, r_ids, how: str, out_cap: int, l_mask=None, r_mask=None):
-    """Materialize (l_take, r_take, total): row index pairs of the join
-    result, -1 marking the null side of unmatched outer rows.  ``out_cap``
-    must be >= the count from :func:`join_count`; slots past ``total`` hold
-    (-1, -1)."""
+    rel = k - meta[:, 0]
+    matched = rel < meta[:, 1]
+    mpos = jnp.clip(meta[:, 2] + rel, 0, max(n - 1, 0))
+    m_idx = idx_s[mpos]
+    valid = k < total_main
     if how == "right":
-        r_take, l_take, total = join_indices(
-            r_ids, l_ids, "left", out_cap, r_mask, l_mask)
-        return l_take, r_take, total
-    le, re_ = _effective_ids(l_ids, r_ids, l_mask, r_mask)
-    rs, perm_r = _sort_ids(re_)
-    lo, hi = _bounds(rs, le)
-    counts = hi - lo
-    eff = jnp.maximum(counts, 1) if how in ("left", "outer") else counts
-    if l_mask is not None:
-        eff = jnp.where(l_mask, eff, 0)
-    l_take, r_take, total = _expand(counts, eff, lo, perm_r, out_cap)
+        r_take = jnp.where(valid, meta[:, 3] - n_l, jnp.int32(-1))
+        l_take = jnp.where(valid & matched, m_idx, jnp.int32(-1))
+    else:
+        l_take = jnp.where(valid, meta[:, 3], jnp.int32(-1))
+        r_take = jnp.where(valid & matched, m_idx - n_l, jnp.int32(-1))
+
+    total = total_main
     if how == "outer":
-        un = _unmatched_right(le, re_, r_mask)  # (m,)
-        m = un.shape[0]
-        ridx = jnp.arange(m, dtype=jnp.int32)
-        # compact unmatched right rows preserving order: first n_un of ``src``
-        order = jnp.where(un, ridx, jnp.int32(m))
-        _, src = jax.lax.sort((order, ridx), num_keys=1, is_stable=True)
-        n_un = jnp.sum(un).astype(jnp.int32)
-        pos = total + jnp.arange(m, dtype=jnp.int32)
-        pos = jnp.where(jnp.arange(m) < n_un, pos, jnp.int32(out_cap))
-        l_take = l_take.at[pos].set(jnp.int32(-1), mode="drop")
-        r_take = r_take.at[pos].set(src, mode="drop")
-        total = total + n_un
+        unpos = (jnp.cumsum(un) - un).astype(jnp.int32)
+        slot = jnp.where(un > 0, total_main + unpos, jnp.int32(out_cap))
+        r_take = r_take.at[slot].set(idx_s - n_l, mode="drop")
+        total = total_main + jnp.sum(un).astype(jnp.int32)
     return l_take, r_take, total

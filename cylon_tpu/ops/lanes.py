@@ -1,0 +1,168 @@
+"""u32 lane-matrix packing: move many columns in ONE gather/collective.
+
+TPU cost model (measured on v5e): a random row gather of an (n, L) matrix
+costs ~(1 + 0.2·(L-1))× a 1-D gather — far cheaper than L separate 1-D
+gathers.  So whenever an operator must move whole rows by index (join/filter
+materialization, shuffle exchange), the table's columns are first bitcast
+into one (n, L) uint32 lane matrix, moved in one pass, and unpacked after.
+
+This is the TPU analog of the reference's row-wise serializer: Arrow buffer
+triplets per column (serialize/table_serialize.hpp:23-59) become u32 lanes —
+  * int64/uint64/datetime64 → 2 lanes (hi, lo via shifts — no 64-bit
+    bitcasts: XLA's TPU x64 rewriter does not implement them)
+  * int32/uint32/float32/int16/int8/string-codes → 1 lane (bitcast/widen)
+  * bool → 1 lane (0/1)
+  * validity masks → bit-packed, 32 columns per lane
+  * float64 → NOT laneable (its bit split would need a 64-bit bitcast);
+    planned as a side column and moved as a raw f64 array — XLA's gather
+    handles f64 under x64 fine, it's only bitcast that is missing.
+Bitcasts are bit-exact roundtrips on the same device, so no ordering or
+canonicalization concerns apply (unlike sort-operand packing in pack.py).
+
+The static :class:`LaneSpec` travels with compiled programs (hashable), the
+matrix with the data.  :func:`gather_columns` is the one-stop row-move:
+one (n, L) matrix gather for every laneable column + validity, plus one
+1-D gather per f64 column.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ColLanes(NamedTuple):
+    """Static description of one column's slot in the lane matrix."""
+    dtype: str       # numpy dtype name of the column data
+    lanes: tuple     # lane indices (1 or 2 entries; 64-bit = (hi, lo));
+                     # empty tuple = non-laneable (f64 side column)
+    valid_bit: int   # bit position in the validity lane block, or -1
+
+
+class LaneSpec(NamedTuple):
+    cols: tuple          # tuple[ColLanes]
+    n_lanes: int         # total lanes incl. validity lanes
+    valid_lane0: int     # first validity lane index (== n_lanes if none)
+
+
+def plan_lanes(dtypes, has_valid) -> LaneSpec:
+    """Build the static lane layout for columns of ``dtypes`` (numpy dtype
+    names) where ``has_valid[i]`` marks nullable columns.  float64 columns
+    get no lanes (side-channel); their validity still rides the matrix."""
+    cols = []
+    lane = 0
+    vbit = 0
+    for dt, hv in zip(dtypes, has_valid):
+        ndt = np.dtype(dt)
+        if ndt.itemsize == 8 and np.issubdtype(ndt, np.floating):
+            lanes = ()
+        else:
+            width = 2 if ndt.itemsize == 8 else 1
+            lanes = tuple(range(lane, lane + width))
+            lane += width
+        cols.append(ColLanes(dt, lanes, vbit if hv else -1))
+        if hv:
+            vbit += 1
+    valid_lane0 = lane
+    n_valid_lanes = (vbit + 31) // 32
+    return LaneSpec(tuple(cols), lane + n_valid_lanes, valid_lane0)
+
+
+def _to_lanes(x):
+    """Column data array -> list of u32 lane arrays (hi, lo for 64-bit
+    ints; f64 never reaches here — it is planned laneless)."""
+    dt = x.dtype
+    if dt == jnp.bool_:
+        return [x.astype(jnp.uint32)]
+    if dt.itemsize == 8:
+        xi = x.astype(jnp.int64) if dt != jnp.uint64 else x
+        hi = (xi >> 32).astype(jnp.uint32)
+        lo = (xi & jnp.asarray(0xFFFFFFFF, xi.dtype)).astype(jnp.uint32)
+        return [hi, lo]
+    if jnp.issubdtype(dt, jnp.floating):  # f32 (f16 widened by caller)
+        return [jax.lax.bitcast_convert_type(x.astype(jnp.float32),
+                                             jnp.uint32)]
+    if jnp.issubdtype(dt, jnp.signedinteger):
+        return [jax.lax.bitcast_convert_type(x.astype(jnp.int32),
+                                             jnp.uint32)]
+    return [x.astype(jnp.uint32)]
+
+
+def _from_lanes(lanes, dtype: str):
+    dt = np.dtype(dtype)
+    jdt = jnp.dtype(dt)
+    if dt == np.bool_:
+        return lanes[0] != 0
+    if dt.itemsize == 8:
+        hi, lo = lanes
+        x = (jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.int64)
+             << 32) | lo.astype(jnp.int64)
+        return x.astype(jdt)
+    if np.issubdtype(dt, np.floating):
+        return jax.lax.bitcast_convert_type(lanes[0], jnp.float32).astype(jdt)
+    if np.issubdtype(dt, np.signedinteger):
+        return jax.lax.bitcast_convert_type(lanes[0], jnp.int32).astype(jdt)
+    return lanes[0].astype(jdt)
+
+
+def pack_lanes(spec: LaneSpec, datas, valids):
+    """(n, spec.n_lanes) uint32 lane matrix from parallel column arrays
+    (laneless f64 columns contribute only their validity bit).
+    ``valids[i]`` may be None for columns planned with valid_bit == -1."""
+    n = datas[0].shape[0]
+    lanes = [None] * spec.n_lanes
+    n_valid_lanes = spec.n_lanes - spec.valid_lane0
+    vlanes = [jnp.zeros(n, jnp.uint32) for _ in range(n_valid_lanes)]
+    for col, d, v in zip(spec.cols, datas, valids):
+        if col.lanes:
+            for li, arr in zip(col.lanes, _to_lanes(d)):
+                lanes[li] = arr
+        if col.valid_bit >= 0:
+            vb = jnp.ones(n, jnp.uint32) if v is None else v.astype(jnp.uint32)
+            slot = col.valid_bit // 32
+            vlanes[slot] = vlanes[slot] | (vb << jnp.uint32(col.valid_bit % 32))
+    for i, vl in enumerate(vlanes):
+        lanes[spec.valid_lane0 + i] = vl
+    return jnp.stack(lanes, axis=1)
+
+
+def unpack_lanes(spec: LaneSpec, mat):
+    """Inverse of :func:`pack_lanes`: (datas, valids) tuples — laneless
+    (f64) columns yield None data (moved separately); valids entries are
+    None for columns planned without validity."""
+    datas, valids = [], []
+    for col in spec.cols:
+        if col.lanes:
+            datas.append(_from_lanes([mat[:, li] for li in col.lanes],
+                                     col.dtype))
+        else:
+            datas.append(None)
+        if col.valid_bit >= 0:
+            vl = mat[:, spec.valid_lane0 + col.valid_bit // 32]
+            valids.append(((vl >> jnp.uint32(col.valid_bit % 32)) & 1) != 0)
+        else:
+            valids.append(None)
+    return tuple(datas), tuple(valids)
+
+
+def gather_columns(spec: LaneSpec, datas, valids, take):
+    """Move whole rows by index: ONE (n, L) matrix gather for every laneable
+    column + validity bits, plus one raw gather per f64 column.  ``take``
+    entries < 0 select row 0 (callers mask via validity).  Returns (datas,
+    valids) aligned with the input order."""
+    n = datas[0].shape[0]
+    sel = jnp.clip(take, 0, max(n - 1, 0))
+    if spec.n_lanes:
+        mat = pack_lanes(spec, datas, valids)
+        out_d, out_v = unpack_lanes(spec, mat[sel])
+        out_d, out_v = list(out_d), list(out_v)
+    else:
+        out_d = [None] * len(spec.cols)
+        out_v = [None] * len(spec.cols)
+    for i, col in enumerate(spec.cols):
+        if not col.lanes:
+            out_d[i] = datas[i][sel]
+    return tuple(out_d), tuple(out_v)

@@ -13,11 +13,26 @@ virtual comparators and pointer-chasing hash maps; on TPU we instead
    tuples and assign consecutive group ids.  Two tables get comparable ids by
    ranking their concatenation (the dual-table comparator analog).
 
-No 64-bit bitcasts anywhere — XLA's TPU x64 emulation does not implement
-``bitcast-convert`` on u64, so descending order uses arithmetic transforms
-(``~x`` for ints — total, overflow-free — and ``-x`` for floats) and float
-equality is handled by NaN/zero canonicalization plus float-aware compare
-helpers instead of the classic IEEE bit-flip trick.
+**u32 lane packing (the TPU fast path).**  TPU has no native 64-bit integer
+compare — an int64 ``lax.sort`` operand runs through XLA's x64 emulation and
+dominates every relational op.  So every sort operand is packed into native
+32-bit lanes before it reaches ``lax.sort``:
+
+* int64/uint64 → (hi int32/uint32, lo uint32) operand pair — lexicographic
+  order over the pair equals the 64-bit numeric order;
+* int8/16/32, bool → one int32 operand;
+* float32 → one uint32 operand via the IEEE total-order bit flip
+  (sign bit set → flip all bits, else set sign bit) after NaN/-0.0
+  canonicalization, so plain unsigned compares implement float order and
+  bit equality implements float equality (NaN == NaN);
+* float64 → kept as one f64 operand (kind 'f'): XLA TPU does not implement
+  u64 bitcast-convert and the (2,)-u32 bitcast half-order is platform
+  ambiguous, so f64 keys stay on the (slower) emulated-compare path.
+
+Descending order uses arithmetic transforms before packing (``~x`` for ints
+— total, overflow-free — and ``-x`` for floats).  Null flags are emitted
+only for columns that can actually hold nulls (callers coordinate the
+static operand structure across tables with ``need_null_flags``).
 
 Every downstream op (join, groupby, set ops, unique) then works on a single
 int32 id column — the moral equivalent of the reference flattening multi-col
@@ -54,32 +69,66 @@ def _canon_float(x: jax.Array) -> jax.Array:
     return jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
 
 
-def _sort_value(x: jax.Array, descending: bool) -> tuple[jax.Array, str]:
+def _sort_value(x: jax.Array, descending: bool,
+                narrow: bool = False) -> list[tuple[jax.Array, str]]:
+    """Pack one key column into native-lane sort operands: a list of
+    (operand, kind) pairs whose lexicographic order equals the column's
+    requested order (see module docstring for the packing rules).
+
+    ``narrow=True`` asserts (host-known ``Column.bounds``) that a 64-bit
+    integer column's values fit in int32 — it then sorts as ONE native
+    operand instead of a (hi, lo) pair."""
     dt = x.dtype
     if dt == jnp.bool_:
         v = x.astype(jnp.int32)
-        return (-v if descending else v), "i"
+        return [((-v if descending else v), "i")]
     if jnp.issubdtype(dt, jnp.integer):
+        if narrow and x.dtype.itemsize == 8:
+            x = x.astype(jnp.int32)
         # ~x = -x-1: strictly decreasing, total, no overflow (INT_MIN→INT_MAX)
-        return (~x if descending else x), "i"
+        if descending:
+            x = ~x
+        if x.dtype.itemsize <= 4:
+            if x.dtype == jnp.uint32:
+                return [(x, "i")]
+            return [(x.astype(jnp.int32), "i")]
+        # 64-bit: split into (hi, lo) native lanes.  Arithmetic >>32 keeps
+        # the sign in hi (signed) / zero-extends (unsigned); lo compares
+        # unsigned either way.
+        signed = jnp.issubdtype(x.dtype, jnp.signedinteger)
+        hi = (x >> 32).astype(jnp.int32 if signed else jnp.uint32)
+        lo = (x & jnp.asarray(0xFFFFFFFF, x.dtype)).astype(jnp.uint32)
+        return [(hi, "i"), (lo, "i")]
     if jnp.issubdtype(dt, jnp.floating):
         v = -x if descending else x
         # positive canonical NaN sorts after all numbers in XLA's total order
         v = _canon_float(v)
-        return v, "f"
+        if dt == jnp.float32:
+            u = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            flip = jnp.where(u >> 31 != 0, jnp.uint32(0xFFFFFFFF),
+                             jnp.uint32(0x80000000))
+            return [(u ^ flip, "i")]
+        return [(v, "f")]
     raise TypeError(f"unsortable dtype {dt}")
 
 
 def key_operands(datas, validities=None, row_mask=None, descendings=None,
-                 nulls_position: int = NULL_LAST, pad_key: int = 4) -> KeyOps:
+                 nulls_position: int = NULL_LAST, pad_key: int = 4,
+                 need_null_flags=None, narrow32=None) -> KeyOps:
     """Build the lexicographic sort-operand list for a key tuple.
 
-    For each key column: a (null-flag, value) operand pair — valid rows get
-    flag 1, nulls get 0 (first) or 2 (last), matching pandas ``na_position``
-    independently of ascending/descending.  A leading row-liveness operand is
-    added when ``row_mask`` is given; padding rows sort last with flag
-    ``pad_key`` (use distinct pad keys per table so padding never matches
-    across tables in a dense rank).
+    For each nullable key column: a null-flag operand then the packed value
+    operand(s) — valid rows get flag 1, nulls get 0 (first) or 2 (last),
+    matching pandas ``na_position`` independently of ascending/descending.
+    A leading row-liveness operand is added when ``row_mask`` is given;
+    padding rows sort last with flag ``pad_key`` (use distinct pad keys per
+    table so padding never matches across tables in a dense rank).
+
+    ``need_null_flags`` (tuple of bool per column) forces/suppresses the
+    null-flag operand statically — callers ranking TWO tables together must
+    pass the same tuple on both sides (operand structures must match even
+    when only one side is nullable).  Default: emit iff the column has a
+    validity mask.
     """
     ops, kinds = [], []
     n = datas[0].shape[0]
@@ -88,17 +137,21 @@ def key_operands(datas, validities=None, row_mask=None, descendings=None,
         kinds.append("i")
     for i, d in enumerate(datas):
         desc = bool(descendings[i]) if descendings is not None else False
-        val, kind = _sort_value(d, desc)
         v = validities[i] if validities is not None else None
-        if v is None:
-            nf = jnp.zeros(n, jnp.int32)
-        else:
-            nf = jnp.where(v, jnp.int32(1), jnp.int32(nulls_position))
-            val = jnp.where(v, val, jnp.zeros_like(val))
-        ops.append(nf)
-        kinds.append("i")
-        ops.append(val)
-        kinds.append(kind)
+        need_nf = (v is not None) if need_null_flags is None \
+            else bool(need_null_flags[i])
+        if need_nf:
+            if v is None:
+                nf = jnp.ones(n, jnp.int32)
+            else:
+                nf = jnp.where(v, jnp.int32(1), jnp.int32(nulls_position))
+                d = jnp.where(v, d, jnp.zeros_like(d))
+            ops.append(nf)
+            kinds.append("i")
+        nrw = bool(narrow32[i]) if narrow32 is not None else False
+        for val, kind in _sort_value(d, desc, narrow=nrw):
+            ops.append(val)
+            kinds.append(kind)
     return KeyOps(tuple(ops), tuple(kinds))
 
 
@@ -154,12 +207,39 @@ def dense_rank(keyops: KeyOps):
     return gids, n_groups
 
 
-def dense_rank_two(l: KeyOps, r: KeyOps):
-    """Comparable dense ranks across two tables (dual-table comparator
-    analog, arrow_comparator.hpp:238): rank the concatenation, split back."""
-    n = l.n
-    gids, n_groups = dense_rank(concat_keyops(l, r))
-    return gids[:n], gids[n:], n_groups
+def row_neq_prev(datas, validities=None):
+    """(n,) bool: row i's key tuple differs from row i-1's (row 0 -> False).
+    Null-aware (null == null, null != value) and float-total (NaN == NaN,
+    -0.0 == 0.0) — the same equality the dense rank implements, but computed
+    directly on adjacent rows of an already-grouped table (no sort)."""
+    n = datas[0].shape[0]
+    neq = jnp.zeros(max(n - 1, 0), bool)
+    for i, d in enumerate(datas):
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = _canon_float(d)
+            kind = "f"
+        else:
+            kind = "i"
+        dn = op_neq(d[1:], d[:-1], kind)
+        v = validities[i] if validities is not None else None
+        if v is not None:
+            dn = (v[1:] != v[:-1]) | (dn & v[1:] & v[:-1])
+        neq = neq | dn
+    return jnp.concatenate([jnp.zeros(min(n, 1), bool), neq])
+
+
+def grouped_gids(datas, validities, mask):
+    """Dense group ids for an already-grouped (equal keys contiguous) shard:
+    boundary flags + prefix sum — no sort.  Returns (gids, n_groups, first)
+    with masked (padding) rows excluded from the id space (caller routes
+    them); ``first`` marks each group's first live row."""
+    n = datas[0].shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    first0 = pos == 0
+    bnd = (row_neq_prev(datas, validities) | first0) & mask
+    gid = jnp.cumsum(bnd.astype(jnp.int32)).astype(jnp.int32) - 1
+    n_groups = jnp.max(jnp.where(mask, gid, -1)) + 1
+    return jnp.where(mask, gid, n), n_groups.astype(jnp.int32), bnd
 
 
 def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):

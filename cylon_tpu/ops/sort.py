@@ -48,12 +48,13 @@ def take_with_nulls(data: jax.Array, validity, idx: jax.Array):
 def compact_by_flag(flag: jax.Array, out_cap: int):
     """Indices of rows with flag set, in original row order, padded to
     ``out_cap`` with -1; plus the true count.  The static-shape analog of the
-    reference's growing Arrow index builders."""
+    reference's growing Arrow index builders.  Sort-free: output positions
+    are the exclusive prefix sum of the flags, materialized by one scatter."""
     n = flag.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    key = jnp.where(flag, idx, jnp.int32(n))
-    s, src = jax.lax.sort((key, idx), num_keys=1, is_stable=True)
-    total = jnp.sum(flag).astype(jnp.int32)
-    k = jnp.arange(out_cap, dtype=jnp.int32)
-    out = jnp.where(k < total, src[jnp.clip(k, 0, max(n - 1, 0))], jnp.int32(-1))
+    fi = flag.astype(jnp.int32)
+    pos = (jnp.cumsum(fi) - fi).astype(jnp.int32)
+    total = jnp.sum(fi).astype(jnp.int32)
+    scat = jnp.where(flag, pos, jnp.int32(out_cap))
+    out = jnp.full(out_cap, -1, jnp.int32).at[scat].set(idx, mode="drop")
     return out, total

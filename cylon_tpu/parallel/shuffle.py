@@ -122,22 +122,20 @@ def _exchange_fn(mesh: Mesh, w: int, block: int, out_cap: int):
             recv = jax.lax.all_to_all(send, ROW_AXIS, split_axis=0,
                                       concat_axis=0, tiled=True)
             outs.append(recv)
-        # compact: slot k (= src*block + pos) valid iff pos < C[src, my]
+        # compact: slot k (= src*block + pos) valid iff pos < C[src, my].
+        # Sort-free: output position = exclusive prefix sum of validity; one
+        # scatter builds the take map.  Slots past the shard's valid count
+        # keep the init value 0 (any in-bounds slot) — the valid_counts
+        # sidecar masks those rows everywhere downstream.
         k = jnp.arange(w * block, dtype=jnp.int32)
         src = k // block
         kpos = k - src * block
         valid = kpos < recv_block_valid[src]
-        key = jnp.where(valid, k, jnp.int32(w * block))
-        _, perm2 = jax.lax.sort((key, k), num_keys=1, is_stable=True)
-        take = perm2[:out_cap] if out_cap <= w * block else None
-        final = []
-        for recv in outs:
-            if take is not None:
-                final.append(recv[take])
-            else:
-                pad = jnp.zeros((out_cap - w * block,) + recv.shape[1:],
-                                recv.dtype)
-                final.append(jnp.concatenate([recv[perm2], pad]))
+        vi = valid.astype(jnp.int32)
+        cpos = (jnp.cumsum(vi) - vi).astype(jnp.int32)
+        scat = jnp.where(valid, cpos, jnp.int32(out_cap))
+        take = jnp.zeros(out_cap, jnp.int32).at[scat].set(k, mode="drop")
+        final = [recv[take] for recv in outs]
         return tuple(final)
 
     def fn(tgt, counts, cols):

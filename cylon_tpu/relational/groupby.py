@@ -26,10 +26,12 @@ from .. import config
 from ..core.column import Column
 from ..core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from ..core.table import Table
+from ..ctx.context import ROW_AXIS
 from ..ops import groupby as gbk
 from ..ops import pack
 from ..status import InvalidError
-from .common import PAD_L, REP, ROW, col_arrays, live_mask
+from ..utils import timing
+from .common import PAD_L, REP, ROW, col_arrays, live_mask, narrow32_flags
 from .repart import shuffle_table
 
 shard_map = jax.shard_map
@@ -70,18 +72,29 @@ def _normalize_aggs(aggs):
     return out
 
 
-def _group_keys(by_datas, by_valids, vc):
+def _group_keys(by_datas, by_valids, vc, grouped: bool = False,
+                narrow: tuple | None = None):
     """Per-shard dense group ids; padding rows route to trash segment ``cap``
     and never contribute a group (live rows sort first, so live ranks are a
-    dense prefix 0..n_groups-1)."""
+    dense prefix 0..n_groups-1).
+
+    ``grouped=True`` (table carries ``grouped_by`` metadata — join/sort
+    output): equal keys are already contiguous, so ids come from boundary
+    flags + prefix sum instead of a rank sort.  ``narrow`` = static
+    narrow32 flags for the sort-operand packing (see common.narrow32_flags).
+    """
     cap = by_datas[0].shape[0]
     mask = live_mask(vc, cap)
+    if grouped:
+        gids, n_groups, first = pack.grouped_gids(list(by_datas),
+                                                  list(by_valids), mask)
+        return gids, n_groups, mask, first
     ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask,
-                           pad_key=PAD_L)
+                           pad_key=PAD_L, narrow32=narrow)
     gids, _ = pack.dense_rank(ko)
     n_groups = jnp.max(jnp.where(mask, gids, -1)) + 1
     gids = jnp.where(mask, gids, cap)
-    return gids, n_groups.astype(jnp.int32), mask
+    return gids, n_groups.astype(jnp.int32), mask, None
 
 
 def _value_mask(mask, val, valid):
@@ -104,13 +117,15 @@ def _rep_keys(by_datas, by_valids, gids, seg_cap):
 
 
 @lru_cache(maxsize=None)
-def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int):
+def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
+                narrow: tuple):
     """Phase 1 per shard: dense-rank keys, segment-reduce each (col, op) into
     intermediate arrays of static length seg_cap (rank-ordered dense prefix),
     gather per-group key representatives."""
 
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
-        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
+        gids, n_groups, mask, _ = _group_keys(by_datas, by_valids, vc,
+                                              grouped, narrow)
         key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         inter_out = []
         for i, op in enumerate(ops):
@@ -125,12 +140,13 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int):
 
 
 @lru_cache(maxsize=None)
-def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int):
+def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple):
     """Phase 2 per shard: re-rank shuffled intermediate rows by key,
     segment-reduce the intermediates, finalize each op."""
 
     def per_shard(vc, by_datas, by_valids, inter_by_op):
-        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
+        gids, n_groups, mask, _ = _group_keys(by_datas, by_valids, vc,
+                                              narrow=narrow)
         key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         res_d, res_v = [], []
         for i, op in enumerate(ops):
@@ -147,19 +163,50 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int):
 
 
 @lru_cache(maxsize=None)
-def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int):
+def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
+            narrow: tuple):
     """Single-phase per shard over raw (already co-located) rows — used for
-    non-associative ops and the local path.  specs: ((op, q), ...)."""
+    non-associative ops, the local path, and the grouped-input fast path
+    (join/sort output: no shuffle, no rank sort)."""
 
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
-        gids, n_groups, mask = _group_keys(by_datas, by_valids, vc)
-        key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+        gids, n_groups, mask, first = _group_keys(by_datas, by_valids, vc,
+                                                  grouped, narrow)
+        cap = by_datas[0].shape[0]
+        starts = ends = None
+        if grouped:
+            my = jax.lax.axis_index(ROW_AXIS)
+            n_live = vc[my].astype(jnp.int32)
+            starts, ends = gbk.grouped_bounds(gids, first, mask, n_live,
+                                              seg_cap)
+            # rep keys = each run's first row (no segment_min needed)
+            safe = jnp.clip(starts, 0, max(cap - 1, 0))
+            key_out = tuple(d[safe] for d in by_datas)
+            kval_out = tuple(v[safe] if v is not None else None
+                             for v in by_valids)
+        else:
+            key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+        vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
+                  for i in range(len(specs))]
+        # batch all cumsum-able aggregations through ONE prefix-diff pass
+        batched: dict[int, dict] = {}
+        if grouped:
+            sel = [i for i, (op, _) in enumerate(specs)
+                   if op in gbk.CUMSUMMABLE]
+            if sel:
+                inters = gbk.grouped_combine_many(
+                    [specs[i][0] for i in sel], [val_datas[i] for i in sel],
+                    starts, ends, [vmasks[i] for i in sel])
+                batched = dict(zip(sel, inters))
         res_d, res_v = [], []
         for i, (op, q) in enumerate(specs):
-            vmask = _value_mask(mask, val_datas[i], val_valids[i])
+            vmask = vmasks[i]
             if op in gbk.ASSOCIATIVE:
-                inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap,
-                                            vmask)
+                if i in batched:
+                    inter = batched[i]
+                else:
+                    inter = gbk.combine_locally(op, val_datas[i], gids,
+                                                seg_cap, vmask)
                 d, v = gbk.finalize(op, inter, ddof)
             elif op == "nunique":
                 ko = pack.key_operands([val_datas[i]], [val_valids[i]])
@@ -252,8 +299,14 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     res_names = [n for _, _, _, n in specs]
     all_assoc = all(op in gbk.ASSOCIATIVE for _, op, _, _ in specs)
     distributed = env.world_size > 1
+    # grouped fast path: equal keys already contiguous per shard AND
+    # co-located across shards (join/sort/groupby output) — one single-phase
+    # pass, no shuffle, no rank sort
+    grouped = (table.grouped_by is not None
+               and tuple(by) == tuple(table.grouped_by))
+    narrow = narrow32_flags(by_cols)
 
-    if distributed and all_assoc:
+    if distributed and all_assoc and not grouped:
         # phase 1: local pre-combine (reference groupby.cpp:76-81)
         by_datas, by_valids = col_arrays(by_cols)
         val_datas = tuple(c.data for c in val_cols)
@@ -262,8 +315,8 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         ops_t = tuple(op for _, op, _, _ in specs)
         seg_cap = max(table.capacity, 1)
         key_out, kval_out, inter_out, n_groups = _combine_fn(
-            env.mesh, ops_t, seg_cap)(vc, by_datas, by_valids, val_datas,
-                                      val_valids)
+            env.mesh, ops_t, seg_cap, False, narrow)(
+                vc, by_datas, by_valids, val_datas, val_valids)
         n_groups = np.asarray(n_groups, np.int64)
         # intermediate table: keys + flat intermediate columns
         cols = {}
@@ -287,26 +340,31 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
             for inames in inames_by_op)
         vc2 = np.asarray(shuffled.valid_counts, np.int32)
         key2, kval2, res_d, res_v, ng2 = _final_fn(
-            env.mesh, ops_t, max(shuffled.capacity, 1), ddof)(
+            env.mesh, ops_t, max(shuffled.capacity, 1), ddof, narrow)(
                 vc2, s_by_datas, s_by_valids, inter_by_op)
         ng2 = np.asarray(ng2, np.int64)
         out = _result_table(env, by, by_cols, key2, kval2, res_names, res_d,
                             res_v, res_types, res_dicts, ng2)
-        return _shrink(out, ng2)
+        out = _shrink(out, ng2)
+        out.grouped_by = tuple(by)
+        return out
 
-    # non-associative ops (or local): co-locate raw rows first
+    # non-associative ops (or local, or grouped input): co-locate raw rows
     work = table.project(list(dict.fromkeys(by + [c for c, _, _, _ in specs])))
-    if distributed:
+    if distributed and not grouped:
         work = shuffle_table(work, by)
     by_datas, by_valids = col_arrays([work.column(n) for n in by])
     val_datas = tuple(work.column(c).data for c, _, _, _ in specs)
     val_valids = tuple(work.column(c).validity for c, _, _, _ in specs)
     vc = np.asarray(work.valid_counts, np.int32)
     spec_t = tuple((op, q) for _, op, q, _ in specs)
-    key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
-        env.mesh, spec_t, max(work.capacity, 1), ddof)(
-            vc, by_datas, by_valids, val_datas, val_valids)
-    n_groups = np.asarray(n_groups, np.int64)
+    with timing.region("groupby.raw"):
+        key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
+            env.mesh, spec_t, max(work.capacity, 1), ddof, grouped, narrow)(
+                vc, by_datas, by_valids, val_datas, val_valids)
+        n_groups = np.asarray(n_groups, np.int64)
     out = _result_table(env, by, by_cols, key_out, kval_out, res_names, res_d,
                         res_v, res_types, res_dicts, n_groups)
-    return _shrink(out, n_groups)
+    out = _shrink(out, n_groups)
+    out.grouped_by = tuple(by)
+    return out

@@ -6,11 +6,20 @@ local sort-join (join/sort_join.cpp:66, the reference's default algorithm,
 join_config.hpp:37) with join_utils.cpp's output assembly (suffix naming,
 null sides of outer joins).
 
-The local kernel is the two-phase static-shape sort-merge in
-:mod:`cylon_tpu.ops.join` run per shard under ``shard_map``: phase 1 returns
-exact per-shard output counts (the sidecar that replaces Arrow's growing
-builders), the host picks a pow2 capacity, phase 2 materializes gather
-indices and gathers every output column in one fused program.
+The local kernel is the two-phase static-shape single-sort merge in
+:mod:`cylon_tpu.ops.join` run per shard under ``shard_map``:
+
+* phase 1 runs THE one stable sort of both sides' packed key tuples and
+  returns exact per-shard output counts (the sidecar that replaces Arrow's
+  growing builders) plus the per-position geometry carry as device arrays;
+* the host picks a pow2 capacity;
+* phase 2 reuses the carried geometry — no re-sort, no re-scan — to build
+  (l_take, r_take) and gathers every output column through ONE u32
+  lane-matrix gather per side (:mod:`cylon_tpu.ops.lanes`) instead of one
+  gather per column — the dominant cost on TPU is per-gather, not per-lane.
+
+Key packing consults host-known column bounds (``Column.bounds``) so int64
+keys whose values fit in 32 bits sort as a single native operand.
 """
 
 from __future__ import annotations
@@ -27,11 +36,12 @@ from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
 from ..ops import join as joink
+from ..ops import lanes
 from ..ops import pack
-from ..ops import sort as sortk
 from ..status import InvalidError
+from ..utils import timing
 from .common import (PAD_L, PAD_R, REP, ROW, build_table, check_same_env,
-                     col_arrays, live_mask, promote_key_pair)
+                     col_arrays, live_mask, narrow32_flags, promote_key_pair)
 from .repart import shuffle_table
 
 shard_map = jax.shard_map
@@ -39,72 +49,96 @@ shard_map = jax.shard_map
 HOW = ("inner", "left", "right", "outer")
 
 
-def _ranks(vcl, vcr, l_datas, l_valids, r_datas, r_valids):
-    """Per-shard comparable dense ranks + liveness masks for both sides."""
+def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
+    """Concat-row liveness for (left ++ right) per shard."""
+    return jnp.concatenate([live_mask(vcl, cap_l), live_mask(vcr, cap_r)])
+
+
+def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
+                  narrow: tuple):
+    """Per-shard single-sort join state (bnd, idx_s, live_cat).
+
+    Both sides must build structurally identical operand lists, so the
+    null-flag presence per key column is the union of the two sides' and the
+    narrow-key decision is made by the caller for the pair."""
     cap_l, cap_r = l_datas[0].shape[0], r_datas[0].shape[0]
     mask_l = live_mask(vcl, cap_l)
     mask_r = live_mask(vcr, cap_r)
+    need_nf = tuple((lv is not None) or (rv is not None)
+                    for lv, rv in zip(l_valids, r_valids))
     ko_l = pack.key_operands(list(l_datas), list(l_valids), row_mask=mask_l,
-                             pad_key=PAD_L)
+                             pad_key=PAD_L, need_null_flags=need_nf,
+                             narrow32=narrow)
     ko_r = pack.key_operands(list(r_datas), list(r_valids), row_mask=mask_r,
-                             pad_key=PAD_R)
-    lids, rids, _ = pack.dense_rank_two(ko_l, ko_r)
-    return lids, rids, mask_l, mask_r
+                             pad_key=PAD_R, need_null_flags=need_nf,
+                             narrow32=narrow)
+    bnd, idx_s = joink.join_sort_state(ko_l, ko_r)
+    return bnd, idx_s, jnp.concatenate([mask_l, mask_r])
 
 
 @lru_cache(maxsize=None)
-def _count_fn(mesh: Mesh, how: str):
+def _count_fn(mesh: Mesh, how: str, narrow: tuple):
+    """Phase 1: sort once; return per-shard exact counts + carried state."""
+
     def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids):
-        lids, rids, mask_l, mask_r = _ranks(vcl, vcr, l_datas, l_valids,
-                                            r_datas, r_valids)
-        n = joink.join_count(lids, rids, how, mask_l, mask_r)
-        return n.reshape(1)
+        cap_l = l_datas[0].shape[0]
+        bnd, idx_s, live = _sorted_state(vcl, vcr, l_datas, l_valids,
+                                         r_datas, r_valids, narrow)
+        n, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
+        return (n.reshape(1),) + tuple(carry)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW),
-                             out_specs=ROW))
+                             out_specs=(ROW,) * 7))
 
 
 @lru_cache(maxsize=None)
-def _materialize_fn(mesh: Mesh, how: str, out_cap: int, plan: tuple):
-    """plan entries (static):
-    ("l", needs_null_valid) / ("r", needs_null_valid) — gather arrays[i]
-    from that side; ("k", needs_valid) — coalesce left/right key pair.
-    Array operands arrive as parallel tuples (ldatas/lvalids/rdatas/rvalids
-    for keys; gather columns in ``gcols``/``gvalids`` with side tags in the
-    plan order)."""
+def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
+                    plan: tuple, lspec: lanes.LaneSpec,
+                    rspec: lanes.LaneSpec):
+    """Phase 2.  ``plan`` entries (static):
+    ("l", i, needs_valid) — output column = left lane-matrix column i;
+    ("r", j, needs_valid) — right lane-matrix column j;
+    ("k", i, j, needs_valid) — coalesce left col i with right col j.
+    """
 
-    def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-                  gcols, gvalids):
-        lids, rids, mask_l, mask_r = _ranks(vcl, vcr, l_datas, l_valids,
-                                            r_datas, r_valids)
-        l_take, r_take, _total = joink.join_indices(
-            lids, rids, how, out_cap, mask_l, mask_r)
+    def per_shard(carry, l_cols, l_valids, r_cols, r_valids):
+        l_take, r_take, _total = joink.join_take(
+            joink.JoinCarry(*carry), cap_l, how, out_cap)
+        ldat, lval = lanes.gather_columns(lspec, l_cols, l_valids, l_take)
+        rdat, rval = lanes.gather_columns(rspec, r_cols, r_valids, r_take)
+        l_ok = l_take >= 0
+        r_ok = r_take >= 0
+
+        def side_out(datas, vals, ok, i, needs_valid):
+            d = datas[i]
+            if not needs_valid:
+                return d, None
+            v = ok if vals[i] is None else (ok & vals[i])
+            return d, v
+
         out_d, out_v = [], []
-        gi = 0
         for entry in plan:
-            kind = entry[0]
-            if kind == "k":
-                _, ki, needs_valid = entry
-                dl, vl = sortk.take_with_nulls(l_datas[ki], l_valids[ki], l_take)
-                dr, vr = sortk.take_with_nulls(r_datas[ki], r_valids[ki], r_take)
-                use_l = l_take >= 0
-                d = jnp.where(use_l, dl, dr)
-                v = jnp.where(use_l, vl, vr)
+            if entry[0] == "k":
+                _, i, j, needs_valid = entry
+                dl, vl = side_out(ldat, lval, l_ok, i, True)
+                dr, vr = side_out(rdat, rval, r_ok, j, True)
+                d = jnp.where(l_ok, dl, dr)
+                v = jnp.where(l_ok, vl, vr)
                 out_d.append(d)
                 out_v.append(v if needs_valid else None)
             else:
-                take = l_take if kind == "l" else r_take
-                needs_valid = entry[1]
-                d, v = sortk.take_with_nulls(gcols[gi], gvalids[gi], take)
+                side, i, needs_valid = entry
+                datas, vals, ok = ((ldat, lval, l_ok) if side == "l"
+                                   else (rdat, rval, r_ok))
+                d, v = side_out(datas, vals, ok, i, needs_valid)
                 out_d.append(d)
-                out_v.append(v if needs_valid else None)
-                gi += 1
+                out_v.append(v)
         return tuple(out_d), tuple(out_v)
 
     return jax.jit(shard_map(
         per_shard, mesh=mesh,
-        in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW, ROW),
+        in_specs=(ROW, ROW, ROW, ROW, ROW),
         out_specs=(ROW, ROW)))
 
 
@@ -132,58 +166,95 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     rwork = right.with_columns(dict(zip(right_on, rkey_cols)))
 
     if env.world_size > 1:
-        lwork = shuffle_table(lwork, left_on)
-        rwork = shuffle_table(rwork, right_on)
+        with timing.region("join.shuffle"):
+            lwork = shuffle_table(lwork, left_on)
+            rwork = shuffle_table(rwork, right_on)
 
-    l_datas, l_valids = col_arrays([lwork.column(n) for n in left_on])
-    r_datas, r_valids = col_arrays([rwork.column(n) for n in right_on])
+    l_key_cols = [lwork.column(n) for n in left_on]
+    r_key_cols = [rwork.column(n) for n in right_on]
+    l_datas, l_valids = col_arrays(l_key_cols)
+    r_datas, r_valids = col_arrays(r_key_cols)
+    narrow = narrow32_flags(l_key_cols, r_key_cols)
     vcl = np.asarray(lwork.valid_counts, np.int32)
     vcr = np.asarray(rwork.valid_counts, np.int32)
 
-    counts = np.asarray(_count_fn(env.mesh, how)(
-        vcl, vcr, l_datas, l_valids, r_datas, r_valids)).astype(np.int64)
+    with timing.region("join.sort_count"):
+        res = _count_fn(env.mesh, how, narrow)(
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids)
+        counts_dev, carry = res[0], res[1:]
+        counts = np.asarray(counts_dev).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
 
     # ---- output plan -----------------------------------------------------
     coalesce = coalesce_keys and left_on == right_on
-    l_nullable_side = how in ("right", "outer")   # left side may be unmatched
-    r_nullable_side = how in ("left", "outer")
     key_set_l, key_set_r = set(left_on), set(right_on)
     overlap = (set(lwork.column_names) & set(rwork.column_names)) - (
         key_set_l if coalesce else set())
 
-    plan, names, types, dicts, gcols, gvalids = [], [], [], [], [], []
+    # lane-matrix column lists per side (keys first, then gathered columns)
+    l_cols_list: list[Column] = []
+    r_cols_list: list[Column] = []
 
-    def add_gather(side, name, col, out_name):
-        needs_valid = col.validity is not None or (
-            l_nullable_side if side == "l" else r_nullable_side)
-        plan.append((side, needs_valid))
-        gcols.append(col.data)
-        gvalids.append(col.validity)
-        names.append(out_name)
-        types.append(col.type)
-        dicts.append(col.dictionary)
+    def lane_col(side_list, col) -> int:
+        side_list.append(col)
+        return len(side_list) - 1
 
-    for i, n in enumerate(lwork.column_names):
+    plan, names, types, dicts = [], [], [], []
+    for n in lwork.column_names:
+        col = lwork.column(n)
         if coalesce and n in key_set_l:
             ki = left_on.index(n)
-            col = lwork.column(n)
-            needs_valid = (col.validity is not None
-                           or rwork.column(right_on[ki]).validity is not None)
-            plan.append(("k", ki, needs_valid))
-            names.append(n)
-            types.append(col.type)
-            dicts.append(col.dictionary)
+            rcol = rwork.column(right_on[ki])
+            # the coalesced key only needs BOTH sides for outer joins; for
+            # inner/left every output row has a live left key (and for right
+            # a live right key) — one lane set instead of two
+            if how in ("inner", "left"):
+                plan.append(("l", lane_col(l_cols_list, col),
+                             col.validity is not None))
+            elif how == "right":
+                plan.append(("r", lane_col(r_cols_list, rcol),
+                             rcol.validity is not None))
+            else:
+                needs_valid = (col.validity is not None
+                               or rcol.validity is not None)
+                plan.append(("k", lane_col(l_cols_list, col),
+                             lane_col(r_cols_list, rcol), needs_valid))
         else:
-            out = n + suffixes[0] if n in overlap else n
-            add_gather("l", n, lwork.column(n), out)
+            needs_valid = col.validity is not None or how in ("right", "outer")
+            plan.append(("l", lane_col(l_cols_list, col), needs_valid))
+            n = n + suffixes[0] if n in overlap else n
+        names.append(n)
+        types.append(col.type)
+        dicts.append(col.dictionary)
     for n in rwork.column_names:
         if coalesce and n in key_set_r:
             continue
-        out = n + suffixes[1] if n in overlap else n
-        add_gather("r", n, rwork.column(n), out)
+        col = rwork.column(n)
+        needs_valid = col.validity is not None or how in ("left", "outer")
+        plan.append(("r", lane_col(r_cols_list, col), needs_valid))
+        names.append(n + suffixes[1] if n in overlap else n)
+        types.append(col.type)
+        dicts.append(col.dictionary)
 
-    fn = _materialize_fn(env.mesh, how, out_cap, tuple(plan))
-    out_d, out_v = fn(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-                      tuple(gcols), tuple(gvalids))
-    return build_table(names, out_d, out_v, types, dicts, counts, env)
+    lspec = lanes.plan_lanes(
+        tuple(str(c.data.dtype) for c in l_cols_list),
+        tuple(c.validity is not None for c in l_cols_list))
+    rspec = lanes.plan_lanes(
+        tuple(str(c.data.dtype) for c in r_cols_list),
+        tuple(c.validity is not None for c in r_cols_list))
+
+    fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
+                         tuple(plan), lspec, rspec)
+    with timing.region("join.materialize"):
+        out_d, out_v = fn(carry,
+                          tuple(c.data for c in l_cols_list),
+                          tuple(c.validity for c in l_cols_list),
+                          tuple(c.data for c in r_cols_list),
+                          tuple(c.validity for c in r_cols_list))
+    out = build_table(names, out_d, out_v, types, dicts, counts, env)
+    if coalesce:
+        # join output rows are key-grouped per shard (sorted merge order) and
+        # keys are co-located across shards (hash shuffle) -> groupby on the
+        # same keys can skip shuffle + rank (relational/groupby.py fast path)
+        out.grouped_by = tuple(left_on)
+    return out

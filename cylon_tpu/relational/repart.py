@@ -50,15 +50,17 @@ def _flatten_for_exchange(table: Table):
         if c.validity is not None:
             vi = len(flat)
             flat.append(c.validity)
-        recipe.append((name, di, vi, c.type, c.dictionary))
+        recipe.append((name, di, vi, c.type, c.dictionary, c.bounds))
     return tuple(flat), recipe
 
 
 def _rebuild(recipe, new_flat, valid_counts, env: CylonEnv) -> Table:
     cols = {}
-    for name, di, vi, t, dc in recipe:
+    for name, di, vi, t, dc, b in recipe:
         v = new_flat[vi] if vi >= 0 else None
-        cols[name] = Column(new_flat[di], t, v, dc)
+        # exchanged rows are a permutation + zero padding of the input values
+        nb = (min(b[0], 0), max(b[1], 0)) if b is not None else None
+        cols[name] = Column(new_flat[di], t, v, dc, bounds=nb)
     return Table(cols, env, np.asarray(valid_counts, np.int64))
 
 

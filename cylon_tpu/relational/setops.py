@@ -121,10 +121,14 @@ def _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids,
     cap_a, cap_b = a_datas[0].shape[0], b_datas[0].shape[0]
     mask_a = live_mask(vca, cap_a)
     mask_b = live_mask(vcb, cap_b)
+    # operand structures must match across the two tables: emit a null-flag
+    # operand for a column when EITHER side is nullable
+    need_nf = tuple((av is not None) or (bv is not None)
+                    for av, bv in zip(a_valids, b_valids))
     ko_a = pack.key_operands(list(a_datas), list(a_valids), row_mask=mask_a,
-                             pad_key=PAD_L)
+                             pad_key=PAD_L, need_null_flags=need_nf)
     ko_b = pack.key_operands(list(b_datas), list(b_valids), row_mask=mask_b,
-                             pad_key=PAD_L)
+                             pad_key=PAD_L, need_null_flags=need_nf)
     gids_cat, _ = pack.dense_rank(pack.concat_keyops(ko_a, ko_b))
     side_is_b = jnp.concatenate([jnp.zeros(cap_a, bool), jnp.ones(cap_b, bool)])
     mask_cat = jnp.concatenate([mask_a, mask_b])

@@ -165,4 +165,8 @@ def sort_table(table: Table, by, ascending=True,
     valids = tuple(c.validity for _, c in items)
     out_d, out_v = _local_sort_fn(env.mesh, descendings, npos)(
         vc, by_datas, by_valids, datas, valids)
-    return rebuild_like(items, out_d, out_v, table.valid_counts, env)
+    out = rebuild_like(items, out_d, out_v, table.valid_counts, env)
+    # globally sorted by the keys ⇒ equal keys contiguous per shard and
+    # (range partition) co-located across shards
+    out.grouped_by = tuple(by)
+    return out

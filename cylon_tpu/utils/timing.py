@@ -1,0 +1,57 @@
+"""Per-phase bench timers (the reference's CYLON_BENCH_TIMER analog).
+
+The reference wraps hot regions in a compile-time ``CYLON_BENCH_TIMER(ctx,
+tag, ...)`` macro that prints ``[BENCH] tag ms`` on rank 0 when built with
+``-D_CYLON_BENCH`` (util/macros.hpp:102-117).  Here the switch is the
+runtime flag ``config.BENCH_TIMINGS`` (env ``CYLON_TPU_BENCH=1``): when off,
+:func:`region` is a no-op context manager with near-zero overhead; when on,
+wall-time per named region accumulates in a process-global table that
+``bench.py`` snapshots into its phase-breakdown detail.
+
+JAX dispatch is async — a region covering only device work would time the
+dispatch, not the execution.  Regions are therefore placed around phases
+that end in a host synchronization (count-matrix pulls, ``np.asarray`` of
+sidecars); purely-async phases are flushed explicitly by the caller
+(``block=`` argument) when exact attribution matters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from .. import config
+
+#: name -> [total_seconds, call_count]
+_ACCUM: dict[str, list] = {}
+
+
+@contextlib.contextmanager
+def region(name: str, block=None):
+    """Time a named region (when ``config.BENCH_TIMINGS``).  ``block`` may be
+    a jax array (or pytree leaf list) to block_until_ready before stopping
+    the clock, charging async device work to this region."""
+    if not config.BENCH_TIMINGS:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if block is not None:
+            import jax
+            jax.block_until_ready(block)
+        dt = time.perf_counter() - t0
+        acc = _ACCUM.setdefault(name, [0.0, 0])
+        acc[0] += dt
+        acc[1] += 1
+
+
+def reset() -> None:
+    _ACCUM.clear()
+
+
+def snapshot() -> dict:
+    """{region: {"s": total_seconds, "n": calls}} sorted by cost."""
+    return {k: {"s": round(v[0], 4), "n": v[1]}
+            for k, v in sorted(_ACCUM.items(), key=lambda kv: -kv[1][0])}

@@ -106,3 +106,18 @@ def test_equals_nan(env4):
     t1 = ct.Table.from_pandas(df, env4)
     t2 = ct.Table.from_pandas(df.copy(), env4)
     assert equals(t1, t2)
+
+
+def test_setop_mixed_nullability(env4, rng):
+    """One side nullable, other not: operand structures must still align
+    (need_null_flags union) — regression for the round-2 packing change."""
+    import pandas as pd
+    a = pd.DataFrame({"x": [1.0, None, 3.0, 4.0]})
+    b = pd.DataFrame({"x": [3.0, 4.0, 5.0]})          # no nulls
+    ta = ct.Table.from_pandas(a, env4)
+    tb = ct.Table.from_pandas(b, env4)
+    got = set_operation(ta, tb, "intersect").to_pandas()
+    assert sorted(got["x"].tolist()) == [3.0, 4.0]
+    got2 = set_operation(ta, tb, "subtract").to_pandas()
+    vals = got2["x"].tolist()
+    assert len(vals) == 2 and 1.0 in vals  # {1.0, null}
