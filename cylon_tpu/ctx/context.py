@@ -167,6 +167,28 @@ class CylonEnv:
     def get_config(self, key: str, default: str = "") -> str:
         return self._conf.get(key, default)
 
+    # -- collective surface (reference net/communicator.hpp:31-69) ---------
+    def allgather(self, table):
+        """AllGather(Table): every shard receives every row."""
+        from ..parallel.collectives import allgather_table
+        return allgather_table(table)
+
+    def gather(self, table, root: int = 0):
+        """Gather(Table, root): all rows onto shard ``root``."""
+        from ..parallel.collectives import gather_table
+        return gather_table(table, root)
+
+    def bcast(self, table, root: int = 0):
+        """Bcast(Table): replicate shard ``root``'s rows to every shard."""
+        from ..parallel.collectives import bcast_table
+        return bcast_table(table, root)
+
+    def allreduce(self, column_or_array, op: str = "sum", valid_counts=None):
+        """AllReduce(Column, op): elementwise across shards -> host array.
+        Pass the owning table's ``valid_counts`` to mask capacity padding."""
+        from ..parallel.collectives import allreduce
+        return allreduce(column_or_array, op, valid_counts)
+
     def barrier(self) -> None:
         """Block until all queued device work is done (reference Barrier())."""
         for d in self._devices:

@@ -48,6 +48,145 @@ shard_map = jax.shard_map
 
 HOW = ("inner", "left", "right", "outer")
 
+#: heavy-key detection: per-shard sample size and global-share threshold
+SKEW_SAMPLE = 4096
+SKEW_MAX_KEYS = 8
+
+
+@lru_cache(maxsize=None)
+def _key_sample_fn(mesh: Mesh, m: int, with_valid: bool):
+    """Evenly spaced per-shard sample of a key column's live prefix."""
+
+    def per_shard(vc, key, valid):
+        cap = key.shape[0]
+        my = jax.lax.axis_index(ROW_AXIS)
+        n = vc[my]
+        # float stride avoids int32 overflow of arange(m)*n under x64=0;
+        # sampling needs no exactness, only in-range spread
+        stride = jnp.maximum(n, 1).astype(jnp.float32) / m
+        idx = (jnp.arange(m, dtype=jnp.float32) * stride).astype(jnp.int32)
+        idx = jnp.clip(idx, 0, cap - 1)
+        live = jnp.full((m,), n > 0)
+        if with_valid:
+            live = live & valid[idx]
+        return key[idx], live
+
+    specs = (REP, ROW) + ((ROW,) if with_valid else (REP,))
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+                             out_specs=(ROW, ROW)))
+
+
+def _heavy_keys(table: Table, key_name: str, env):
+    """Host-side heavy-hitter estimate from a small device sample: key
+    values whose sampled global share exceeds 1/world (a single key owning
+    a full shard's worth of rows).  Returns a small np array or None.
+    Reference analog: the sampled partition machinery (table.cpp:620-689)
+    applied to skew (SURVEY.md §7 hard-part 4)."""
+    col = table.column(key_name)
+    if col.data.dtype.kind not in ("i", "u"):
+        return None  # float keys: skip (NaN equality pitfalls)
+    with_valid = col.validity is not None
+    fn = _key_sample_fn(env.mesh, SKEW_SAMPLE, with_valid)
+    vc = np.asarray(table.valid_counts, np.int32)
+    args = (vc, col.data, col.validity) if with_valid \
+        else (vc, col.data, np.zeros(0, bool))
+    vals_d, live_d = fn(*args)
+    w = env.world_size
+    vals = np.asarray(vals_d).reshape(w, SKEW_SAMPLE)
+    live = np.asarray(live_d).reshape(w, SKEW_SAMPLE)
+    total = int(table.valid_counts.sum())
+    if total < w * 64:
+        return None
+    # weight each shard's sample by its true row share — unweighted pooling
+    # would let a tiny shard's keys dominate the global estimate
+    shares: dict = {}
+    for s in range(w):
+        lv = vals[s][live[s]]
+        if lv.size == 0:
+            continue
+        weight = float(table.valid_counts[s]) / total / lv.size
+        uniq, cnt = np.unique(lv, return_counts=True)
+        for u, c in zip(uniq[cnt / lv.size > 0.01], cnt[cnt / lv.size > 0.01]):
+            shares[u] = shares.get(u, 0.0) + c * weight
+    heavy = [(u, sh) for u, sh in shares.items() if sh > 1.0 / w]
+    if not heavy:
+        return None
+    heavy.sort(key=lambda x: -x[1])
+    return np.asarray([u for u, _ in heavy[:SKEW_MAX_KEYS]])
+
+
+@lru_cache(maxsize=None)
+def _heavy_flag_fn(mesh: Mesh, k: int, with_valid: bool):
+    def per_shard(heavy_vals, key, valid):
+        flag = jnp.zeros(key.shape[0], bool)
+        for j in range(k):
+            flag = flag | (key == heavy_vals[j])
+        if with_valid:
+            flag = flag & valid
+        return flag
+
+    specs = (REP, ROW) + ((ROW,) if with_valid else (REP,))
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+                             out_specs=ROW))
+
+
+def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
+                      how: str, env):
+    """Distributed co-location with heavy-key skew splitting.
+
+    Default: hash-shuffle both sides (reference table.cpp:219).  When the
+    probe side's sampled key distribution has heavy hitters (single-column
+    integer/string-code keys, inner/left/right joins), the probe side's
+    heavy rows are SPREAD round-robin instead of hashed and the build
+    side's heavy rows are replicated to every shard (duplicate-broadcast,
+    via AllGather(Table)) — peak per-shard memory stays ~input-sized
+    instead of one shard receiving the whole heavy key.
+
+    Returns (lwork, rwork, split_used)."""
+    from ..parallel import shuffle as shf
+    from ..parallel.collectives import allgather_table
+    from .repart import concat_tables, exchange_by_targets, filter_table
+
+    if how in ("inner", "left", "right") and len(left_on) == 1:
+        if how == "right":
+            probe, probe_key = rwork, right_on[0]
+            build, build_key = lwork, left_on[0]
+        else:
+            probe, probe_key = lwork, left_on[0]
+            build, build_key = rwork, right_on[0]
+        heavy = _heavy_keys(probe, probe_key, env)
+        if heavy is not None:
+            bcol = build.column(build_key)
+            if bcol.data.dtype.kind in ("i", "u"):
+                hv = np.asarray(heavy).astype(bcol.data.dtype)
+                with_valid = bcol.validity is not None
+                flag = _heavy_flag_fn(env.mesh, len(hv), with_valid)(
+                    hv, bcol.data,
+                    bcol.validity if with_valid else np.zeros(0, bool))
+                build_heavy = filter_table(build, flag)
+                # replication guard: if the BUILD side is itself heavy on
+                # these keys, W-way replication would recreate the blow-up
+                # the split exists to avoid — fall back to plain hashing
+                if (build_heavy.row_count * env.world_size
+                        > 2 * max(build.row_count, 1)
+                        and build_heavy.row_count > 65536):
+                    return (shuffle_table(lwork, left_on),
+                            shuffle_table(rwork, right_on), False)
+                build_light = filter_table(build, ~flag)
+                build_out = concat_tables(
+                    [shuffle_table(build_light, [build_key]),
+                     allgather_table(build_heavy)])
+                pcol = probe.column(probe_key)
+                tgt = shf.skew_targets(env.mesh, pcol.data, pcol.validity,
+                                       probe.valid_counts, hv)
+                counts = shf.count_targets(env.mesh, tgt)
+                probe_out = exchange_by_targets(probe, tgt, counts)
+                if how == "right":
+                    return build_out, probe_out, True
+                return probe_out, build_out, True
+    return (shuffle_table(lwork, left_on), shuffle_table(rwork, right_on),
+            False)
+
 
 def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
     """Concat-row liveness for (left ++ right) per shard."""
@@ -165,10 +304,11 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     lwork = left.with_columns(dict(zip(left_on, lkey_cols)))
     rwork = right.with_columns(dict(zip(right_on, rkey_cols)))
 
+    skew_split = False
     if env.world_size > 1:
         with timing.region("join.shuffle"):
-            lwork = shuffle_table(lwork, left_on)
-            rwork = shuffle_table(rwork, right_on)
+            lwork, rwork, skew_split = _shuffle_for_join(
+                lwork, rwork, left_on, right_on, how, env)
 
     l_key_cols = [lwork.column(n) for n in left_on]
     r_key_cols = [rwork.column(n) for n in right_on]
@@ -252,9 +392,11 @@ def join_tables(left: Table, right: Table, left_on, right_on,
                           tuple(c.data for c in r_cols_list),
                           tuple(c.validity for c in r_cols_list))
     out = build_table(names, out_d, out_v, types, dicts, counts, env)
-    if coalesce:
+    if coalesce and not skew_split:
         # join output rows are key-grouped per shard (sorted merge order) and
         # keys are co-located across shards (hash shuffle) -> groupby on the
-        # same keys can skip shuffle + rank (relational/groupby.py fast path)
+        # same keys can skip shuffle + rank (relational/groupby.py fast path).
+        # Skew splitting spreads heavy keys across shards, so the co-location
+        # half of the contract does not hold there.
         out.grouped_by = tuple(left_on)
     return out

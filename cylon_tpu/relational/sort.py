@@ -80,8 +80,10 @@ def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int):
         ko = pack.key_operands(list(by_datas), list(by_valids),
                                descendings=list(descendings),
                                nulls_position=nulls_position)
-        idx = (jnp.arange(m, dtype=jnp.int64) * jnp.maximum(n, 1)) // m
-        idx = jnp.clip(idx, 0, cap - 1).astype(jnp.int32)
+        # float stride avoids int32 overflow of arange(m)*n under x64=0
+        stride = jnp.maximum(n, 1).astype(jnp.float32) / m
+        idx = (jnp.arange(m, dtype=jnp.float32) * stride).astype(jnp.int32)
+        idx = jnp.clip(idx, 0, cap - 1)
         sampled = tuple(op[idx] for op in ko.ops)
         live = jnp.full((m,), True) & (n > 0)
         return sampled, live

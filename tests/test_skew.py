@@ -1,0 +1,130 @@
+"""Skewed-key exchanges must stay memory-bounded (round-1 VERDICT red flag
+3): the multi-round exchange caps the per-(src,dst) block near the uniform
+stream size, so an all-to-one key distribution runs in R > 1 rounds with
+W·block ≈ one shard of extra memory instead of W shards' worth.
+
+Reference analog: partition sampling machinery, table.cpp:620-689."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.parallel import shuffle as shf
+from cylon_tpu import config
+from cylon_tpu.relational import groupby_aggregate, join_tables, unique_table
+
+from utils import assert_table_matches
+
+
+def test_block_cap_bounds_send_memory():
+    # uniform: single round; skewed: bounded block, multiple rounds
+    w = 8
+    total = 1_000_000
+    cap = shf.exchange_block_cap(total, w)
+    assert cap <= config.pow2ceil(2 * total // (w * w))
+    max_skewed = int(0.9 * total)
+    rounds = -(-max_skewed // cap)
+    assert rounds > 1
+    # peak send buffer w*block is ~2x one shard, not w shards
+    assert w * cap <= 4 * (total // w + cap)
+
+
+def test_90pct_one_key_join_world8(env8, rng):
+    n = 40_000
+    keys_l = np.where(rng.random(n) < 0.9, 7, rng.integers(100, 2000, n))
+    keys_r = np.where(rng.random(64) < 0.5, 7, rng.integers(100, 2000, 64))
+    ldf = pd.DataFrame({"k": keys_l.astype(np.int64), "a": rng.random(n)})
+    rdf = pd.DataFrame({"k": keys_r.astype(np.int64), "b": rng.random(64)})
+    lt = ct.Table.from_pandas(ldf, env8)
+    rt = ct.Table.from_pandas(rdf, env8)
+    j = join_tables(lt, rt, "k", "k", how="inner")
+    exp = ldf.merge(rdf, on="k", how="inner")
+    assert j.row_count == len(exp)
+    g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+    eg = exp.groupby("k", as_index=False).agg(a_sum=("a", "sum"),
+                                              b_sum=("b", "sum"))
+    assert_table_matches(g, eg)
+
+
+def test_multi_round_exchange_preserves_order(env8, rng):
+    """Force R > 1 rounds on a small table by shrinking the block floor, and
+    check the order-preserving (src rank, src pos) receive contract."""
+    from cylon_tpu.parallel.shuffle import exchange, hash_targets, \
+        count_targets
+    import cylon_tpu.parallel.shuffle as sh
+
+    n = 4096
+    df = pd.DataFrame({"k": np.full(n, 3, np.int64),
+                       "v": np.arange(n, dtype=np.int64)})
+    t = ct.Table.from_pandas(df, env8)
+    tgt = hash_targets(env8.mesh, (t.column("k").data,), (None,),
+                       t.valid_counts)
+    counts = count_targets(env8.mesh, tgt)
+    assert int((counts > 0).sum(axis=1).max()) == 1  # all-to-one
+
+    orig = sh.exchange_block_cap
+    sh.exchange_block_cap = lambda total, w: 64   # tiny blocks -> many rounds
+    try:
+        new_cols, new_valid = exchange(env8.mesh, tgt,
+                                       counts, (t.column("v").data,))
+    finally:
+        sh.exchange_block_cap = orig
+    # single destination holds all rows, in (src rank, src pos) order
+    d = int(np.argmax(counts.sum(axis=0)))
+    cap = new_cols[0].shape[0] // env8.world_size
+    vals = np.asarray(new_cols[0])[d * cap: d * cap + n]
+    src_caps = t.capacity
+    expected = np.concatenate(
+        [np.arange(s * src_caps, s * src_caps + int(t.valid_counts[s]))
+         for s in range(env8.world_size)]) % (1 << 62)
+    # source values were v = global row index in ingest order
+    exp_vals = df["v"].to_numpy()
+    assert np.array_equal(np.sort(vals), np.sort(exp_vals))
+    # order-preserving: strictly increasing within each source segment
+    offs = np.cumsum([0] + [int(c) for c in t.valid_counts])
+    for s in range(env8.world_size):
+        seg = vals[offs[s]:offs[s + 1]]
+        assert np.all(np.diff(seg) > 0)
+
+
+def test_skewed_unique_world8(env8, rng):
+    n = 20_000
+    keys = np.where(rng.random(n) < 0.95, 1, rng.integers(2, 50, n))
+    df = pd.DataFrame({"k": keys.astype(np.int64)})
+    t = ct.Table.from_pandas(df, env8)
+    u = unique_table(t)
+    assert sorted(u.to_pandas()["k"].tolist()) == sorted(set(keys.tolist()))
+
+
+def test_heavy_key_split_balances_shards(env8, rng):
+    """90%-one-key probe side: the skew split must spread the heavy key
+    round-robin (balanced shards, ~input-sized peak) and replicate the
+    build side's heavy rows, with results identical to pandas."""
+    from cylon_tpu.relational import join as rjoin
+
+    n = 40_000
+    keys_l = np.where(rng.random(n) < 0.9, 7, rng.integers(100, 2000, n))
+    ldf = pd.DataFrame({"k": keys_l.astype(np.int64), "a": rng.random(n)})
+    rdf = pd.DataFrame({"k": np.arange(2000, dtype=np.int64),
+                        "b": rng.random(2000)})
+    lt = ct.Table.from_pandas(ldf, env8)
+    rt = ct.Table.from_pandas(rdf, env8)
+
+    heavy = rjoin._heavy_keys(lt, "k", env8)
+    assert heavy is not None and 7 in heavy.tolist()
+
+    lsh, rsh, split = rjoin._shuffle_for_join(lt, rt, ["k"], ["k"],
+                                              "inner", env8)
+    assert split
+    # probe side balanced: no shard holds more than ~2x the even share
+    assert int(lsh.valid_counts.max()) <= 2 * (n // 8) + 1024
+    # end-to-end correctness incl. left join (null side)
+    for how in ("inner", "left"):
+        j = join_tables(lt, rt, "k", "k", how=how)
+        assert j.grouped_by is None  # split breaks co-location
+        exp = ldf.merge(rdf, on="k", how=how)
+        assert j.row_count == len(exp)
+        g = groupby_aggregate(j, "k", [("a", "sum")])
+        eg = exp.groupby("k", as_index=False).agg(a_sum=("a", "sum"))
+        assert_table_matches(g, eg)
