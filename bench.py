@@ -120,9 +120,12 @@ def main() -> dict:
     rows = None
     unique = 0.9
     iters = 4
+    scale = None
     for a in sys.argv[1:]:
         if a.startswith("--rows="):
             rows = int(a.split("=", 1)[1])
+        elif a.startswith("--scale="):
+            scale = float(a.split("=", 1)[1])
         elif a.startswith("--unique="):
             unique = float(a.split("=", 1)[1])
         elif a.startswith("--iters="):
@@ -130,7 +133,7 @@ def main() -> dict:
 
     if "--tpch" in sys.argv:
         from cylon_tpu.tpch import bench_tpch
-        return bench_tpch(scale=rows or 1)
+        return bench_tpch(scale=scale if scale is not None else 0.1)
 
     if rows is None:
         rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000

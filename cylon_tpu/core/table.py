@@ -88,8 +88,11 @@ class Table:
 
     @staticmethod
     def from_arrow(at, env: CylonEnv | None = None) -> "Table":
-        """From a pyarrow.Table (reference Table::FromArrowTable, table.hpp:61)."""
-        return Table.from_pandas(at.to_pandas(), env)
+        """From a pyarrow.Table via direct buffer conversion — no pandas
+        object round trip (reference Table::FromArrowTable, table.hpp:61;
+        conversion rules in core/arrow_interop.py)."""
+        from .arrow_interop import table_from_arrow
+        return table_from_arrow(at, env)
 
     @staticmethod
     def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
@@ -187,8 +190,8 @@ class Table:
         return pd.DataFrame(out)
 
     def to_arrow(self):
-        import pyarrow as pa
-        return pa.Table.from_pandas(self.to_pandas(), preserve_index=False)
+        from .arrow_interop import table_to_arrow
+        return table_to_arrow(self)
 
     def to_pylist(self) -> list[dict]:
         return self.to_pandas().to_dict("records")

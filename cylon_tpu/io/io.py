@@ -52,23 +52,63 @@ def _read_many(files: list[str], read_one, parallel: bool = True):
     return pd.concat(dfs, ignore_index=True)
 
 
+def _concat_arrow(tables):
+    import pyarrow as pa
+    if len(tables) == 1:
+        return tables[0]
+    return pa.concat_tables(tables, promote_options="default")
+
+
+def _read_many_arrow(files: list[str], read_one, parallel: bool = True):
+    """Threaded multi-file Arrow read (reference ReadCSVThread,
+    table.cpp:1167)."""
+    if len(files) == 1:
+        return read_one(files[0])
+    if parallel:
+        with ThreadPoolExecutor(max_workers=min(8, len(files))) as ex:
+            ats = list(ex.map(read_one, files))
+    else:
+        ats = [read_one(f) for f in files]
+    return _concat_arrow(ats)
+
+
 def read_csv(paths, env: CylonEnv | None = None, **kwargs) -> Table:
-    import pandas as pd
+    """Arrow-native CSV read (reference io/arrow_io.cpp FromCSV) — the
+    column buffers go straight to host arrays, no pandas object round trip.
+    Passing pandas-specific kwargs falls back to the pandas reader."""
     files = _expand(paths)
-    df = _read_many(files, lambda f: pd.read_csv(f, **kwargs))
-    return Table.from_pandas(df, env)
+    if kwargs:
+        import pandas as pd
+        df = _read_many(files, lambda f: pd.read_csv(f, **kwargs))
+        return Table.from_pandas(df, env)
+    from pyarrow import csv as pacsv
+    at = _read_many_arrow(files, lambda f: pacsv.read_csv(f))
+    return Table.from_arrow(at, env)
 
 
 def read_parquet(paths, env: CylonEnv | None = None, **kwargs) -> Table:
-    import pandas as pd
     files = _expand(paths)
-    df = _read_many(files, lambda f: pd.read_parquet(f, **kwargs))
-    return Table.from_pandas(df, env)
+    if kwargs:
+        import pandas as pd
+        df = _read_many(files, lambda f: pd.read_parquet(f, **kwargs))
+        return Table.from_pandas(df, env)
+    import pyarrow.parquet as pq
+    at = _read_many_arrow(files, lambda f: pq.read_table(f))
+    return Table.from_arrow(at, env)
 
 
 def read_json(paths, env: CylonEnv | None = None, **kwargs) -> Table:
-    import pandas as pd
     files = _expand(paths)
+    if not kwargs:
+        # pyarrow's reader only speaks newline-delimited JSON; fall back to
+        # pandas for array-of-objects files
+        try:
+            from pyarrow import json as pajson
+            at = _read_many_arrow(files, lambda f: pajson.read_json(f))
+            return Table.from_arrow(at, env)
+        except Exception:  # noqa: BLE001 — e.g. pyarrow.ArrowInvalid
+            pass
+    import pandas as pd
     kwargs.setdefault("lines", str(files[0]).endswith(".jsonl"))
     df = _read_many(files, lambda f: pd.read_json(f, **kwargs))
     return Table.from_pandas(df, env)
@@ -135,26 +175,33 @@ def read_csv_dist(paths, env: CylonEnv, **kwargs) -> Table:
     partition (reference distributed_io.py:10-44).  The controller reads all
     files but assigns rows to shards following the same file->rank division,
     so resulting partition boundaries match the reference exactly."""
-    import pandas as pd
     files = _expand(paths)
     w = env.world_size
     per_rank: list[list[str]] = [[] for _ in range(w)]
     for i, f in enumerate(files):
         per_rank[i % w].append(f)
-    frames = []
-    counts = []
-    for fl in per_rank:
-        if fl:
-            df = _read_many(fl, lambda f: pd.read_csv(f, **kwargs))
-        else:
-            df = None
-        frames.append(df)
-        counts.append(0 if df is None else len(df))
-    non_empty = [f for f in frames if f is not None]
-    if not non_empty:
-        raise CylonIOError("no data read")
-    allf = pd.concat(non_empty, ignore_index=True)
-    t = Table.from_pandas(allf, env)
+    if kwargs:  # pandas-specific options: per-rank pandas reads
+        import pandas as pd
+        read_one = lambda fl: _read_many(fl, lambda f: pd.read_csv(f, **kwargs))
+        parts = [(read_one(fl) if fl else None) for fl in per_rank]
+        counts = [0 if p is None else len(p) for p in parts]
+        live = [p for p in parts if p is not None]
+        if not live:
+            raise CylonIOError("no data read")
+        t = Table.from_pandas(pd.concat(live, ignore_index=True), env)
+    else:
+        from pyarrow import csv as pacsv
+        parts, counts = [], []
+        for fl in per_rank:
+            if fl:
+                at = _read_many_arrow(fl, lambda f: pacsv.read_csv(f))
+                parts.append(at)
+                counts.append(at.num_rows)
+            else:
+                counts.append(0)
+        if not parts:
+            raise CylonIOError("no data read")
+        t = Table.from_arrow(_concat_arrow(parts), env)
     from ..relational import repartition
     return repartition(t, tuple(counts))
 
@@ -162,7 +209,6 @@ def read_csv_dist(paths, env: CylonEnv, **kwargs) -> Table:
 def read_parquet_dist(paths, env: CylonEnv, **kwargs) -> Table:
     """Row-group-balanced parquet read (reference distributed_io.py:146):
     row groups are assigned round-robin to ranks by size."""
-    import pandas as pd
     import pyarrow.parquet as pq
     files = _expand(paths)
     w = env.world_size
@@ -180,20 +226,17 @@ def read_parquet_dist(paths, env: CylonEnv, **kwargs) -> Table:
         r = int(np.argmin(loads))
         assign[r].append(u)
         loads[r] += u[2]
-    frames, counts = [], []
+    parts, counts = [], []
     for r in range(w):
         if assign[r]:
-            parts = [pq.ParquetFile(f).read_row_group(g).to_pandas()
-                     for f, g, _ in assign[r]]
-            df = pd.concat(parts, ignore_index=True)
+            ats = [pq.ParquetFile(f).read_row_group(g)
+                   for f, g, _ in assign[r]]
+            parts.append(_concat_arrow(ats))
+            counts.append(parts[-1].num_rows)
         else:
-            df = None
-        frames.append(df)
-        counts.append(0 if df is None else len(df))
-    non_empty = [f for f in frames if f is not None]
-    if not non_empty:
+            counts.append(0)
+    if not parts:
         raise CylonIOError("no data read")
-    allf = pd.concat(non_empty, ignore_index=True)
-    t = Table.from_pandas(allf, env)
+    t = Table.from_arrow(_concat_arrow(parts), env)
     from ..relational import repartition
     return repartition(t, tuple(counts))

@@ -28,6 +28,15 @@ REP = P()
 PAD_L, PAD_R = 4, 5
 
 
+def sample_positions(n, m: int, cap: int) -> jax.Array:
+    """m evenly spaced in-range row positions over a live prefix of traced
+    length ``n`` (float stride: arange(m)*n would overflow int32 under
+    x64=0).  Shared by sort splitter sampling and skew-key sampling."""
+    stride = jnp.maximum(n, 1).astype(jnp.float32) / m
+    idx = (jnp.arange(m, dtype=jnp.float32) * stride).astype(jnp.int32)
+    return jnp.clip(idx, 0, cap - 1)
+
+
 def live_mask(vc: jax.Array, cap: int) -> jax.Array:
     """Per-shard row-liveness mask (call inside shard_map): the first
     ``vc[my_rank]`` rows of the shard are real, the rest padding."""

@@ -41,6 +41,7 @@ from ..ops import pack
 from ..status import InvalidError
 from ..utils import timing
 from .common import (PAD_L, PAD_R, REP, ROW, build_table, check_same_env,
+                     sample_positions,
                      col_arrays, live_mask, narrow32_flags, promote_key_pair)
 from .repart import shuffle_table
 
@@ -61,11 +62,7 @@ def _key_sample_fn(mesh: Mesh, m: int, with_valid: bool):
         cap = key.shape[0]
         my = jax.lax.axis_index(ROW_AXIS)
         n = vc[my]
-        # float stride avoids int32 overflow of arange(m)*n under x64=0;
-        # sampling needs no exactness, only in-range spread
-        stride = jnp.maximum(n, 1).astype(jnp.float32) / m
-        idx = (jnp.arange(m, dtype=jnp.float32) * stride).astype(jnp.int32)
-        idx = jnp.clip(idx, 0, cap - 1)
+        idx = sample_positions(n, m, cap)
         live = jnp.full((m,), n > 0)
         if with_valid:
             live = live & valid[idx]
@@ -85,18 +82,18 @@ def _heavy_keys(table: Table, key_name: str, env):
     col = table.column(key_name)
     if col.data.dtype.kind not in ("i", "u"):
         return None  # float keys: skip (NaN equality pitfalls)
+    w = env.world_size
+    total = int(table.valid_counts.sum())
+    if total < w * 64:  # too small to skew-split — skip the device sample
+        return None
     with_valid = col.validity is not None
     fn = _key_sample_fn(env.mesh, SKEW_SAMPLE, with_valid)
     vc = np.asarray(table.valid_counts, np.int32)
     args = (vc, col.data, col.validity) if with_valid \
         else (vc, col.data, np.zeros(0, bool))
     vals_d, live_d = fn(*args)
-    w = env.world_size
     vals = np.asarray(vals_d).reshape(w, SKEW_SAMPLE)
     live = np.asarray(live_d).reshape(w, SKEW_SAMPLE)
-    total = int(table.valid_counts.sum())
-    if total < w * 64:
-        return None
     # weight each shard's sample by its true row share — unweighted pooling
     # would let a tiny shard's keys dominate the global estimate
     shares: dict = {}

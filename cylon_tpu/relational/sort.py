@@ -32,7 +32,8 @@ from ..ctx.context import ROW_AXIS
 from ..ops import pack
 from ..ops import sort as sortk
 from ..status import InvalidError
-from .common import PAD_L, REP, ROW, col_arrays, live_mask, rebuild_like
+from .common import (PAD_L, REP, ROW, col_arrays, live_mask, rebuild_like,
+                     sample_positions)
 from .repart import exchange_by_targets
 from ..parallel import shuffle
 
@@ -80,10 +81,7 @@ def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int):
         ko = pack.key_operands(list(by_datas), list(by_valids),
                                descendings=list(descendings),
                                nulls_position=nulls_position)
-        # float stride avoids int32 overflow of arange(m)*n under x64=0
-        stride = jnp.maximum(n, 1).astype(jnp.float32) / m
-        idx = (jnp.arange(m, dtype=jnp.float32) * stride).astype(jnp.int32)
-        idx = jnp.clip(idx, 0, cap - 1)
+        idx = sample_positions(n, m, cap)
         sampled = tuple(op[idx] for op in ko.ops)
         live = jnp.full((m,), True) & (n > 0)
         return sampled, live

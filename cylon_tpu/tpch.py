@@ -1,0 +1,250 @@
+"""TPC-H subset: data generator + Q3/Q5 on the DataFrame API.
+
+The reference validated its relational engine on TPC-xBB / TPC-H-style
+workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
+SF10 Q3/Q5 on 8 ranks).  This module provides:
+
+* :func:`generate_tables` — a numpy dbgen-alike for the six tables Q3/Q5
+  touch (customer, orders, lineitem, supplier, nation, region) with the
+  standard cardinalities (150K/1.5M/~6M/10K/25/5 rows x SF) and the value
+  distributions the two queries are sensitive to (mktsegment 5-way uniform,
+  order dates uniform over 1992-1998, discount 0-0.10, one region in 5);
+* :func:`q3` / :func:`q5` — the queries written against the public
+  DataFrame API (filter -> merge -> arithmetic -> groupby -> sort -> head),
+  exactly how a user would port them;
+* :func:`q3_pandas` / :func:`q5_pandas` — the pandas oracle;
+* :func:`bench_tpch` — the ``bench.py --tpch`` entry.
+
+Dates are datetime64[ns] columns; scalar date predicates compare against
+integer nanoseconds (``_ts``) since epoch.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pandas as pd
+
+SEGMENTS = np.asarray(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                       "MACHINERY"])
+REGIONS = np.asarray(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"])
+NATIONS = np.asarray(
+    ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+     "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+     "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA", "ROMANIA",
+     "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM", "UNITED STATES"])
+#: n_nationkey -> n_regionkey per the TPC-H spec nation table
+NATION_REGION = np.asarray([0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0,
+                            0, 1, 2, 3, 4, 2, 3, 3, 1])
+
+
+def _ts(date: str) -> int:
+    return int(pd.Timestamp(date).value)
+
+
+def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
+    """Host-side table generation (pandas dict) at TPC-H scale ``scale``."""
+    rng = np.random.default_rng(seed)
+    n_cust = max(int(150_000 * scale), 10)
+    n_ord = max(int(1_500_000 * scale), 40)
+    n_supp = max(int(10_000 * scale), 5)
+    lines_per_order = rng.integers(1, 8, n_ord)
+    n_line = int(lines_per_order.sum())
+
+    day = 24 * 3600 * 1_000_000_000
+    d0 = _ts("1992-01-01")
+    span = (_ts("1998-08-02") - d0) // day
+
+    customer = pd.DataFrame({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)],
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+    })
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
+        "o_orderdate": (d0 + rng.integers(0, span, n_ord) * day
+                        ).astype("datetime64[ns]"),
+        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+    })
+    l_orderkey = np.repeat(orders["o_orderkey"].to_numpy(), lines_per_order)
+    ship_delay = rng.integers(1, 122, n_line) * day
+    lineitem = pd.DataFrame({
+        "l_orderkey": l_orderkey.astype(np.int64),
+        "l_suppkey": rng.integers(0, n_supp, n_line).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_line), 2),
+        "l_discount": np.round(rng.integers(0, 11, n_line) * 0.01, 2),
+        "l_shipdate": (np.repeat(orders["o_orderdate"].to_numpy(),
+                                 lines_per_order).astype(np.int64)
+                       + ship_delay).astype("datetime64[ns]"),
+    })
+    supplier = pd.DataFrame({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
+    })
+    nation = pd.DataFrame({
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": NATIONS,
+        "n_regionkey": NATION_REGION.astype(np.int64),
+    })
+    region = pd.DataFrame({
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS,
+    })
+    return {"customer": customer, "orders": orders, "lineitem": lineitem,
+            "supplier": supplier, "nation": nation, "region": region}
+
+
+def generate_tables(scale: float = 0.01, env=None, seed: int = 0) -> dict:
+    """Device-resident DataFrames for all six tables."""
+    from .frame import DataFrame
+    pdfs = generate_pandas(scale, seed)
+    return {k: DataFrame(v, env=env) for k, v in pdfs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Q3 — shipping priority
+# ---------------------------------------------------------------------------
+
+def q3(dfs: dict, env=None, segment: str = "BUILDING",
+       date: str = "1995-03-15"):
+    """SELECT l_orderkey, sum(l_extendedprice*(1-l_discount)) AS revenue,
+    o_orderdate, o_shippriority FROM customer, orders, lineitem WHERE
+    c_mktsegment = :segment AND c_custkey = o_custkey AND l_orderkey =
+    o_orderkey AND o_orderdate < :date AND l_shipdate > :date GROUP BY
+    l_orderkey, o_orderdate, o_shippriority ORDER BY revenue DESC,
+    o_orderdate LIMIT 10."""
+    cust = dfs["customer"]
+    orders = dfs["orders"]
+    line = dfs["lineitem"]
+    t = _ts(date)
+
+    c = cust[cust["c_mktsegment"] == segment]
+    o = orders[orders["o_orderdate"] < t]
+    l = line[line["l_shipdate"] > t]
+
+    co = c.merge(o, left_on="c_custkey", right_on="o_custkey", env=env)
+    col = co.merge(l, left_on="o_orderkey", right_on="l_orderkey", env=env)
+    col["revenue"] = col["l_extendedprice"] * (1.0 - col["l_discount"])
+    g = (col.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                     env=env)[["revenue"]].sum())
+    out = g.sort_values(["revenue", "o_orderdate"],
+                        ascending=[False, True], env=env).head(10)
+    return out[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+
+
+def q3_pandas(pdfs: dict, segment: str = "BUILDING",
+              date: str = "1995-03-15") -> pd.DataFrame:
+    t = pd.Timestamp(date)
+    c = pdfs["customer"]
+    c = c[c.c_mktsegment == segment]
+    o = pdfs["orders"]
+    o = o[o.o_orderdate < t]
+    l = pdfs["lineitem"]
+    l = l[l.l_shipdate > t]
+    j = c.merge(o, left_on="c_custkey", right_on="o_custkey") \
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+    j["revenue"] = j.l_extendedprice * (1.0 - j.l_discount)
+    g = (j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                   as_index=False)["revenue"].sum())
+    g = g.sort_values(["revenue", "o_orderdate"],
+                      ascending=[False, True]).head(10)
+    return g[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]] \
+        .reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q5 — local supplier volume
+# ---------------------------------------------------------------------------
+
+def q5(dfs: dict, env=None, region: str = "ASIA",
+       date_lo: str = "1994-01-01", date_hi: str = "1995-01-01"):
+    """SELECT n_name, sum(l_extendedprice*(1-l_discount)) AS revenue FROM
+    customer, orders, lineitem, supplier, nation, region WHERE c_custkey =
+    o_custkey AND l_orderkey = o_orderkey AND l_suppkey = s_suppkey AND
+    c_nationkey = s_nationkey AND s_nationkey = n_nationkey AND n_regionkey
+    = r_regionkey AND r_name = :region AND o_orderdate >= :lo AND
+    o_orderdate < :hi GROUP BY n_name ORDER BY revenue DESC."""
+    lo, hi = _ts(date_lo), _ts(date_hi)
+    reg = dfs["region"]
+    reg = reg[reg["r_name"] == region]
+    nat = dfs["nation"].merge(reg, left_on="n_regionkey",
+                              right_on="r_regionkey", env=env)
+    sup = dfs["supplier"].merge(nat, left_on="s_nationkey",
+                                right_on="n_nationkey", env=env)
+    o = dfs["orders"]
+    o = o[(o["o_orderdate"] >= lo) & (o["o_orderdate"] < hi)]
+    co = dfs["customer"].merge(o, left_on="c_custkey", right_on="o_custkey",
+                               env=env)
+    col = co.merge(dfs["lineitem"], left_on="o_orderkey",
+                   right_on="l_orderkey", env=env)
+    # l_suppkey = s_suppkey AND c_nationkey = s_nationkey (two-column key)
+    j = col.merge(sup, left_on=["l_suppkey", "c_nationkey"],
+                  right_on=["s_suppkey", "s_nationkey"], env=env)
+    j["revenue"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    g = j.groupby(["n_name"], env=env)[["revenue"]].sum()
+    return g.sort_values("revenue", ascending=False,
+                         env=env)[["n_name", "revenue"]]
+
+
+def q5_pandas(pdfs: dict, region: str = "ASIA", date_lo: str = "1994-01-01",
+              date_hi: str = "1995-01-01") -> pd.DataFrame:
+    lo, hi = pd.Timestamp(date_lo), pd.Timestamp(date_hi)
+    reg = pdfs["region"]
+    reg = reg[reg.r_name == region]
+    nat = pdfs["nation"].merge(reg, left_on="n_regionkey",
+                               right_on="r_regionkey")
+    sup = pdfs["supplier"].merge(nat, left_on="s_nationkey",
+                                 right_on="n_nationkey")
+    o = pdfs["orders"]
+    o = o[(o.o_orderdate >= lo) & (o.o_orderdate < hi)]
+    j = (pdfs["customer"].merge(o, left_on="c_custkey", right_on="o_custkey")
+         .merge(pdfs["lineitem"], left_on="o_orderkey",
+                right_on="l_orderkey")
+         .merge(sup, left_on=["l_suppkey", "c_nationkey"],
+                right_on=["s_suppkey", "s_nationkey"]))
+    j["revenue"] = j.l_extendedprice * (1.0 - j.l_discount)
+    g = j.groupby("n_name", as_index=False)["revenue"].sum()
+    return g.sort_values("revenue", ascending=False)[
+        ["n_name", "revenue"]].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# bench entry (bench.py --tpch)
+# ---------------------------------------------------------------------------
+
+def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
+    import jax
+    import cylon_tpu as ct
+    from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+
+    devs = jax.devices()
+    on_accel = devs[0].platform != "cpu"
+    env = ct.CylonEnv(config=TPUConfig() if on_accel else CPUMeshConfig())
+    dfs = generate_tables(scale=scale, env=env)
+
+    def run_query(fn):
+        def step():
+            out = fn(dfs, env=env)
+            out.to_pandas()  # materialize to host = full completion barrier
+            return out
+        step()  # warmup/compile
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            step()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    t3 = run_query(q3)
+    t5 = run_query(q5)
+    return {
+        "metric": f"TPC-H SF{scale:g} Q3+Q5 wall time",
+        "value": round(t3 + t5, 4),
+        "unit": "seconds",
+        "vs_baseline": 0.0,
+        "detail": {"world": env.world_size, "platform": devs[0].platform,
+                   "scale": scale, "q3_s": round(t3, 4),
+                   "q5_s": round(t5, 4)},
+    }

