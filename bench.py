@@ -133,7 +133,8 @@ def main() -> dict:
 
     if "--tpch" in sys.argv:
         from cylon_tpu.tpch import bench_tpch
-        return bench_tpch(scale=scale if scale is not None else 0.1)
+        return bench_tpch(scale=scale if scale is not None else 0.1,
+                          iters=iters)
 
     if rows is None:
         rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000

@@ -80,6 +80,17 @@ def column_from_arrow(arr) -> Column:
         data = np.asarray(arr.fill_null(False))
         return Column(data, LogicalType.BOOL, validity)
 
+    if pa.types.is_null(t):
+        # arrow 'null' (e.g. an all-empty CSV column) -> all-null float64,
+        # matching what the pandas reader produced
+        n = len(arr)
+        return Column(np.zeros(n, np.float64), LogicalType.FLOAT64,
+                      np.zeros(n, bool))
+
+    if pa.types.is_decimal(t):
+        arr = arr.cast(pa.float64())
+        t = arr.type
+
     if pa.types.is_integer(t) or pa.types.is_floating(t):
         filled = arr.fill_null(0) if arr.null_count else arr
         data = np.asarray(filled)
@@ -105,21 +116,17 @@ def table_to_arrow(table):
     """Device Table -> pyarrow.Table with faithful types (reference
     Table::ToArrowTable)."""
     import pyarrow as pa
-    w = table.env.world_size
-    cap = table.capacity
     arrays, names = [], []
     for name, c in table.columns.items():
-        host = np.asarray(c.data)
-        valid = np.asarray(c.validity) if c.validity is not None else None
-        sl = [slice(i * cap, i * cap + int(table.valid_counts[i]))
-              for i in range(w)]
-        data = np.concatenate([host[s] for s in sl]) if sl else host[:0]
-        mask = (~np.concatenate([valid[s] for s in sl])
-                if valid is not None else None)
+        data, valid = table.host_column(name)
+        mask = ~valid if valid is not None else None
         if c.type == LogicalType.STRING:
             idx = pa.array(data.astype(np.int32), mask=mask)
             arr = pa.DictionaryArray.from_arrays(
                 idx, pa.array(c.dictionary.astype(object)))
+            # faithful schema: sources are typically plain utf8, and our
+            # dictionary-encoding is an internal representation choice
+            arr = arr.dictionary_decode()
         elif c.type == LogicalType.DATE64:
             arr = pa.array(data, type=pa.timestamp("ns"), mask=mask)
         elif c.type == LogicalType.TIMEDELTA:

@@ -175,17 +175,27 @@ class Table:
         return Table(cols, self._env, self._valid)
 
     # -- materialization ---------------------------------------------------
-    def to_pandas(self):
-        import pandas as pd
+    def host_column(self, name: str):
+        """(data, validity) host arrays of one column's live rows in global
+        order (shard valid prefixes concatenated) — the one materialization
+        path shared by to_pandas/to_arrow; multi-host aware."""
+        from ..utils.host import host_array
+        c = self.column(name)
         w = self._env.world_size
         cap = self.capacity
+        host = host_array(c.data)
+        valid = host_array(c.validity) if c.validity is not None else None
+        sl = [slice(i * cap, i * cap + int(self._valid[i])) for i in range(w)]
+        data = np.concatenate([host[s] for s in sl]) if sl else host[:0]
+        vcat = (np.concatenate([valid[s] for s in sl])
+                if valid is not None else None)
+        return data, vcat
+
+    def to_pandas(self):
+        import pandas as pd
         out = {}
         for k, c in self._cols.items():
-            host = np.asarray(c.data)
-            valid = np.asarray(c.validity) if c.validity is not None else None
-            sl = [slice(i * cap, i * cap + int(self._valid[i])) for i in range(w)]
-            data = np.concatenate([host[s] for s in sl]) if sl else host[:0]
-            vcat = np.concatenate([valid[s] for s in sl]) if valid is not None else None
+            data, vcat = self.host_column(k)
             out[k] = Column(data, c.type, vcat, c.dictionary).to_numpy(len(data))
         return pd.DataFrame(out)
 
@@ -201,6 +211,18 @@ class Table:
                 f"world={self._env.world_size}, cap={self.capacity})")
 
 
+def _put(host: np.ndarray, sharding):
+    """Place a host array under a sharding.  device_put in single-controller
+    mode; in multi-controller (jax.distributed) mode each process holds the
+    same full host copy and materializes only its addressable shards
+    (SPMD ingest — the reference's per-rank partition reads)."""
+    import jax as _jax
+    if _jax.process_count() > 1:
+        return _jax.make_array_from_callback(host.shape, sharding,
+                                             lambda idx: host[idx])
+    return _jax.device_put(host, sharding)
+
+
 def _place_local(cols: dict[str, Column], env: CylonEnv) -> dict[str, Column]:
     """Place host-built columns onto the env's (single) device — only the
     env's devices are ever touched, never the process default backend (the
@@ -208,8 +230,8 @@ def _place_local(cols: dict[str, Column], env: CylonEnv) -> dict[str, Column]:
     sharding = env.sharding()
     out = {}
     for k, c in cols.items():
-        data = jax.device_put(np.asarray(c.data), sharding)
-        v = (jax.device_put(np.asarray(c.validity), sharding)
+        data = _put(np.asarray(c.data), sharding)
+        v = (_put(np.asarray(c.validity), sharding)
              if c.validity is not None else None)
         out[k] = Column(data, c.type, v, c.dictionary, bounds=c.bounds)
     return out
@@ -242,8 +264,8 @@ def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:
                 padded[i * cap: i * cap + m] = host[i * chunk: i * chunk + m]
                 if vpad is not None:
                     vpad[i * cap: i * cap + m] = vhost[i * chunk: i * chunk + m]
-        data = jax.device_put(padded, sharding)
-        v = jax.device_put(vpad, sharding) if vpad is not None else None
+        data = _put(padded, sharding)
+        v = _put(vpad, sharding) if vpad is not None else None
         # padding rows are zeros — covered by widening bounds to include 0
         b = c.bounds
         if b is not None:

@@ -190,13 +190,19 @@ class CylonEnv:
         return allreduce(column_or_array, op, valid_counts)
 
     def barrier(self) -> None:
-        """Block until all queued device work is done (reference Barrier())."""
+        """Synchronization barrier (reference Barrier()).
+
+        Multi-process (``jax.distributed``): a REAL cross-process barrier —
+        every process blocks until all reach it (the reference's
+        MPI_Barrier).  Single-process: drains queued work on every device
+        of the env."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"cylon_env_barrier_{next(_seq)}")
+            return
         for d in self._devices:
-            try:
-                jax.block_until_ready(
-                    jax.device_put(np.zeros((), np.int32), d))
-            except Exception:  # pragma: no cover - defensive
-                pass
+            jax.block_until_ready(jax.device_put(np.zeros((), np.int32), d))
 
     def finalize(self) -> None:
         self._finalized = True

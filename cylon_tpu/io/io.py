@@ -23,7 +23,7 @@ import numpy as np
 
 from ..core.table import Table
 from ..ctx.context import CylonEnv
-from ..status import CylonIOError
+from ..status import CylonIOError, CylonTypeError
 
 
 def _expand(paths) -> list[str]:
@@ -82,8 +82,13 @@ def read_csv(paths, env: CylonEnv | None = None, **kwargs) -> Table:
         df = _read_many(files, lambda f: pd.read_csv(f, **kwargs))
         return Table.from_pandas(df, env)
     from pyarrow import csv as pacsv
-    at = _read_many_arrow(files, lambda f: pacsv.read_csv(f))
-    return Table.from_arrow(at, env)
+    try:
+        at = _read_many_arrow(files, lambda f: pacsv.read_csv(f))
+        return Table.from_arrow(at, env)
+    except CylonTypeError:
+        import pandas as pd
+        df = _read_many(files, lambda f: pd.read_csv(f))
+        return Table.from_pandas(df, env)
 
 
 def read_parquet(paths, env: CylonEnv | None = None, **kwargs) -> Table:
@@ -93,8 +98,13 @@ def read_parquet(paths, env: CylonEnv | None = None, **kwargs) -> Table:
         df = _read_many(files, lambda f: pd.read_parquet(f, **kwargs))
         return Table.from_pandas(df, env)
     import pyarrow.parquet as pq
-    at = _read_many_arrow(files, lambda f: pq.read_table(f))
-    return Table.from_arrow(at, env)
+    try:
+        at = _read_many_arrow(files, lambda f: pq.read_table(f))
+        return Table.from_arrow(at, env)
+    except CylonTypeError:
+        import pandas as pd
+        df = _read_many(files, lambda f: pd.read_parquet(f))
+        return Table.from_pandas(df, env)
 
 
 def read_json(paths, env: CylonEnv | None = None, **kwargs) -> Table:

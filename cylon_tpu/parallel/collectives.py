@@ -193,4 +193,4 @@ def allreduce(table_or_column, op: str = "sum", valid_counts=None):
     vc = (np.asarray(valid_counts, np.int32) if valid_counts is not None
           else np.full(w, cap, np.int32))
     (res,) = _allreduce_fn(mesh, op, 1)(vc, arr)
-    return np.asarray(res)
+    return np.asarray(res)  # out_specs REP: replicated, locally addressable

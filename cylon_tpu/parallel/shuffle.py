@@ -91,7 +91,8 @@ def _count_fn(mesh: Mesh, w: int):
 def count_targets(mesh: Mesh, tgt) -> np.ndarray:
     """(W, W) host count matrix: C[s, d] = rows rank s sends to rank d."""
     w = mesh.devices.size
-    return np.asarray(_count_fn(mesh, w)(tgt))
+    from ..utils.host import host_array
+    return host_array(_count_fn(mesh, w)(tgt))
 
 
 @lru_cache(maxsize=None)

@@ -31,6 +31,7 @@ from ..ops import groupby as gbk
 from ..ops import pack
 from ..status import InvalidError
 from ..utils import timing
+from ..utils.host import host_array
 from .common import PAD_L, REP, ROW, col_arrays, live_mask, narrow32_flags
 from .repart import shuffle_table
 
@@ -317,7 +318,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         key_out, kval_out, inter_out, n_groups = _combine_fn(
             env.mesh, ops_t, seg_cap, False, narrow)(
                 vc, by_datas, by_valids, val_datas, val_valids)
-        n_groups = np.asarray(n_groups, np.int64)
+        n_groups = host_array(n_groups).astype(np.int64)
         # intermediate table: keys + flat intermediate columns
         cols = {}
         for n, c, d, v in zip(by, by_cols, key_out, kval_out):
@@ -342,7 +343,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         key2, kval2, res_d, res_v, ng2 = _final_fn(
             env.mesh, ops_t, max(shuffled.capacity, 1), ddof, narrow)(
                 vc2, s_by_datas, s_by_valids, inter_by_op)
-        ng2 = np.asarray(ng2, np.int64)
+        ng2 = host_array(ng2).astype(np.int64)
         out = _result_table(env, by, by_cols, key2, kval2, res_names, res_d,
                             res_v, res_types, res_dicts, ng2)
         out = _shrink(out, ng2)
@@ -362,7 +363,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
             env.mesh, spec_t, max(work.capacity, 1), ddof, grouped, narrow)(
                 vc, by_datas, by_valids, val_datas, val_valids)
-        n_groups = np.asarray(n_groups, np.int64)
+        n_groups = host_array(n_groups).astype(np.int64)
     out = _result_table(env, by, by_cols, key_out, kval_out, res_names, res_d,
                         res_v, res_types, res_dicts, n_groups)
     out = _shrink(out, n_groups)

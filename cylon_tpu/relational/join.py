@@ -40,6 +40,7 @@ from ..ops import lanes
 from ..ops import pack
 from ..status import InvalidError
 from ..utils import timing
+from ..utils.host import host_array
 from .common import (PAD_L, PAD_R, REP, ROW, build_table, check_same_env,
                      sample_positions,
                      col_arrays, live_mask, narrow32_flags, promote_key_pair)
@@ -92,8 +93,8 @@ def _heavy_keys(table: Table, key_name: str, env):
     args = (vc, col.data, col.validity) if with_valid \
         else (vc, col.data, np.zeros(0, bool))
     vals_d, live_d = fn(*args)
-    vals = np.asarray(vals_d).reshape(w, SKEW_SAMPLE)
-    live = np.asarray(live_d).reshape(w, SKEW_SAMPLE)
+    vals = host_array(vals_d).reshape(w, SKEW_SAMPLE)
+    live = host_array(live_d).reshape(w, SKEW_SAMPLE)
     # weight each shard's sample by its true row share — unweighted pooling
     # would let a tiny shard's keys dominate the global estimate
     shares: dict = {}
@@ -319,7 +320,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         res = _count_fn(env.mesh, how, narrow)(
             vcl, vcr, l_datas, l_valids, r_datas, r_valids)
         counts_dev, carry = res[0], res[1:]
-        counts = np.asarray(counts_dev).astype(np.int64)
+        counts = host_array(counts_dev).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
 
     # ---- output plan -----------------------------------------------------

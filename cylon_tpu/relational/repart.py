@@ -29,6 +29,7 @@ from ..ctx.context import CylonEnv
 from ..ops import sort as sortk
 from ..parallel import shuffle
 from ..status import InvalidError
+from ..utils.host import host_array
 from .common import ROW, REP, build_table, col_arrays, live_mask, \
     unify_dictionaries_many
 
@@ -277,7 +278,7 @@ def filter_table(table: Table, flag) -> Table:
     env = table.env
     cap = max(table.capacity, 1)
     vc = np.asarray(table.valid_counts, np.int32)
-    counts = np.asarray(_filter_count_fn(env.mesh, cap)(vc, flag)
+    counts = host_array(_filter_count_fn(env.mesh, cap)(vc, flag)
                         ).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
     items = list(table.columns.items())

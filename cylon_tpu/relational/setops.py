@@ -29,6 +29,7 @@ from ..ops import pack
 from ..ops import setops as setk
 from ..ops import sort as sortk
 from ..status import InvalidError
+from ..utils.host import host_array
 from .common import (PAD_L, REP, ROW, check_same_env, col_arrays, live_mask,
                      promote_key_pair, rebuild_like)
 from .repart import repartition, shuffle_table
@@ -88,7 +89,7 @@ def unique_table(table: Table, subset=None, keep: str = "first") -> Table:
         table = shuffle_table(table, subset)
     key_datas, key_valids = col_arrays([table.column(n) for n in subset])
     vc = np.asarray(table.valid_counts, np.int32)
-    counts = np.asarray(_unique_count_fn(env.mesh, keep)(
+    counts = host_array(_unique_count_fn(env.mesh, keep)(
         vc, key_datas, key_valids)).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
     items = list(table.columns.items())
@@ -190,7 +191,7 @@ def set_operation(a: Table, b: Table, op: str) -> Table:
     b_datas, b_valids = col_arrays([b.column(n) for n in names])
     vca = np.asarray(a.valid_counts, np.int32)
     vcb = np.asarray(b.valid_counts, np.int32)
-    counts = np.asarray(_setop_count_fn(env.mesh, op)(
+    counts = host_array(_setop_count_fn(env.mesh, op)(
         vca, vcb, a_datas, a_valids, b_datas, b_valids)).astype(np.int64)
     out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
     out_d, out_v = _setop_mat_fn(env.mesh, op, out_cap)(
@@ -260,4 +261,4 @@ def equals(a: Table, b: Table, ordered: bool = True) -> bool:
                   for n in names)
     vc = np.asarray(a.valid_counts, np.int32)
     res = _equals_fn(env.mesh, kinds)(vc, a_datas, a_valids, b_datas, b_valids)
-    return bool(np.asarray(res).all())
+    return bool(host_array(res).all())

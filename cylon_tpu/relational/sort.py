@@ -32,6 +32,7 @@ from ..ctx.context import ROW_AXIS
 from ..ops import pack
 from ..ops import sort as sortk
 from ..status import InvalidError
+from ..utils.host import host_array
 from .common import (PAD_L, REP, ROW, col_arrays, live_mask, rebuild_like,
                      sample_positions)
 from .repart import exchange_by_targets
@@ -116,8 +117,8 @@ def _pick_splitters(sample_ops, live, w: int):
     of actual sample rows yields a *correct* partition (rows are compared to
     splitters on device with the same total order); the choice only affects
     balance, so numpy's NaN-last lexsort is fine here."""
-    ops_np = [np.asarray(o) for o in sample_ops]
-    live_np = np.asarray(live)
+    ops_np = [host_array(o) for o in sample_ops]
+    live_np = host_array(live)
     n_live = int(live_np.sum())
     # lexicographic argsort over (liveness, op_0, op_1, ...)
     cols = [~live_np] + [o for o in ops_np]

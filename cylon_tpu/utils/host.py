@@ -1,0 +1,27 @@
+"""Host materialization that works in BOTH execution modes.
+
+Single-controller (one process drives the whole mesh): ``np.asarray`` sees
+every shard.  Multi-controller (``jax.distributed`` SPMD — the reference's
+``mpirun -np N`` launch model, README.md:69-73): each process only
+addresses its local shards, so sidecar pulls (count matrices, valid-count
+vectors, splitter samples) must cross-gather with
+``multihost_utils.process_allgather`` before they are host-visible.  Every
+host pull of a possibly-sharded device array in the framework goes through
+:func:`host_array` so the same operator code runs in either mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def host_array(x) -> np.ndarray:
+    """Materialize a (possibly multi-host row-sharded) array on this host."""
+    if isinstance(x, np.ndarray):
+        return x
+    import jax
+    if jax.process_count() > 1 and not getattr(x, "is_fully_addressable",
+                                               True):
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)

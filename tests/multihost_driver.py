@@ -1,0 +1,63 @@
+"""2-process jax.distributed SPMD driver (launched by test_multihost.py).
+
+The reference's launch model is `mpirun -np N python app.py` (README.md:
+69-73); the TPU-native analog is N processes each calling
+``jax.distributed.initialize`` and running the SAME script over the global
+mesh.  Each process here: builds an env with ``TPUConfig(distributed=True)``
+(4 local CPU devices -> 8-device world), ingests the same host data (each
+process materializes only its addressable shards), runs shuffle-backed
+join + groupby + sort, validates against pandas, and exercises the real
+cross-process barrier.
+
+Usage: multihost_driver.py <process_id> <num_processes> <coordinator>
+"""
+
+import os
+import sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+coord = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+import cylon_tpu as ct
+from cylon_tpu.ctx.context import TPUConfig
+from cylon_tpu.relational import groupby_aggregate, join_tables, sort_table
+
+env = ct.CylonEnv(config=TPUConfig(
+    distributed=True, coordinator_address=coord,
+    process_id=pid, num_processes=nproc))
+assert jax.process_count() == nproc, jax.process_count()
+assert env.world_size == 4 * nproc, env.world_size
+assert env.rank == pid
+
+rng = np.random.default_rng(11)  # same seed in every process: SPMD ingest
+n = 5000
+ldf = pd.DataFrame({"k": rng.integers(0, 500, n), "a": rng.random(n)})
+rdf = pd.DataFrame({"k": rng.integers(0, 500, n), "b": rng.random(n)})
+lt = ct.Table.from_pandas(ldf, env)
+rt = ct.Table.from_pandas(rdf, env)
+
+env.barrier()
+
+j = join_tables(lt, rt, "k", "k", how="inner")
+g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "mean")])
+s = sort_table(g, "k")
+
+exp = (ldf.merge(rdf, on="k", how="inner")
+       .groupby("k", as_index=False)
+       .agg(a_sum=("a", "sum"), b_mean=("b", "mean"))
+       .sort_values("k").reset_index(drop=True))
+got = s.to_pandas().reset_index(drop=True)
+pd.testing.assert_frame_equal(got, exp, check_dtype=False, check_exact=False)
+
+env.barrier()
+print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
+      flush=True)

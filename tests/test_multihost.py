@@ -1,0 +1,45 @@
+"""Multi-host execution: 2 jax.distributed processes over one logical world
+(VERDICT item 6 — makes TPUConfig(distributed=True) and the cross-process
+barrier tested code).  The moral analog of the reference's `mpirun -np 2`
+suite runs (python/pycylon/test/test_all.py:23-29)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_join_groupby_sort():
+    driver = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, driver, str(i), "2", coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(driver))))
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=570)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert f"MULTIHOST_OK pid={i} world=8" in out, out[-2000:]
