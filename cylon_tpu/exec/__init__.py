@@ -1,0 +1,5 @@
+"""Pipelined (chunked, comm/compute-overlapped) execution — the TPU-first
+re-think of the reference's streaming operator DAG (cpp/src/cylon/ops/,
+SURVEY.md §2 C9)."""
+
+from .pipeline import chunk_table, pipelined_join  # noqa: F401

@@ -1,0 +1,144 @@
+"""Pipelined (chunked) operator execution — the C9 slot, TPU-first.
+
+The reference ships an experimental push-based operator DAG (ops/api/
+parallel_op.hpp:32 ``Op`` with per-tag input queues, execution/execution.hpp
+:43-110 RoundRobin/ForkJoin/Priority executors, dis_join_op.hpp:44) whose
+point is overlapping the shuffle of one batch with the compute of another.
+On TPU the executor half of that machinery already exists in the runtime:
+XLA dispatch is asynchronous, so a host loop that ENQUEUES chunk k+1's
+partition/exchange while chunk k's join still occupies the device gets
+comm/compute overlap for free — the design reduces to *streaming chunked
+operators*:
+
+  build side: promote + hash-shuffle ONCE (amortized across all chunks);
+  probe side: split into C row chunks; each chunk flows
+      partition -> exchange -> local join
+  and successive chunks' device work interleaves in the dispatch queue.
+
+Chunking also bounds peak memory: each materialization sizes to one
+chunk's output instead of the whole join's — the way to run a join whose
+output (or sort scratch) exceeds HBM.
+
+Degenerate case C=1 equals the monolithic operator exactly.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import config
+from ..core.column import Column
+from ..core.table import Table
+from ..relational.common import REP, ROW, check_same_env, promote_key_pair
+from ..relational.join import join_tables
+from ..relational.repart import concat_tables, shuffle_table
+from ..status import InvalidError
+
+shard_map = jax.shard_map
+
+
+@lru_cache(maxsize=None)
+def _chunk_fn(mesh: Mesh, cap: int, step: int):
+    """Per-shard dynamic slice [start, start+step) of every column."""
+
+    def per_shard(start, datas, valids):
+        def sl(a):
+            return jax.lax.dynamic_slice(a, (start,), (step,))
+
+        out_d = tuple(sl(d) for d in datas)
+        out_v = tuple(sl(v) if v is not None else None for v in valids)
+        return out_d, out_v
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
+
+
+def chunk_table(table: Table, n_chunks: int) -> list[Table]:
+    """Split each shard's valid prefix into ``n_chunks`` contiguous row
+    ranges; chunk i is a Table holding every shard's i-th range (so the
+    concatenation of chunks in order re-covers the table, per shard)."""
+    if n_chunks <= 1:
+        return [table]
+    from ..relational.repart import repad_table
+    cap = max(table.capacity, 1)
+    step = -(-cap // n_chunks)
+    if step * n_chunks != cap:      # make every window in-bounds
+        table = repad_table(table, step * n_chunks)
+        cap = step * n_chunks
+    items = list(table.columns.items())
+    datas = tuple(c.data for _, c in items)
+    valids = tuple(c.validity for _, c in items)
+    fn = _chunk_fn(table.env.mesh, cap, step)
+    out = []
+    for i in range(n_chunks):
+        start = i * step
+        # chunk validity = how much of each shard's live prefix falls
+        # inside [start, start+step)
+        vc = np.clip(table.valid_counts - start, 0, step)
+        out_d, out_v = fn(np.int32(start), datas, valids)
+        cols = {}
+        for (n, c), d, v in zip(items, out_d, out_v):
+            cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
+        out.append(Table(cols, table.env, vc.astype(np.int64)))
+    return out
+
+
+def pipelined_join(left: Table, right: Table, left_on, right_on,
+                   how: str = "inner", n_chunks: int = 4,
+                   suffixes=("_x", "_y"), sink=None):
+    """Streaming chunked distributed join (reference DisJoinOP re-thought).
+
+    The (smaller) build side shuffles once; the probe side streams through
+    in ``n_chunks`` row chunks whose partition/exchange/join dispatches
+    interleave on the device.  Semantics match
+    :func:`~cylon_tpu.relational.join.join_tables` for inner/left joins
+    (each probe row appears in exactly one chunk).  right/outer need
+    cross-chunk unmatched-row bookkeeping and are not supported here.
+
+    Note: chunks shuffle with plain hashing — the monolithic join's
+    heavy-key skew split is not applied here, so an extreme single-key
+    distribution still concentrates on one shard (use join_tables for
+    skewed keys).
+
+    ``sink``: the downstream operator of the pipeline (the reference's next
+    ``Op`` in the DAG).  When given, each output chunk is passed to
+    ``sink(chunk_table)`` and immediately released — peak memory is ONE
+    chunk's output — and the list of sink results is returned.  Without a
+    sink the chunks are concatenated into one Table (which necessarily
+    holds the full output twice during assembly; use a sink for outputs
+    near HBM capacity).
+    """
+    if how not in ("inner", "left"):
+        raise InvalidError("pipelined_join supports how in ('inner','left')")
+    env = check_same_env(left, right)
+    left_on = [left_on] if isinstance(left_on, str) else list(left_on)
+    right_on = [right_on] if isinstance(right_on, str) else list(right_on)
+
+    # promote once so every chunk shares dictionaries/dtypes with the build
+    lkey, rkey = [], []
+    for ln, rn in zip(left_on, right_on):
+        a, b = promote_key_pair(left.column(ln), right.column(rn))
+        lkey.append(a)
+        rkey.append(b)
+    lwork = left.with_columns(dict(zip(left_on, lkey)))
+    rwork = right.with_columns(dict(zip(right_on, rkey)))
+
+    if env.world_size > 1:
+        rwork = shuffle_table(rwork, right_on)   # build side: ONCE
+
+    outs = []
+    for chunk in chunk_table(lwork, n_chunks):
+        if env.world_size > 1:
+            chunk = shuffle_table(chunk, left_on)
+        # chunk and rwork are now co-located: plain local join
+        res = join_tables(chunk, rwork, left_on, right_on, how=how,
+                          suffixes=suffixes, assume_colocated=True)
+        outs.append(sink(res) if sink is not None else res)
+    if sink is not None:
+        return outs
+    return concat_tables(outs) if len(outs) > 1 else outs[0]

@@ -82,13 +82,13 @@ def read_csv(paths, env: CylonEnv | None = None, **kwargs) -> Table:
         df = _read_many(files, lambda f: pd.read_csv(f, **kwargs))
         return Table.from_pandas(df, env)
     from pyarrow import csv as pacsv
+    at = _read_many_arrow(files, lambda f: pacsv.read_csv(f))
     try:
-        at = _read_many_arrow(files, lambda f: pacsv.read_csv(f))
         return Table.from_arrow(at, env)
     except CylonTypeError:
-        import pandas as pd
-        df = _read_many(files, lambda f: pd.read_csv(f))
-        return Table.from_pandas(df, env)
+        # unsupported arrow column type: convert the ALREADY-READ table
+        # (no second disk pass)
+        return Table.from_pandas(at.to_pandas(), env)
 
 
 def read_parquet(paths, env: CylonEnv | None = None, **kwargs) -> Table:
@@ -98,13 +98,11 @@ def read_parquet(paths, env: CylonEnv | None = None, **kwargs) -> Table:
         df = _read_many(files, lambda f: pd.read_parquet(f, **kwargs))
         return Table.from_pandas(df, env)
     import pyarrow.parquet as pq
+    at = _read_many_arrow(files, lambda f: pq.read_table(f))
     try:
-        at = _read_many_arrow(files, lambda f: pq.read_table(f))
         return Table.from_arrow(at, env)
     except CylonTypeError:
-        import pandas as pd
-        df = _read_many(files, lambda f: pd.read_parquet(f))
-        return Table.from_pandas(df, env)
+        return Table.from_pandas(at.to_pandas(), env)
 
 
 def read_json(paths, env: CylonEnv | None = None, **kwargs) -> Table:

@@ -281,10 +281,15 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
 
 def join_tables(left: Table, right: Table, left_on, right_on,
                 how: str = "inner", suffixes=("_x", "_y"),
-                coalesce_keys: bool = True) -> Table:
+                coalesce_keys: bool = True,
+                assume_colocated: bool = False) -> Table:
     """Join two tables. Distributed path = hash-shuffle both sides on the
     (promoted) keys, then per-shard local sort-join — the reference's exact
-    skeleton (table.cpp:861,219,194)."""
+    skeleton (table.cpp:861,219,194).
+
+    ``assume_colocated=True`` skips the shuffle: the caller guarantees equal
+    keys already share a shard on both sides (pipelined execution shuffles
+    the build side once and streams pre-shuffled probe chunks)."""
     if how not in HOW:
         raise InvalidError(f"how must be one of {HOW}, got {how!r}")
     env = check_same_env(left, right)
@@ -303,7 +308,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     rwork = right.with_columns(dict(zip(right_on, rkey_cols)))
 
     skew_split = False
-    if env.world_size > 1:
+    if env.world_size > 1 and not assume_colocated:
         with timing.region("join.shuffle"):
             lwork, rwork, skew_split = _shuffle_for_join(
                 lwork, rwork, left_on, right_on, how, env)

@@ -184,7 +184,11 @@ def repad_table(table: Table, new_cap: int) -> Table:
     for n, c in table.columns.items():
         d = fn(c.data)
         v = fn(c.validity) if c.validity is not None else None
-        cols[n] = Column(d, c.type, v, c.dictionary)
+        # pad rows are zeros -> widen bounds to include 0
+        b = c.bounds
+        if b is not None and new_cap > cap:
+            b = (min(b[0], 0), max(b[1], 0))
+        cols[n] = Column(d, c.type, v, c.dictionary, bounds=b)
     return Table(cols, table.env, table.valid_counts)
 
 
