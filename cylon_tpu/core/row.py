@@ -1,0 +1,101 @@
+"""Row & Scalar — reference ``cylon::Row`` (row.hpp, used by
+``Table::Select``, table.cpp:892) and ``cylon::Scalar`` (scalar.hpp,
+wrapping ``arrow::Scalar``).
+
+In the device-resident model a Row is a host-side *view* of one global row
+(gathered lazily on first access — row access is an inherently host-facing
+operation), and a Scalar wraps one typed value with its logical type, as
+produced by column reductions and consumed by comparisons/fills.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..status import CylonKeyError, InvalidError
+from .dtypes import LogicalType
+
+
+class Scalar:
+    """One typed value (reference scalar.hpp).  ``value`` is a python/numpy
+    scalar or None (null)."""
+
+    __slots__ = ("value", "type")
+
+    def __init__(self, value: Any, type: LogicalType):
+        self.value = value
+        self.type = type
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None or (
+            isinstance(self.value, float) and np.isnan(self.value))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Scalar({self.value!r}, {self.type.value})"
+
+    def __eq__(self, other) -> bool:
+        o = other.value if isinstance(other, Scalar) else other
+        if self.is_null:
+            return o is None
+        return bool(self.value == o)
+
+    def __hash__(self):
+        return hash((self.value, self.type))
+
+
+class Row:
+    """One global row of a DataFrame/Table (reference row.hpp).  Values are
+    gathered to the host on first access and cached."""
+
+    __slots__ = ("_df", "_i", "_values")
+
+    def __init__(self, df, i: int):
+        n = len(df)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise InvalidError(f"row {i} out of range for {n} rows")
+        self._df = df
+        self._i = i
+        self._values: dict | None = None
+
+    def _load(self) -> dict:
+        if self._values is None:
+            # one-row global slice -> host (a row access is host-facing);
+            # restricted to VISIBLE columns so a drop=True index column
+            # stays hidden here exactly as it is on the frame
+            from ..relational.repart import slice_table
+            one = slice_table(self._df.table, self._i, 1).to_pandas()
+            rec = one.to_dict("records")[0] if len(one) else {}
+            vis = list(self._df.columns)
+            self._values = {k: (None if isinstance(rec[k], float)
+                                and np.isnan(rec[k]) else rec[k])
+                            for k in vis if k in rec}
+        return self._values
+
+    @property
+    def columns(self) -> list[str]:
+        return self._df.columns
+
+    def __getitem__(self, name: str):
+        vals = self._load()
+        if name not in vals:
+            raise CylonKeyError(f"no column {name!r}")
+        return vals[name]
+
+    def scalar(self, name: str) -> Scalar:
+        col = self._df.table.column(name)
+        return Scalar(self[name], col.type)
+
+    def to_dict(self) -> dict:
+        return dict(self._load())
+
+    def __iter__(self):
+        vals = self._load()
+        return iter(vals.values())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Row({self._i}, {self._load()!r})"

@@ -41,6 +41,7 @@ class DataFrame:
     def __init__(self, data: Any = None, env: CylonEnv | None = None,
                  _table: Table | None = None):
         self._index: str | None = None  # label index column (C24 analog)
+        self._index_drop: bool = True   # pandas set_index drop semantics
         if _table is not None:
             self._table = _table
             return
@@ -90,26 +91,41 @@ class DataFrame:
         out = DataFrame(_table=table)
         if keep_index and self._index in table.column_names:
             out._index = self._index
+            out._index_drop = self._index_drop
         return out
+
+    def _hidden(self) -> set:
+        """Columns present in the physical table but not user-visible (a
+        dropped-into-index column)."""
+        if self._index is not None and self._index_drop:
+            return {self._index}
+        return set()
+
+    def _visible_table(self) -> Table:
+        hid = self._hidden()
+        return self._table.drop(hid) if hid else self._table
 
     # -- schema / introspection -------------------------------------------
     @property
     def columns(self) -> list[str]:
-        return self._table.column_names
+        hid = self._hidden()
+        return [c for c in self._table.column_names if c not in hid]
 
     @property
     def shape(self) -> tuple[int, int]:
-        return (self._table.row_count, self._table.column_count)
+        return (self._table.row_count, len(self.columns))
 
     @property
     def dtypes(self) -> dict[str, str]:
-        return {f.name: f.type.value for f in self._table.schema}
+        hid = self._hidden()
+        return {f.name: f.type.value for f in self._table.schema
+                if f.name not in hid}
 
     def __len__(self) -> int:
         return self._table.row_count
 
     def __contains__(self, name: str) -> bool:
-        return name in self._table
+        return name in self._table and name not in self._hidden()
 
     def __repr__(self) -> str:  # pragma: no cover
         n = len(self)
@@ -137,17 +153,22 @@ class DataFrame:
             return np.arange(len(self))
         return self[self._index].to_numpy()
 
-    def set_index(self, name: str, drop: bool = False) -> "DataFrame":
+    def set_index(self, name: str, drop: bool = True) -> "DataFrame":
         """Use column ``name`` as the row-label index (reference
-        Table::SetArrowIndex, table.hpp:164; drop semantics from pandas —
-        the column stays addressable unless drop=True materialization)."""
+        Table::SetArrowIndex, table.hpp:164).  ``drop`` follows pandas:
+        drop=True (default) removes the column from the visible columns —
+        it lives on as the index (physically retained for loc) — while
+        drop=False keeps it addressable as a data column too."""
         if name not in self._table:
             raise CylonKeyError(f"no column {name!r}")
         out = DataFrame(_table=self._table)
         out._index = name
+        out._index_drop = bool(drop)
         return out
 
     def reset_index(self) -> "DataFrame":
+        """Demote the index back to a regular column (pandas semantics —
+        the physical column was retained, so this is metadata-only)."""
         out = DataFrame(_table=self._table)
         return out
 
@@ -155,7 +176,10 @@ class DataFrame:
     def to_pandas(self):
         df = self._table.to_pandas()
         if self._index is not None:
-            df = df.set_index(self._index)
+            df = df.set_index(self._index, drop=self._index_drop)
+            if not self._index_drop:
+                # pandas keeps the column AND names the index after it
+                df.index.name = self._index
         return df
 
     def to_arrow(self):
@@ -169,8 +193,17 @@ class DataFrame:
                 for k, v in self.to_pandas().to_dict("list").items()}
 
     # -- column access / mutation -----------------------------------------
+    def _col_series(self, name: str) -> "Series":
+        """Internal column access that ignores index-hiding (used by the
+        loc/iloc machinery, which must read the index column)."""
+        return Series(name, self._table.column(name), self.env,
+                      self._table.valid_counts)
+
     def __getitem__(self, key):
         if isinstance(key, str):
+            if key in self._hidden():
+                raise CylonKeyError(
+                    f"{key!r} is the index (set_index drop=True)")
             col = self._table.column(key)
             return Series(key, col, self.env, self._table.valid_counts)
         if isinstance(key, (list, tuple)) and all(isinstance(k, str)
@@ -244,7 +277,8 @@ class DataFrame:
             if not common:
                 raise InvalidError("no common columns to merge on")
             left_on = right_on = common
-        t = join_tables(lhs._table, rhs._table, left_on, right_on, how=how,
+        t = join_tables(lhs._visible_table(), rhs._visible_table(),
+                        left_on, right_on, how=how,
                         suffixes=suffixes, coalesce_keys=True)
         return self._wrap(t)
 
@@ -258,8 +292,9 @@ class DataFrame:
         if on is None:
             raise InvalidError("join requires on= key column(s)")
         on = [on] if isinstance(on, str) else list(on)
-        t = join_tables(lhs._table, oth._table, on, on, how=how,
-                        suffixes=(lsuffix, rsuffix), coalesce_keys=False)
+        t = join_tables(lhs._visible_table(), oth._visible_table(), on, on,
+                        how=how, suffixes=(lsuffix, rsuffix),
+                        coalesce_keys=False)
         return self._wrap(t)
 
     def sort_values(self, by, ascending=True, nulls_position: str = "last",
@@ -278,8 +313,10 @@ class DataFrame:
     def drop_duplicates(self, subset=None, keep: str = "first",
                         env: CylonEnv | None = None) -> "DataFrame":
         env = _resolve_env(self.env, env)
-        return self._wrap(unique_table(self._to_env(env)._table, subset,
-                                       keep))
+        d = self._to_env(env)
+        if subset is None:
+            subset = d.columns  # visible columns only, pandas semantics
+        return d._wrap(unique_table(d._table, subset, keep), keep_index=True)
 
     def union(self, other: "DataFrame", env: CylonEnv | None = None) -> "DataFrame":
         env = _resolve_env(self.env, env)
@@ -334,6 +371,123 @@ class DataFrame:
         diff = set_operation(self._table, other._to_env(self.env)._table,
                              "subtract")
         return diff.row_count == 0
+
+    # -- missing data (reference frame.py:187-2421 breadth; pandas parity) --
+    def _rebuild_cols(self, newcols: dict) -> "DataFrame":
+        """New table from per-column results, re-attaching a hidden index
+        column so the label index survives (pandas keeps the index through
+        elementwise ops)."""
+        for h in self._hidden():
+            newcols[h] = self._table.column(h)
+        return self._wrap(Table(newcols, self._table.env,
+                                self._table.valid_counts), keep_index=True)
+
+    def isna(self) -> "DataFrame":
+        """Boolean frame: True where a value is missing (null or NaN)."""
+        return self._rebuild_cols(
+            {c: self[c].isna().column for c in self.columns})
+
+    def notna(self) -> "DataFrame":
+        return self._rebuild_cols(
+            {c: self[c].notna().column for c in self.columns})
+
+    def dropna(self, how: str = "any", subset=None) -> "DataFrame":
+        """Drop rows with missing values (any/all over ``subset``)."""
+        from .status import InvalidError as _IE
+        if how not in ("any", "all"):
+            raise _IE("how must be 'any' or 'all'")
+        cols = list(subset) if subset is not None else self.columns
+        keep = None
+        for c in cols:
+            ok = self[c].notna()
+            keep = ok if keep is None else (
+                (keep & ok) if how == "any" else (keep | ok))
+        if keep is None:
+            return self
+        from .relational.common import valid_flag
+        return self._wrap(filter_table(self._table, valid_flag(keep.column)),
+                          keep_index=True)
+
+    def fillna(self, value) -> "DataFrame":
+        """Replace missing values (nulls and float NaNs) with ``value``.
+        Columns whose dtype cannot hold ``value`` (e.g. a string column vs a
+        numeric fill) are left unchanged — a documented deviation from
+        pandas' object-dtype mixing, which fixed-width device columns cannot
+        represent."""
+        from .status import CylonTypeError
+        cols = {}
+        for name, c in self._table.columns.items():
+            if name in self._hidden() or (
+                    c.validity is None
+                    and not str(c.data.dtype).startswith("float")):
+                cols[name] = c
+                continue
+            s = Series(name, c, self.env, self._table.valid_counts)
+            try:
+                cols[name] = s.fillna(value).column
+            except CylonTypeError:
+                cols[name] = c
+        return self._wrap(Table(cols, self._table.env,
+                                self._table.valid_counts), keep_index=True)
+
+    # -- elementwise frame arithmetic (pandas operator parity) -------------
+    def _colwise(self, fn) -> "DataFrame":
+        return self._rebuild_cols({c: fn(self[c]).column
+                                   for c in self.columns})
+
+    def _frame_op(self, other, op_name: str) -> "DataFrame":
+        if isinstance(other, DataFrame):
+            if other.columns != self.columns:
+                raise InvalidError("frame op requires identical columns")
+            return self._colwise(
+                lambda s: getattr(s, op_name)(other[s.name]))
+        return self._colwise(lambda s: getattr(s, op_name)(other))
+
+    def __add__(self, o):
+        return self._frame_op(o, "__add__")
+
+    def __sub__(self, o):
+        return self._frame_op(o, "__sub__")
+
+    def __mul__(self, o):
+        return self._frame_op(o, "__mul__")
+
+    def __truediv__(self, o):
+        return self._frame_op(o, "__truediv__")
+
+    def __neg__(self):
+        return self._colwise(lambda s: -s)
+
+    def __abs__(self):
+        return self._colwise(abs)
+
+    def abs(self) -> "DataFrame":
+        return self._colwise(abs)
+
+    # -- row-wise host iteration (reference Row, row.hpp; frame.py parity) --
+    def applymap(self, func) -> "DataFrame":
+        """Elementwise python function over the data columns — host round
+        trip by necessity (arbitrary python is not jittable); index labels
+        are untouched, pandas-compatible."""
+        pdf = self.to_pandas()
+        mapped = pdf.map(func)
+        if self._index is None:
+            return DataFrame(mapped, env=self.env)
+        out = DataFrame(mapped.reset_index(names=self._index), env=self.env)
+        return out.set_index(self._index, drop=self._index_drop)
+
+    def iterrows(self):
+        """Host-side row iteration, pandas-compatible (reference Row
+        iteration, row.hpp via table.cpp:892 Select)."""
+        return self.to_pandas().iterrows()
+
+    def itertuples(self, index: bool = True, name: str = "Cylon"):
+        return self.to_pandas().itertuples(index=index, name=name)
+
+    def row(self, i: int):
+        """One global row as a :class:`~cylon_tpu.core.row.Row`."""
+        from .core.row import Row
+        return Row(self, i)
 
     # -- reductions over all columns ---------------------------------------
     def _agg_all(self, op: str):

@@ -67,8 +67,9 @@ class LocIndexer:
             keep = ([df._index] if df._index not in (None, RANGE_INDEX) else []
                     ) + cols
             out = out._wrap(out._table.project(
-                [c for c in out.columns if c in set(keep)]))
+                [c for c in out._table.column_names if c in set(keep)]))
             out._index = df._index
+            out._index_drop = df._index_drop
         return out
 
     def _range_loc(self, key):
@@ -88,7 +89,7 @@ class LocIndexer:
         col = df._table.column(name)
         if isinstance(key, slice):
             # inclusive label range: value >= start & value <= stop
-            s = df[name]
+            s = df._col_series(name)
             mask = None
             if key.start is not None:
                 mask = (s >= key.start)
@@ -100,11 +101,12 @@ class LocIndexer:
             from ..relational.common import valid_flag
             out = df._wrap(filter_table(df._table, valid_flag(mask.column)))
             out._index = df._index
+            out._index_drop = df._index_drop
             return out
         labels = [key] if np.isscalar(key) or isinstance(key, str) else list(key)
         # pandas raises when ANY requested label is absent, not only when all
         # are: check membership against the index column's values
-        values = df[name].to_numpy()
+        values = df._col_series(name).to_numpy()
         try:  # dtype-matched isin takes numpy's sort-based path; the object
             labels_arr = np.asarray(labels, dtype=values.dtype)
         except (TypeError, ValueError):  # fallback compares elementwise
@@ -116,6 +118,7 @@ class LocIndexer:
         mask = _label_mask(col, labels)
         out = df._wrap(filter_table(df._table, mask))
         out._index = df._index
+        out._index_drop = df._index_drop
         return out
 
 
@@ -188,6 +191,7 @@ class ILocIndexer:
                     host_cols[cn] = Column(data, c.type, v, c.dictionary)
                 out = df._wrap(Table.from_host_columns(host_cols, df.env))
         out._index = df._index
+        out._index_drop = df._index_drop
         if cols is not None:
             cols = [cols] if isinstance(cols, str) else list(cols)
             out = out._wrap(out._table.project(cols))
