@@ -137,7 +137,7 @@ def main() -> dict:
                           iters=iters)
 
     if rows is None:
-        rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
+        rows = 64_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
     # halve on device OOM so the driver always gets a number
     while True:
         try:

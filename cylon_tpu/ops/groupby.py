@@ -84,17 +84,17 @@ def _ftype(values):
 # prefix diffs are exact; float inputs accumulate in float64.
 # ---------------------------------------------------------------------------
 
-def grouped_bounds(gids, first, mask, n_live, seg_cap: int):
-    """(starts, ends): first/last row position of each group id, for grouped
-    input (each group one contiguous run in the live prefix).  Empty group
-    slots get starts > ends.  ONE scatter."""
+def grouped_starts(gids, first, mask, n_live, seg_cap: int):
+    """First live row position of each group id, for grouped input (each
+    group one contiguous run in the live prefix).  Slots past the last
+    group hold ``n_live`` — making them both the empty-group sentinel and
+    the "next start" of the final group, so every run extent is a
+    consecutive diff of this one array.  ONE scatter."""
     n = gids.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
     scat = jnp.where(first & mask, gids, jnp.int32(seg_cap))
-    starts = jnp.full(seg_cap, n_live, jnp.int32).at[scat].set(pos,
-                                                               mode="drop")
-    ends = jnp.concatenate([starts[1:], n_live.reshape(1)]) - 1
-    return starts, ends
+    return jnp.full(seg_cap, n_live, jnp.int32).at[scat].set(pos,
+                                                             mode="drop")
 
 
 _GROUPED_NEEDS = {"sum": ("sum",), "count": ("count",),
@@ -103,20 +103,25 @@ _GROUPED_NEEDS = {"sum": ("sum",), "count": ("count",),
                   "std": ("sum", "sumsq", "count")}
 
 
-def grouped_combine_many(ops, values_list, starts, ends, vmasks):
-    """Grouped-input analog of :func:`combine_locally` for the cumsum-able
-    ops (sum/count/mean/var/std), batched over all aggregations: per-group
-    intermediates via prefix-sum diffs at the run bounds.  All requested
-    prefix arrays of one dtype class are stacked so the two bound gathers
-    (at ends, at starts) each run ONCE per class.  Returns one inter dict
-    per op."""
-    n = values_list[0].shape[0]
-    live = starts <= ends
-    s_cl = jnp.clip(starts, 0, max(n - 1, 0))
-    e_cl = jnp.clip(ends, 0, max(n - 1, 0))
+def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
+                   key_valids, seg_cap: int):
+    """Grouped-input fast path, fully batched: per-group sums for the
+    cumsum-able ops (sum/count/mean/var/std) AND the representative-key
+    gather share ONE indexed pass per dtype class.
 
-    # collect the per-op source arrays to prefix-sum
-    plans = []          # (op_index, name, source array)
+    For contiguous runs, group g's sum over x is PS[starts[g+1]] -
+    PS[starts[g]], with PS the zero-padded exclusive prefix of x and
+    starts[n_groups..] = n_live — so a single (seg_cap, k) gather of the
+    stacked prefix columns at ``starts`` + a consecutive diff replaces the
+    two bound gathers of the naive start/end formulation (gathers are the
+    dominant groupby cost on TPU); key columns and their validity ride the
+    same gather as passthrough lanes.
+
+    Returns (inter dicts per op, key_out tuple, kval_out tuple)."""
+    n = key_datas[0].shape[0]
+
+    # entries: (kind, slot, name, src) with kind prefix|key|kval
+    entries = []
     for i, op in enumerate(ops):
         vm = vmasks[i] if vmasks[i] is not None else jnp.ones(n, bool)
         v = values_list[i]
@@ -130,26 +135,48 @@ def grouped_combine_many(ops, values_list, starts, ends, vmasks):
                 src = jnp.where(vm, f, jnp.zeros_like(f))
             else:
                 src = jnp.where(vm, f * f, jnp.zeros_like(f))
-            plans.append((i, name, src))
+            entries.append(("prefix", i, name, src))
+    for ki, (d, v) in enumerate(zip(key_datas, key_valids)):
+        entries.append(("key", ki, None, d))
+        if v is not None:
+            entries.append(("kval", ki, None, v))
 
-    # batch by dtype: one (n, k) cumsum + two (g, k) gathers per dtype class
     by_dtype: dict = {}
-    for j, (_, _, src) in enumerate(plans):
-        by_dtype.setdefault(str(src.dtype), []).append(j)
-    results = [None] * len(plans)
+    for j, e in enumerate(entries):
+        by_dtype.setdefault(str(e[3].dtype), []).append(j)
+    results = [None] * len(entries)
     for idxs in by_dtype.values():
-        x = jnp.stack([plans[j][2] for j in idxs], axis=1)      # (n, k)
-        s = jnp.cumsum(x, axis=0)
-        e = s - x
-        diff = s[e_cl] - e[s_cl]                                # (g, k)
-        diff = jnp.where(live[:, None], diff, jnp.zeros_like(diff))
+        cols = []
+        for j in idxs:
+            kind, _, _, src = entries[j]
+            if kind == "prefix":
+                cols.append(jnp.concatenate(
+                    [jnp.zeros(1, src.dtype), jnp.cumsum(src)]))  # (n+1,)
+            else:
+                cols.append(jnp.concatenate([src, src[-1:]]))
+        mat = jnp.stack(cols, axis=1)                  # (n+1, k)
+        g = mat[starts]                                # THE gather
+        # "next start" of slot seg_cap-1 is n_live (PS there = full total)
+        tailv = mat[jnp.minimum(n_live, n)][None, :]
+        g_next = jnp.concatenate([g[1:], tailv], axis=0)
         for col, j in enumerate(idxs):
-            results[j] = diff[:, col]
+            if entries[j][0] == "prefix":
+                results[j] = g_next[:, col] - g[:, col]
+            else:
+                results[j] = g[:, col]
 
     inters = [dict() for _ in ops]
-    for j, (i, name, _) in enumerate(plans):
-        inters[i][name] = results[j]
-    return inters
+    key_out = [None] * len(key_datas)
+    kval_out = [None] * len(key_datas)
+    for j, e in enumerate(entries):
+        kind, slot, name, _ = e
+        if kind == "prefix":
+            inters[slot][name] = results[j]
+        elif kind == "key":
+            key_out[slot] = results[j]
+        else:
+            kval_out[slot] = results[j]
+    return inters, tuple(key_out), tuple(kval_out)
 
 
 #: ops whose grouped-input fast path avoids scatter reductions entirely

@@ -173,32 +173,24 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
         gids, n_groups, mask, first = _group_keys(by_datas, by_valids, vc,
                                                   grouped, narrow)
-        cap = by_datas[0].shape[0]
-        starts = ends = None
+        vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
+                  for i in range(len(specs))]
+        # grouped fast path: ONE batched prefix-diff pass computes every
+        # cumsum-able aggregation AND the representative keys
+        batched: dict[int, dict] = {}
         if grouped:
             my = jax.lax.axis_index(ROW_AXIS)
             n_live = vc[my].astype(jnp.int32)
-            starts, ends = gbk.grouped_bounds(gids, first, mask, n_live,
-                                              seg_cap)
-            # rep keys = each run's first row (no segment_min needed)
-            safe = jnp.clip(starts, 0, max(cap - 1, 0))
-            key_out = tuple(d[safe] for d in by_datas)
-            kval_out = tuple(v[safe] if v is not None else None
-                             for v in by_valids)
-        else:
-            key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
-        vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
-                  for i in range(len(specs))]
-        # batch all cumsum-able aggregations through ONE prefix-diff pass
-        batched: dict[int, dict] = {}
-        if grouped:
+            starts = gbk.grouped_starts(gids, first, mask, n_live, seg_cap)
             sel = [i for i, (op, _) in enumerate(specs)
                    if op in gbk.CUMSUMMABLE]
-            if sel:
-                inters = gbk.grouped_combine_many(
-                    [specs[i][0] for i in sel], [val_datas[i] for i in sel],
-                    starts, ends, [vmasks[i] for i in sel])
-                batched = dict(zip(sel, inters))
+            inters, key_out, kval_out = gbk.grouped_reduce(
+                [specs[i][0] for i in sel], [val_datas[i] for i in sel],
+                [vmasks[i] for i in sel], starts, n_live,
+                list(by_datas), list(by_valids), seg_cap)
+            batched = dict(zip(sel, inters))
+        else:
+            key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         res_d, res_v = [], []
         for i, (op, q) in enumerate(specs):
             vmask = vmasks[i]

@@ -50,6 +50,22 @@ shard_map = jax.shard_map
 
 HOW = ("inner", "left", "right", "outer")
 
+#: capacity hysteresis: callsite-signature -> last exact output bucket.
+#: Lets join_tables dispatch the materialize phase at the PREDICTED capacity
+#: before the (blocking) count pull, overlapping the host sync with device
+#: work; a mispredict (counts exceed the prediction) just re-dispatches at
+#: the correct bucket.  Steady-state loops (benchmarks, iterative pipelines)
+#: hit every time.  Bounded FIFO so varying input sizes can't grow it
+#: without limit.
+_CAP_CACHE: dict = {}
+_CAP_CACHE_MAX = 512
+
+
+def _cap_cache_put(key, value) -> None:
+    if len(_CAP_CACHE) >= _CAP_CACHE_MAX:
+        _CAP_CACHE.pop(next(iter(_CAP_CACHE)))
+    _CAP_CACHE[key] = value
+
 #: heavy-key detection: per-shard sample size and global-share threshold
 SKEW_SAMPLE = 4096
 SKEW_MAX_KEYS = 8
@@ -325,8 +341,11 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         res = _count_fn(env.mesh, how, narrow)(
             vcl, vcr, l_datas, l_valids, r_datas, r_valids)
         counts_dev, carry = res[0], res[1:]
-        counts = host_array(counts_dev).astype(np.int64)
-    out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+    cache_key = (id(env.mesh), how, narrow, lwork.capacity, rwork.capacity,
+                 int(lwork.valid_counts.sum()), int(rwork.valid_counts.sum()),
+                 tuple(left_on), tuple(right_on),
+                 tuple(lwork.column_names), tuple(rwork.column_names))
+    predicted = _CAP_CACHE.get(cache_key)
 
     # ---- output plan -----------------------------------------------------
     coalesce = coalesce_keys and left_on == right_on
@@ -386,14 +405,27 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         tuple(str(c.data.dtype) for c in r_cols_list),
         tuple(c.validity is not None for c in r_cols_list))
 
-    fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
-                         tuple(plan), lspec, rspec)
+    mat_args = (carry,
+                tuple(c.data for c in l_cols_list),
+                tuple(c.validity for c in l_cols_list),
+                tuple(c.data for c in r_cols_list),
+                tuple(c.validity for c in r_cols_list))
+
     with timing.region("join.materialize"):
-        out_d, out_v = fn(carry,
-                          tuple(c.data for c in l_cols_list),
-                          tuple(c.validity for c in l_cols_list),
-                          tuple(c.data for c in r_cols_list),
-                          tuple(c.validity for c in r_cols_list))
+        out_d = out_v = None
+        if predicted is not None:
+            # speculative dispatch at the predicted capacity BEFORE the
+            # blocking count pull — the sync overlaps device work
+            fn = _materialize_fn(env.mesh, how, predicted, lwork.capacity,
+                                 tuple(plan), lspec, rspec)
+            out_d, out_v = fn(*mat_args)
+        counts = host_array(counts_dev).astype(np.int64)
+        out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+        _cap_cache_put(cache_key, out_cap)
+        if out_d is None or out_cap > predicted:
+            fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
+                                 tuple(plan), lspec, rspec)
+            out_d, out_v = fn(*mat_args)
     out = build_table(names, out_d, out_v, types, dicts, counts, env)
     if coalesce and not skew_split:
         # join output rows are key-grouped per shard (sorted merge order) and

@@ -1,0 +1,38 @@
+"""Logging wrapper — reference ``util/logging.hpp`` (glog wrapper with
+``SetLogLevel``, hpp:18-22).
+
+A thin veneer over :mod:`logging` so framework code logs through one
+switchable channel: ``log.info/warning/error/debug`` plus
+:func:`set_log_level` (accepting glog-style ints 0-3 or names).  Default
+level follows ``CYLON_TPU_LOG`` (env) or WARNING, matching the reference's
+quiet-by-default behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("cylon_tpu")
+
+_GLOG_LEVELS = {0: logging.INFO, 1: logging.WARNING, 2: logging.ERROR,
+                3: logging.CRITICAL}
+
+
+def set_log_level(level) -> None:
+    """glog-style int (0=INFO..3=FATAL), a logging level int, or a name."""
+    if isinstance(level, str):
+        lv = getattr(logging, level.upper())
+    elif level in _GLOG_LEVELS:
+        lv = _GLOG_LEVELS[level]
+    else:
+        lv = int(level)
+    log.setLevel(lv)
+
+
+if not log.handlers:  # one stderr handler, rank-tagged when multi-process
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(
+        "[%(levelname).1s cylon_tpu %(asctime)s] %(message)s", "%H:%M:%S"))
+    log.addHandler(_h)
+    set_log_level(os.environ.get("CYLON_TPU_LOG", "WARNING"))
