@@ -153,6 +153,8 @@ def gather_columns(spec: LaneSpec, datas, valids, take):
     column + validity bits, plus one raw gather per f64 column.  ``take``
     entries < 0 select row 0 (callers mask via validity).  Returns (datas,
     valids) aligned with the input order."""
+    if not spec.cols:
+        return (), ()
     n = datas[0].shape[0]
     sel = jnp.clip(take, 0, max(n - 1, 0))
     if spec.n_lanes:

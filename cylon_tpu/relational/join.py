@@ -208,8 +208,9 @@ def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
 
 
 def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-                  narrow: tuple):
-    """Per-shard single-sort join state (bnd, idx_s, live_cat).
+                  narrow: tuple, payloads: tuple = ()):
+    """Per-shard single-sort join state (bnd, idx_s, live_cat, sorted
+    payloads).
 
     Both sides must build structurally identical operand lists, so the
     null-flag presence per key column is the union of the two sides' and the
@@ -225,43 +226,69 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
     ko_r = pack.key_operands(list(r_datas), list(r_valids), row_mask=mask_r,
                              pad_key=PAD_R, need_null_flags=need_nf,
                              narrow32=narrow)
-    bnd, idx_s = joink.join_sort_state(ko_l, ko_r)
-    return bnd, idx_s, jnp.concatenate([mask_l, mask_r])
+    bnd, idx_s, pl_s = joink.join_sort_state(ko_l, ko_r, payloads)
+    return bnd, idx_s, jnp.concatenate([mask_l, mask_r]), pl_s
 
 
 @lru_cache(maxsize=None)
-def _count_fn(mesh: Mesh, how: str, narrow: tuple):
-    """Phase 1: sort once; return per-shard exact counts + carried state."""
+def _count_fn(mesh: Mesh, how: str, narrow: tuple,
+              rspec: lanes.LaneSpec | None = None):
+    """Phase 1: sort once; return per-shard exact counts + carried state.
 
-    def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids):
+    With ``rspec`` (inner/left joins over fully-laneable right columns),
+    the right side's u32 lane matrix RIDES THE SORT as payload operands —
+    ~2 ns/row/lane vs ~20 ns/row for the two dependent gathers
+    (idx_s[mpos], then the lane matrix) the materialize phase would
+    otherwise pay."""
+
+    def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
+                  rg_cols, rg_valids):
         cap_l = l_datas[0].shape[0]
-        bnd, idx_s, live = _sorted_state(vcl, vcr, l_datas, l_valids,
-                                         r_datas, r_valids, narrow)
+        payloads = ()
+        if rspec is not None:
+            rmat = lanes.pack_lanes(rspec, rg_cols, rg_valids)
+            zl = jnp.zeros(cap_l, jnp.uint32)
+            payloads = tuple(jnp.concatenate([zl, rmat[:, j]])
+                             for j in range(rspec.n_lanes))
+        bnd, idx_s, live, pl_s = _sorted_state(
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids, narrow, payloads)
         n, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
-        return (n.reshape(1),) + tuple(carry)
+        return (n.reshape(1),) + tuple(carry) + pl_s
 
+    n_pl = rspec.n_lanes if rspec is not None else 0
     return jax.jit(shard_map(per_shard, mesh=mesh,
-                             in_specs=(REP, REP, ROW, ROW, ROW, ROW),
-                             out_specs=(ROW,) * 7))
+                             in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW,
+                                       ROW),
+                             out_specs=(ROW,) * (7 + n_pl)))
 
 
 @lru_cache(maxsize=None)
 def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                     plan: tuple, lspec: lanes.LaneSpec,
-                    rspec: lanes.LaneSpec):
+                    rspec: lanes.LaneSpec, carry_right: bool = False):
     """Phase 2.  ``plan`` entries (static):
     ("l", i, needs_valid) — output column = left lane-matrix column i;
     ("r", j, needs_valid) — right lane-matrix column j;
     ("k", i, j, needs_valid) — coalesce left col i with right col j.
-    """
 
-    def per_shard(carry, l_cols, l_valids, r_cols, r_valids):
-        l_take, r_take, _total = joink.join_take(
+    ``carry_right``: the right lane matrix arrived pre-sorted as sort
+    payload (phase 1) — right values come from ONE (out, Lr) gather of the
+    sorted lanes at the match positions instead of idx_s[mpos] + a second
+    lane-matrix gather."""
+
+    def per_shard(carry, pl_s, l_cols, l_valids, r_cols, r_valids):
+        l_take, r_take, _total, mpos = joink.join_take(
             joink.JoinCarry(*carry), cap_l, how, out_cap)
         ldat, lval = lanes.gather_columns(lspec, l_cols, l_valids, l_take)
-        rdat, rval = lanes.gather_columns(rspec, r_cols, r_valids, r_take)
         l_ok = l_take >= 0
         r_ok = r_take >= 0
+        if carry_right:
+            smat = jnp.stack(pl_s, axis=1)          # (N, Lr) sorted lanes
+            rrows = smat[jnp.clip(mpos, 0, smat.shape[0] - 1)]
+            rdat, rval = lanes.unpack_lanes(rspec, rrows)
+        else:
+            rdat, rval = lanes.gather_columns(rspec, r_cols, r_valids,
+                                              r_take)
 
         def side_out(datas, vals, ok, i, needs_valid):
             d = datas[i]
@@ -291,7 +318,7 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
 
     return jax.jit(shard_map(
         per_shard, mesh=mesh,
-        in_specs=(ROW, ROW, ROW, ROW, ROW),
+        in_specs=(ROW, ROW, ROW, ROW, ROW, ROW),
         out_specs=(ROW, ROW)))
 
 
@@ -337,10 +364,6 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     vcl = np.asarray(lwork.valid_counts, np.int32)
     vcr = np.asarray(rwork.valid_counts, np.int32)
 
-    with timing.region("join.sort_count"):
-        res = _count_fn(env.mesh, how, narrow)(
-            vcl, vcr, l_datas, l_valids, r_datas, r_valids)
-        counts_dev, carry = res[0], res[1:]
     cache_key = (id(env.mesh), how, narrow, lwork.capacity, rwork.capacity,
                  int(lwork.valid_counts.sum()), int(rwork.valid_counts.sum()),
                  tuple(left_on), tuple(right_on),
@@ -405,11 +428,29 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         tuple(str(c.data.dtype) for c in r_cols_list),
         tuple(c.validity is not None for c in r_cols_list))
 
-    mat_args = (carry,
+    # ride the right lane matrix through the phase-1 sort when every right
+    # output column is laneable (no f64 side channels) and the lane count
+    # is small — payload operands cost ~2 ns/row vs ~20 ns/row gathers
+    carry_right = bool(how in ("inner", "left") and r_cols_list
+                       and all(c.lanes for c in rspec.cols)
+                       and rspec.n_lanes <= 8)
+
+    r_gather_args = (tuple(c.data for c in r_cols_list),
+                     tuple(c.validity for c in r_cols_list))
+    with timing.region("join.sort_count"):
+        # phase 1 only consumes the right columns when they ride the sort;
+        # keep them out of the trace otherwise (no needless retraces)
+        count_r_args = r_gather_args if carry_right else ((), ())
+        res = _count_fn(env.mesh, how, narrow,
+                        rspec if carry_right else None)(
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids, *count_r_args)
+        counts_dev, carry = res[0], res[1:7]
+        pl_s = tuple(res[7:])
+
+    mat_args = (carry, pl_s,
                 tuple(c.data for c in l_cols_list),
                 tuple(c.validity for c in l_cols_list),
-                tuple(c.data for c in r_cols_list),
-                tuple(c.validity for c in r_cols_list))
+                *r_gather_args)
 
     with timing.region("join.materialize"):
         out_d = out_v = None
@@ -417,14 +458,14 @@ def join_tables(left: Table, right: Table, left_on, right_on,
             # speculative dispatch at the predicted capacity BEFORE the
             # blocking count pull — the sync overlaps device work
             fn = _materialize_fn(env.mesh, how, predicted, lwork.capacity,
-                                 tuple(plan), lspec, rspec)
+                                 tuple(plan), lspec, rspec, carry_right)
             out_d, out_v = fn(*mat_args)
         counts = host_array(counts_dev).astype(np.int64)
         out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
         _cap_cache_put(cache_key, out_cap)
         if out_d is None or out_cap > predicted:
             fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
-                                 tuple(plan), lspec, rspec)
+                                 tuple(plan), lspec, rspec, carry_right)
             out_d, out_v = fn(*mat_args)
     out = build_table(names, out_d, out_v, types, dicts, counts, env)
     if coalesce and not skew_split:

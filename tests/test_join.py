@@ -116,3 +116,16 @@ def test_join_heavy_skew(env8, rng):
     got = join_tables(lt, rt, "k", "k", how="inner")
     exp = ldf.merge(rdf, on="k", how="inner")
     assert_table_matches(got, exp, sort_by=list(exp.columns))
+
+
+def test_join_right_table_key_only(env4):
+    """Right side contributes only the coalesced key column (regression:
+    carry_right must be a bool and gather_columns must accept empty specs)."""
+    import pandas as pd
+    ldf = pd.DataFrame({"k": [1, 2, 3, 4], "a": [1., 2., 3., 4.]})
+    rdf = pd.DataFrame({"k": [2, 3, 5]})
+    for how in ("inner", "left", "outer"):
+        j = join_tables(ct.Table.from_pandas(ldf, env4),
+                        ct.Table.from_pandas(rdf, env4), "k", "k", how=how)
+        exp = ldf.merge(rdf, on="k", how=how)
+        assert j.row_count == len(exp), (how, j.row_count, len(exp))
