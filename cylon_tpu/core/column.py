@@ -68,8 +68,14 @@ class Column:
     def _encode_strings(arr: np.ndarray) -> "Column":
         if arr.dtype.kind == "S":  # binary: decode, don't repr-mangle
             arr = np.char.decode(arr, "utf-8")
-        mask = np.asarray([v is None or (isinstance(v, float) and np.isnan(v))
-                           for v in arr]) if arr.dtype == object else np.zeros(len(arr), bool)
+        if arr.dtype == object:
+            # pd.isna covers None, float NaN, pd.NA and NaT — a hand-rolled
+            # None/NaN check silently stringifies pd.NA (pandas StringDtype
+            # nulls) into the literal "<NA>"
+            import pandas as pd
+            mask = np.asarray(pd.isna(arr), bool)
+        else:
+            mask = np.zeros(len(arr), bool)
         safe = np.where(mask, "", arr.astype(object)) if mask.any() else arr
 
         def as_str(v):

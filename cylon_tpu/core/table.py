@@ -81,7 +81,7 @@ class Table:
     @staticmethod
     def from_pandas(df, env: CylonEnv | None = None) -> "Table":
         env = env or default_env()
-        cols = {str(k): Column.from_numpy(df[k].to_numpy()) for k in df.columns}
+        cols = {str(k): _column_from_series(df[k]) for k in df.columns}
         if env.world_size == 1:
             return Table(_place_local(cols, env), env)
         return _distribute(cols, env)
@@ -209,6 +209,26 @@ class Table:
     def __repr__(self) -> str:  # pragma: no cover
         return (f"Table(rows={self.row_count}, cols={self.column_names}, "
                 f"world={self._env.world_size}, cap={self.capacity})")
+
+
+def _column_from_series(s) -> Column:
+    """pandas Series -> HOST Column, nullable-extension-dtype aware: masked
+    numeric/boolean dtypes (Int64/Float64/boolean, with .numpy_dtype) keep
+    their numeric payload + a validity mask instead of collapsing to an
+    object array of pd.NA (which would stringify); everything else takes
+    the plain to_numpy path (object/str columns dictionary-encode with a
+    pd.isna mask in Column._encode_strings)."""
+    import pandas as pd
+    npdt = getattr(s.dtype, "numpy_dtype", None)
+    if npdt is not None and npdt.kind in ("i", "u", "f", "b"):
+        mask = np.asarray(s.isna(), bool)
+        if mask.any():
+            vals = s.to_numpy(dtype=npdt, na_value=0)
+            col = Column.from_numpy(vals)
+            return Column(col.data, col.type, ~mask, col.dictionary,
+                          bounds=col.bounds)
+        return Column.from_numpy(s.to_numpy(dtype=npdt))
+    return Column.from_numpy(s.to_numpy())
 
 
 def _put(host: np.ndarray, sharding):

@@ -103,25 +103,91 @@ _GROUPED_NEEDS = {"sum": ("sum",), "count": ("count",),
                   "std": ("sum", "sumsq", "count")}
 
 
+def _u32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
 def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
-                   key_valids, seg_cap: int):
+                   key_valids, seg_cap: int, key_narrow=None,
+                   value_narrow=None):
     """Grouped-input fast path, fully batched: per-group sums for the
     cumsum-able ops (sum/count/mean/var/std) AND the representative-key
-    gather share ONE indexed pass per dtype class.
+    gather share ONE u32 lane-matrix gather (plus one f64 side gather when
+    float accumulators are present — f64 cannot lane-split on TPU).
 
     For contiguous runs, group g's sum over x is PS[starts[g+1]] -
     PS[starts[g]], with PS the zero-padded exclusive prefix of x and
-    starts[n_groups..] = n_live — so a single (seg_cap, k) gather of the
-    stacked prefix columns at ``starts`` + a consecutive diff replaces the
-    two bound gathers of the naive start/end formulation (gathers are the
-    dominant groupby cost on TPU); key columns and their validity ride the
-    same gather as passthrough lanes.
+    starts[n_groups..] = n_live — so a single (seg_cap, L) gather of the
+    stacked prefix lanes at ``starts`` + a consecutive diff replaces every
+    per-column reduction pass (gathers are the dominant groupby cost on
+    TPU, ~15 ns/row; splitting an i64 prefix into (hi, lo) u32 lanes is
+    elementwise ~1 ns/row).  Key columns and their validity ride the same
+    gather as passthrough lanes; ``key_narrow[i]`` (host-known bounds fit
+    int32) rides a 64-bit key as ONE lane; ``value_narrow[i]`` (host-proven
+    n·max|v| fits int32 — a BOOLEAN so compiled-fn caches key on it, not on
+    raw data bounds) narrows the i-th op's integer SUM prefix to one lane.
 
     Returns (inter dicts per op, key_out tuple, kval_out tuple)."""
+    from . import lanes as lanes_mod
     n = key_datas[0].shape[0]
 
-    # entries: (kind, slot, name, src) with kind prefix|key|kval
-    entries = []
+    # entries: (kind, slot, name) with kind prefix|key|kval; each appends
+    # its u32 lanes (or f64 side columns) plus a reconstruction recipe
+    u32_cols: list = []    # (n+1,) u32 arrays
+    f64_cols: list = []    # (n+1,) f64 arrays (side channel)
+    recipes: list = []     # (kind, slot, name, space, lane_ids, meta)
+
+    acc_i = _int_dtype()   # int64, or int32 under the CYLON_TPU_X64=0 opt-out
+
+    def prefix_lanes(src, islot, name):
+        if jnp.issubdtype(src.dtype, jnp.floating):
+            ps = jnp.concatenate([jnp.zeros(1, src.dtype), jnp.cumsum(src)])
+            if src.dtype == jnp.float32 and not jax.config.jax_enable_x64:
+                u32_cols.append(_u32(ps))
+                recipes.append(("prefix", islot, name, "u32",
+                                (len(u32_cols) - 1,), "f32"))
+            else:
+                f64_cols.append(ps.astype(jnp.float64))
+                recipes.append(("prefix", islot, name, "f64",
+                                (len(f64_cols) - 1,), None))
+            return
+        ps = jnp.concatenate([jnp.zeros(1, acc_i),
+                              jnp.cumsum(src.astype(acc_i))])
+        narrow = name == "count" or (
+            name == "sum" and value_narrow is not None
+            and bool(value_narrow[islot]))
+        narrow = narrow or np.dtype(ps.dtype).itemsize == 4
+        ls = lanes_mod._to_lanes(ps, narrow)   # 1 lane narrow, else (hi, lo)
+        u32_cols.extend(ls)
+        recipes.append(("prefix", islot, name, "u32",
+                        tuple(range(len(u32_cols) - len(ls),
+                                    len(u32_cols))),
+                        ("int32" if np.dtype(ps.dtype).itemsize == 4
+                         else "int64", narrow)))
+
+    def pass_lanes(src, kind, kslot):
+        """Passthrough (gathered at start, no diff): key data / validity.
+        Lane split/reconstruct delegates to lanes._to_lanes/_from_lanes
+        (one fork of the per-dtype packing rules, not two); recipe meta =
+        (dtype name, narrow flag) for the reconstruction."""
+        ext = jnp.concatenate([src, src[-1:]])
+        dt = np.dtype(ext.dtype)
+        if dt == np.float64:
+            f64_cols.append(ext)
+            recipes.append((kind, kslot, None, "f64",
+                            (len(f64_cols) - 1,), ("float64", False)))
+            return
+        nrw = key_narrow is not None and kind == "key" \
+            and bool(key_narrow[kslot]) and dt.itemsize == 8 \
+            and dt.kind in ("i", "u")
+        if np.issubdtype(dt, np.floating) and dt != np.float32:
+            ext = ext.astype(jnp.float32)  # f16 widens; recon casts back
+        ls = lanes_mod._to_lanes(ext, nrw)
+        u32_cols.extend(ls)
+        recipes.append((kind, kslot, None, "u32",
+                        tuple(range(len(u32_cols) - len(ls),
+                                    len(u32_cols))), (dt.name, nrw)))
+
     for i, op in enumerate(ops):
         vm = vmasks[i] if vmasks[i] is not None else jnp.ones(n, bool)
         v = values_list[i]
@@ -130,52 +196,66 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
             else v
         for name in _GROUPED_NEEDS[op]:
             if name == "count":
-                src = vm.astype(_int_dtype())
+                src = vm.astype(jnp.int32)
             elif name == "sum":
                 src = jnp.where(vm, f, jnp.zeros_like(f))
             else:
                 src = jnp.where(vm, f * f, jnp.zeros_like(f))
-            entries.append(("prefix", i, name, src))
+            prefix_lanes(src, i, name)
     for ki, (d, v) in enumerate(zip(key_datas, key_valids)):
-        entries.append(("key", ki, None, d))
+        pass_lanes(d, "key", ki)
         if v is not None:
-            entries.append(("kval", ki, None, v))
+            pass_lanes(v, "kval", ki)
 
-    by_dtype: dict = {}
-    for j, e in enumerate(entries):
-        by_dtype.setdefault(str(e[3].dtype), []).append(j)
-    results = [None] * len(entries)
-    for idxs in by_dtype.values():
-        cols = []
-        for j in idxs:
-            kind, _, _, src = entries[j]
-            if kind == "prefix":
-                cols.append(jnp.concatenate(
-                    [jnp.zeros(1, src.dtype), jnp.cumsum(src)]))  # (n+1,)
-            else:
-                cols.append(jnp.concatenate([src, src[-1:]]))
-        mat = jnp.stack(cols, axis=1)                  # (n+1, k)
+    def gather_pair(cols):
+        mat = jnp.stack(cols, axis=1)                  # (n+1, L)
         g = mat[starts]                                # THE gather
         # "next start" of slot seg_cap-1 is n_live (PS there = full total)
         tailv = mat[jnp.minimum(n_live, n)][None, :]
         g_next = jnp.concatenate([g[1:], tailv], axis=0)
-        for col, j in enumerate(idxs):
-            if entries[j][0] == "prefix":
-                results[j] = g_next[:, col] - g[:, col]
-            else:
-                results[j] = g[:, col]
+        return g, g_next
+
+    g_u = gn_u = g_f = gn_f = None
+    if u32_cols:
+        g_u, gn_u = gather_pair(u32_cols)
+    if f64_cols:
+        g_f, gn_f = gather_pair(f64_cols)
+
+    def prefix_recon(lane_ids, meta, at_next: bool):
+        """Gathered prefix lanes -> accumulator value (i32/i64/f32/f64)."""
+        src = gn_u if at_next else g_u
+        if meta is None:  # f64 side channel
+            return (gn_f if at_next else g_f)[:, lane_ids[0]]
+        if meta == "f32":
+            return jax.lax.bitcast_convert_type(src[:, lane_ids[0]],
+                                                jnp.float32)
+        dt_name, nrw = meta
+        return lanes_mod._from_lanes([src[:, li] for li in lane_ids],
+                                     dt_name, nrw)
 
     inters = [dict() for _ in ops]
     key_out = [None] * len(key_datas)
     kval_out = [None] * len(key_datas)
-    for j, e in enumerate(entries):
-        kind, slot, name, _ = e
+    for kind, slot, name, space, lane_ids, meta in recipes:
         if kind == "prefix":
-            inters[slot][name] = results[j]
-        elif kind == "key":
-            key_out[slot] = results[j]
+            d = prefix_recon(lane_ids, meta, True) \
+                - prefix_recon(lane_ids, meta, False)
+            if name == "count":
+                d = d.astype(_int_dtype())
+            inters[slot][name] = d
         else:
-            kval_out[slot] = results[j]
+            dt_name, nrw = meta
+            if space == "f64":
+                v = g_f[:, lane_ids[0]]
+            else:
+                v = lanes_mod._from_lanes([g_u[:, li] for li in lane_ids],
+                                          dt_name, nrw)
+                if np.issubdtype(np.dtype(dt_name), np.floating):
+                    v = v.astype(jnp.dtype(dt_name))
+            if kind == "key":
+                key_out[slot] = v
+            else:  # validity lanes are always planned as bool
+                kval_out[slot] = v
     return inters, tuple(key_out), tuple(kval_out)
 
 

@@ -82,16 +82,25 @@ def join_carry(bnd, idx_s, live_cat, n_l: int, how: str) -> tuple:
     """Phase-1 geometry: returns ``(total, JoinCarry)`` with ``total`` the
     exact output row count (device scalar int32).
 
-    Segmented counts come from plain prefix sums + ONE stacked monotone
-    gather at the group end/start positions — NOT ``associative_scan``,
-    whose XLA:TPU compile time explodes superlinearly with array size
-    (~200 s at 2M rows, measured)."""
+    Segmented counts come from prefix sums + monotone-broadcast scans ONLY
+    (cummax forward, reverse cummin backward over the non-decreasing
+    prefixes) — no gathers at all (~15 ns/row each, measured, vs ~1 ns/row
+    for a scan) and NOT ``associative_scan``, whose XLA:TPU compile time
+    explodes superlinearly with array size (~200 s at 2M rows, measured).
+
+    ``live_cat=None`` asserts every concat row is live (host-known
+    ``valid_counts == capacity`` — the common case for exact-bucket tables):
+    it skips the ~15 ns/row ``live_cat[idx_s]`` gather entirely."""
     n = bnd.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
     side = idx_s >= n_l
-    live = live_cat[idx_s]
-    lefts = ((~side) & live).astype(jnp.int32)
-    rights = (side & live).astype(jnp.int32)
+    if live_cat is None:
+        lefts = (~side).astype(jnp.int32)
+        rights = side.astype(jnp.int32)
+    else:
+        live = live_cat[idx_s]
+        lefts = ((~side) & live).astype(jnp.int32)
+        rights = (side & live).astype(jnp.int32)
     first = bnd.astype(bool) | (pos == 0)
 
     s_l = jnp.cumsum(lefts).astype(jnp.int32)    # inclusive prefix counts
@@ -102,26 +111,29 @@ def join_carry(bnd, idx_s, live_cat, n_l: int, how: str) -> tuple:
     need_fwd = emit_right or how == "outer"
 
     if need_fwd:
-        # lefts in the whole group, via the group-start prefix state
-        start = jax.lax.cummax(jnp.where(first, pos, 0))
-        at_start = jnp.stack([s_l, lefts], 1)[start]       # monotone gather
+        # S_l exclusive at the group start, broadcast forward: s_l - lefts is
+        # non-decreasing, so a cummax of its masked group-start values holds
+        # each position's own-group start state
+        b_l = jax.lax.cummax(jnp.where(first, s_l - lefts, jnp.int32(0)))
 
     if emit_right:
-        # group left-count = S_l[end] - S_l[start-1]; for a right row p all
-        # group lefts precede it, so S_l[p] already includes them all
-        cnt = (s_l - (at_start[:, 0] - at_start[:, 1])).astype(jnp.int32)
-        mstart = start
-        emits = side & live
+        # group left-count = S_l[p] - S_l[group start - 1]; for a right row
+        # all group lefts precede it (stability), so s_l[p] includes them all
+        cnt = (s_l - b_l).astype(jnp.int32)
+        mstart = jax.lax.cummax(jnp.where(first, pos, jnp.int32(0)))
+        emits = rights != 0
     else:
-        # group END position = next boundary - 1 (reverse min of marks)
+        # S_l/S_r at the group END, broadcast backward: the prefixes are
+        # non-decreasing, so reverse-cummin of their masked group-end values
+        # gives each position its own group's end state
         ebnd = jnp.concatenate([first[1:], jnp.ones(1, bool)])
-        end = jax.lax.cummin(jnp.where(ebnd, pos, jnp.int32(n)), reverse=True)
-        at_end = jnp.stack([s_l, s_r], 1)[end]             # monotone gather
-        t_l = at_end[:, 0] - (s_l - lefts)   # lefts in [p .. end]
-        t_r = at_end[:, 1] - (s_r - rights)  # rights in [p .. end]
-        cnt = t_r
+        imax = jnp.int32(2**31 - 1)
+        e_l = jax.lax.cummin(jnp.where(ebnd, s_l, imax), reverse=True)
+        e_r = jax.lax.cummin(jnp.where(ebnd, s_r, imax), reverse=True)
+        t_l = e_l - (s_l - lefts)            # lefts in [p .. end]
+        cnt = e_r - (s_r - rights)           # rights in [p .. end]
         mstart = pos + t_l                   # first right position of group
-        emits = (~side) & live
+        emits = lefts != 0
 
     eff = jnp.where(emits,
                     jnp.maximum(cnt, 1) if keep_unmatched else cnt,
@@ -131,42 +143,107 @@ def join_carry(bnd, idx_s, live_cat, n_l: int, how: str) -> tuple:
     total = (csum[-1] if n > 0 else jnp.int32(0)).astype(jnp.int32)
 
     if how == "outer":
-        grp_l = (s_l - (at_start[:, 0] - at_start[:, 1])).astype(jnp.int32)
-        un = (side & live & (grp_l == 0)).astype(jnp.int32)
+        grp_l = (s_l - b_l).astype(jnp.int32)
+        un = ((rights != 0) & (grp_l == 0)).astype(jnp.int32)
         total = total + jnp.sum(un)
     else:
         un = jnp.zeros(n, jnp.int32)
     return total, JoinCarry(offs, eff, cnt, mstart, idx_s, un)
 
 
-def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int):
-    """Phase-2 materialization: (l_take, r_take, total) — row index pairs of
-    the join result (l_take indexes left rows 0..n_l-1, r_take right rows
-    0..n_r-1), -1 marking the null side of unmatched outer rows.  ``out_cap``
-    must be >= phase 1's total; slots past ``total`` hold (-1, -1)."""
+class JoinTake(NamedTuple):
+    """Phase-2 expansion state, all (out_cap,) arrays over output slots.
+
+    ``valid`` covers the MAIN emission only (slot < total excluding outer
+    joins' appended unmatched-right rows, which occupy [main, total) with
+    valid=False but a real ``r_take``) — outer-join callers must use the
+    take arrays, not ``valid``, to mask real rows.  The carry_* fast paths
+    that do rely on ``valid`` are restricted to inner/left joins, where
+    valid exactly means "real output row"."""
+    total: jax.Array      # scalar int32: exact output rows
+    valid: jax.Array      # bool: slot holds a main-emission output row
+    matched: jax.Array    # bool: slot's match-side row exists
+    mpos: jax.Array       # int32: sorted position of the match-side row
+    l_take: object        # left row index or -1; None if suppressed
+    r_take: object        # right row index or -1; None if suppressed
+    extra: tuple          # carried emit-side u32 lanes at the owning row
+
+
+def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
+              extra: tuple = (), carry_emit: bool = False,
+              carry_match: bool = False) -> JoinTake:
+    """Phase-2 materialization over ``out_cap`` static output slots
+    (``out_cap`` >= phase 1's total; slots past ``total`` are invalid).
+
+    Output slot k is owned by the "emitting" sorted row (left rows for
+    inner/left/outer, right rows for right joins) whose offs/eff interval
+    contains k; ownership is reconstructed with one scatter (offs strictly
+    increase over emitting rows, so plain ``set`` — no combiner needed) and
+    a ``cummax`` fill.  ONE stacked (out, M) gather at the owner position
+    then provides the slot's geometry AND any ``extra`` u32 lanes the
+    caller rode through the phase-1 sort (the emit side's packed output
+    columns — ``carry_emit``).
+
+    Static specialization knobs (and the measured ~15 ns/slot gathers they
+    remove):
+      * ``carry_emit``: emit-side values arrive via ``extra`` → the owner's
+        concat-row index (idx_s) drops out of the meta stack and the
+        emit-side take array is None (no emit-side lane-matrix gather in
+        the caller).
+      * ``carry_match``: match-side values ride sorted payload lanes the
+        caller gathers at ``mpos`` → the dependent ``idx_s[mpos]`` gather
+        is skipped and the match-side take array is None.
+      * ``how == "inner"``: every emitted slot is a real match, so
+        ``matched == valid`` and the per-group match count drops out of the
+        meta stack entirely.
+    """
     offs, eff, cnt, mstart, idx_s, un = carry
     n = offs.shape[0]
     pos = jnp.arange(n, dtype=jnp.int32)
     total_main = (offs[-1] + eff[-1] if n > 0 else jnp.int32(0)).astype(
         jnp.int32)
 
+    # emitting rows have strictly increasing offs -> distinct slots: set,
+    # not max (measured ~8.8 vs ~12 ns/update); unscattered slots keep 0 and
+    # the cummax fill assigns them their predecessor's owner
     scat = jnp.where(eff > 0, offs, jnp.int32(out_cap))
-    p0 = jnp.zeros(out_cap, jnp.int32).at[scat].max(pos, mode="drop")
+    p0 = jnp.zeros(out_cap, jnp.int32).at[scat].set(pos, mode="drop")
     p_of_k = jax.lax.cummax(p0)
 
-    meta = jnp.stack([offs, cnt, mstart, idx_s], axis=1)[p_of_k]  # (out, 4)
+    need_cnt = how != "inner"
+    need_own_idx = not carry_emit
+    meta_cols = [offs, mstart]
+    if need_cnt:
+        meta_cols.append(cnt)
+    if need_own_idx:
+        meta_cols.append(idx_s)
+    for e in extra:
+        meta_cols.append(jax.lax.bitcast_convert_type(e, jnp.int32))
+    meta = jnp.stack(meta_cols, axis=1)[p_of_k]    # THE (out, M) gather
     k = jnp.arange(out_cap, dtype=jnp.int32)
     rel = k - meta[:, 0]
-    matched = rel < meta[:, 1]
-    mpos = jnp.clip(meta[:, 2] + rel, 0, max(n - 1, 0))
-    m_idx = idx_s[mpos]
     valid = k < total_main
+    matched = valid if how == "inner" else valid & (rel < meta[:, 2])
+    mpos = jnp.clip(meta[:, 1] + rel, 0, max(n - 1, 0))
+    ci = 2 + int(need_cnt)
+    own_idx = meta[:, ci] if need_own_idx else None
+    extra_out = tuple(
+        jax.lax.bitcast_convert_type(meta[:, ci + int(need_own_idx) + j],
+                                     jnp.uint32)
+        for j in range(len(extra)))
+    m_idx = None if carry_match else idx_s[mpos]
+
+    l_take = r_take = None
     if how == "right":
-        r_take = jnp.where(valid, meta[:, 3] - n_l, jnp.int32(-1))
-        l_take = jnp.where(valid & matched, m_idx, jnp.int32(-1))
+        if need_own_idx:
+            r_take = jnp.where(valid, own_idx - n_l, jnp.int32(-1))
+        if m_idx is not None:
+            l_take = jnp.where(matched, m_idx, jnp.int32(-1))
     else:
-        l_take = jnp.where(valid, meta[:, 3], jnp.int32(-1))
-        r_take = jnp.where(valid & matched, m_idx - n_l, jnp.int32(-1))
+        if need_own_idx:
+            l_take = jnp.where(valid, own_idx, jnp.int32(-1))
+        if m_idx is not None:
+            r_take = jnp.where(matched, m_idx - n_l, jnp.int32(-1))
 
     total = total_main
     if how == "outer":
@@ -174,4 +251,4 @@ def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int):
         slot = jnp.where(un > 0, total_main + unpos, jnp.int32(out_cap))
         r_take = r_take.at[slot].set(idx_s - n_l, mode="drop")
         total = total_main + jnp.sum(un).astype(jnp.int32)
-    return l_take, r_take, total, mpos
+    return JoinTake(total, valid, matched, mpos, l_take, r_take, extra_out)

@@ -40,6 +40,8 @@ class ColLanes(NamedTuple):
     lanes: tuple     # lane indices (1 or 2 entries; 64-bit = (hi, lo));
                      # empty tuple = non-laneable (f64 side column)
     valid_bit: int   # bit position in the validity lane block, or -1
+    narrow: bool = False  # 64-bit int whose host-known bounds fit int32:
+                          # packed as ONE sign-extending lane instead of two
 
 
 class LaneSpec(NamedTuple):
@@ -48,22 +50,27 @@ class LaneSpec(NamedTuple):
     valid_lane0: int     # first validity lane index (== n_lanes if none)
 
 
-def plan_lanes(dtypes, has_valid) -> LaneSpec:
+def plan_lanes(dtypes, has_valid, narrow=None) -> LaneSpec:
     """Build the static lane layout for columns of ``dtypes`` (numpy dtype
     names) where ``has_valid[i]`` marks nullable columns.  float64 columns
-    get no lanes (side-channel); their validity still rides the matrix."""
+    get no lanes (side-channel); their validity still rides the matrix.
+    ``narrow[i]`` (host-known ``Column.bounds`` fit int32) packs a 64-bit
+    integer column as ONE lane — every pass that moves the matrix gets
+    proportionally cheaper."""
     cols = []
     lane = 0
     vbit = 0
-    for dt, hv in zip(dtypes, has_valid):
+    for i, (dt, hv) in enumerate(zip(dtypes, has_valid)):
         ndt = np.dtype(dt)
+        nrw = bool(narrow[i]) if narrow is not None else False
+        nrw = nrw and ndt.itemsize == 8 and ndt.kind in ("i", "u")
         if ndt.itemsize == 8 and np.issubdtype(ndt, np.floating):
             lanes = ()
         else:
-            width = 2 if ndt.itemsize == 8 else 1
+            width = 1 if (ndt.itemsize < 8 or nrw) else 2
             lanes = tuple(range(lane, lane + width))
             lane += width
-        cols.append(ColLanes(dt, lanes, vbit if hv else -1))
+        cols.append(ColLanes(dt, lanes, vbit if hv else -1, nrw))
         if hv:
             vbit += 1
     valid_lane0 = lane
@@ -71,13 +78,16 @@ def plan_lanes(dtypes, has_valid) -> LaneSpec:
     return LaneSpec(tuple(cols), lane + n_valid_lanes, valid_lane0)
 
 
-def _to_lanes(x):
+def _to_lanes(x, narrow: bool = False):
     """Column data array -> list of u32 lane arrays (hi, lo for 64-bit
     ints; f64 never reaches here — it is planned laneless)."""
     dt = x.dtype
     if dt == jnp.bool_:
         return [x.astype(jnp.uint32)]
     if dt.itemsize == 8:
+        if narrow:  # host-known bounds fit int32: one sign-carrying lane
+            return [jax.lax.bitcast_convert_type(x.astype(jnp.int32),
+                                                 jnp.uint32)]
         xi = x.astype(jnp.int64) if dt != jnp.uint64 else x
         hi = (xi >> 32).astype(jnp.uint32)
         lo = (xi & jnp.asarray(0xFFFFFFFF, xi.dtype)).astype(jnp.uint32)
@@ -91,12 +101,15 @@ def _to_lanes(x):
     return [x.astype(jnp.uint32)]
 
 
-def _from_lanes(lanes, dtype: str):
+def _from_lanes(lanes, dtype: str, narrow: bool = False):
     dt = np.dtype(dtype)
     jdt = jnp.dtype(dt)
     if dt == np.bool_:
         return lanes[0] != 0
     if dt.itemsize == 8:
+        if narrow:
+            return jax.lax.bitcast_convert_type(
+                lanes[0], jnp.int32).astype(jdt)
         hi, lo = lanes
         x = (jax.lax.bitcast_convert_type(hi, jnp.int32).astype(jnp.int64)
              << 32) | lo.astype(jnp.int64)
@@ -118,7 +131,7 @@ def pack_lanes(spec: LaneSpec, datas, valids):
     vlanes = [jnp.zeros(n, jnp.uint32) for _ in range(n_valid_lanes)]
     for col, d, v in zip(spec.cols, datas, valids):
         if col.lanes:
-            for li, arr in zip(col.lanes, _to_lanes(d)):
+            for li, arr in zip(col.lanes, _to_lanes(d, col.narrow)):
                 lanes[li] = arr
         if col.valid_bit >= 0:
             vb = jnp.ones(n, jnp.uint32) if v is None else v.astype(jnp.uint32)
@@ -137,7 +150,7 @@ def unpack_lanes(spec: LaneSpec, mat):
     for col in spec.cols:
         if col.lanes:
             datas.append(_from_lanes([mat[:, li] for li in col.lanes],
-                                     col.dtype))
+                                     col.dtype, col.narrow))
         else:
             datas.append(None)
         if col.valid_bit >= 0:

@@ -130,12 +130,17 @@ def unify_dictionaries_many(cols: list[Column]) -> list[Column]:
 
 
 def build_table(names, out_datas, out_valids, types, dicts,
-                valid_counts: np.ndarray, env: CylonEnv) -> Table:
+                valid_counts: np.ndarray, env: CylonEnv,
+                bounds=None) -> Table:
     """Assemble an output Table from kernel results (the static-shape analog
-    of the reference's join_utils output builders)."""
+    of the reference's join_utils output builders).  ``bounds`` (optional,
+    parallel to names) propagates host-known integer value bounds so
+    downstream ops keep their narrow-lane fast paths."""
     cols = {}
-    for name, d, v, t, dc in zip(names, out_datas, out_valids, types, dicts):
-        cols[name] = Column(d, t, v, dc)
+    for i, (name, d, v, t, dc) in enumerate(
+            zip(names, out_datas, out_valids, types, dicts)):
+        b = bounds[i] if bounds is not None else None
+        cols[name] = Column(d, t, v, dc, bounds=b)
     return Table(cols, env, np.asarray(valid_counts, np.int64))
 
 

@@ -39,6 +39,16 @@ shard_map = jax.shard_map
 
 _VALID_OPS = gbk.ASSOCIATIVE | gbk.NON_ASSOCIATIVE
 
+#: callsite-signature -> last observed group-count bucket (bounded FIFO)
+_SEG_CACHE: dict = {}
+_SEG_CACHE_MAX = 512
+
+
+def _seg_cache_put(key, value) -> None:
+    if len(_SEG_CACHE) >= _SEG_CACHE_MAX:
+        _SEG_CACHE.pop(next(iter(_SEG_CACHE)))
+    _SEG_CACHE[key] = value
+
 #: static intermediate-column order per op (mapreduce.hpp:27 analog: MEAN ->
 #: {sum,count}, VAR/STD -> {sum,sumsq,count})
 INTER_NAMES = {
@@ -165,10 +175,14 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple):
 
 @lru_cache(maxsize=None)
 def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
-            narrow: tuple):
+            narrow: tuple, vnarrow: tuple = ()):
     """Single-phase per shard over raw (already co-located) rows — used for
     non-associative ops, the local path, and the grouped-input fast path
-    (join/sort output: no shuffle, no rank sort)."""
+    (join/sort output: no shuffle, no rank sort).  ``vnarrow``: host-proven
+    boolean per value column (rows·max|v| fits int32 — derived from
+    ``Column.bounds``, reduced to a bool so this cache keys on the
+    decision, not on per-batch data bounds), letting the grouped path
+    narrow integer sum-prefix lanes."""
 
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
         gids, n_groups, mask, first = _group_keys(by_datas, by_valids, vc,
@@ -187,7 +201,10 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             inters, key_out, kval_out = gbk.grouped_reduce(
                 [specs[i][0] for i in sel], [val_datas[i] for i in sel],
                 [vmasks[i] for i in sel], starts, n_live,
-                list(by_datas), list(by_valids), seg_cap)
+                list(by_datas), list(by_valids), seg_cap,
+                key_narrow=narrow,
+                value_narrow=[vnarrow[i] if vnarrow else False
+                              for i in sel])
             batched = dict(zip(sel, inters))
         else:
             key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
@@ -351,11 +368,38 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     val_valids = tuple(work.column(c).validity for c, _, _, _ in specs)
     vc = np.asarray(work.valid_counts, np.int32)
     spec_t = tuple((op, q) for _, op, q, _ in specs)
+    cap_full = max(work.capacity, 1)
+
+    def sum_fits_i32(col: Column) -> bool:
+        b = col.bounds
+        if b is None or col.data.dtype.kind not in ("i", "u"):
+            return False
+        m = max(abs(int(b[0])), abs(int(b[1])))
+        return m * cap_full < (1 << 31)
+
+    vnarrow = tuple(sum_fits_i32(work.column(c)) for c, _, _, _ in specs)
+    # segment-capacity hysteresis: every reduction/scatter/gather in _raw_fn
+    # runs over seg_cap slots, but the true group count is usually far below
+    # row capacity — dispatch at the previous call's observed bucket and
+    # re-dispatch at full capacity only when the observed count exceeds it
+    # (n_groups comes from the gids themselves, so a mispredict is always
+    # detected).  Steady-state pipelines (benchmarks, iterative queries) hit.
+    seg_key = (id(env.mesh), spec_t, tuple(by), grouped, narrow, ddof,
+               cap_full, int(work.valid_counts.sum()))
+    pred = _SEG_CACHE.get(seg_key)
+    args = (vc, by_datas, by_valids, val_datas, val_valids)
     with timing.region("groupby.raw"):
-        key_out, kval_out, res_d, res_v, n_groups = _raw_fn(
-            env.mesh, spec_t, max(work.capacity, 1), ddof, grouped, narrow)(
-                vc, by_datas, by_valids, val_datas, val_valids)
-        n_groups = host_array(n_groups).astype(np.int64)
+        seg_cap = pred if (pred is not None and pred < cap_full) else cap_full
+        res = _raw_fn(env.mesh, spec_t, seg_cap, ddof, grouped, narrow,
+                      vnarrow)(*args)
+        n_groups = host_array(res[4]).astype(np.int64)
+        ng_cap = min(config.pow2ceil(int(n_groups.max()) if n_groups.size
+                                     else 1), cap_full)
+        if ng_cap > seg_cap:
+            res = _raw_fn(env.mesh, spec_t, ng_cap, ddof, grouped, narrow,
+                          vnarrow)(*args)
+        _seg_cache_put(seg_key, ng_cap)
+        key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
     out = _result_table(env, by, by_cols, key_out, kval_out, res_names, res_d,
                         res_v, res_types, res_dicts, n_groups)
     out = _shrink(out, n_groups)

@@ -208,16 +208,21 @@ def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
 
 
 def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-                  narrow: tuple, payloads: tuple = ()):
+                  narrow: tuple, payloads: tuple = (),
+                  all_live: bool = False):
     """Per-shard single-sort join state (bnd, idx_s, live_cat, sorted
     payloads).
 
     Both sides must build structurally identical operand lists, so the
     null-flag presence per key column is the union of the two sides' and the
-    narrow-key decision is made by the caller for the pair."""
+    narrow-key decision is made by the caller for the pair.
+
+    ``all_live=True`` (host-known: both tables' valid_counts == capacity)
+    drops the row-liveness sort operand AND the downstream liveness gather
+    (live_cat=None) — one less sort pass and one less ~15 ns/row gather."""
     cap_l, cap_r = l_datas[0].shape[0], r_datas[0].shape[0]
-    mask_l = live_mask(vcl, cap_l)
-    mask_r = live_mask(vcr, cap_r)
+    mask_l = None if all_live else live_mask(vcl, cap_l)
+    mask_r = None if all_live else live_mask(vcr, cap_r)
     need_nf = tuple((lv is not None) or (rv is not None)
                     for lv, rv in zip(l_valids, r_valids))
     ko_l = pack.key_operands(list(l_datas), list(l_valids), row_mask=mask_l,
@@ -227,68 +232,94 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
                              pad_key=PAD_R, need_null_flags=need_nf,
                              narrow32=narrow)
     bnd, idx_s, pl_s = joink.join_sort_state(ko_l, ko_r, payloads)
-    return bnd, idx_s, jnp.concatenate([mask_l, mask_r]), pl_s
+    live_cat = None if all_live \
+        else jnp.concatenate([mask_l, mask_r])
+    return bnd, idx_s, live_cat, pl_s
 
 
 @lru_cache(maxsize=None)
 def _count_fn(mesh: Mesh, how: str, narrow: tuple,
-              rspec: lanes.LaneSpec | None = None):
+              lspec: lanes.LaneSpec | None = None,
+              rspec: lanes.LaneSpec | None = None, all_live: bool = False):
     """Phase 1: sort once; return per-shard exact counts + carried state.
 
-    With ``rspec`` (inner/left joins over fully-laneable right columns),
-    the right side's u32 lane matrix RIDES THE SORT as payload operands —
-    ~2 ns/row/lane vs ~20 ns/row for the two dependent gathers
-    (idx_s[mpos], then the lane matrix) the materialize phase would
-    otherwise pay."""
+    With ``lspec``/``rspec`` (inner/left joins over fully-laneable output
+    columns), that side's u32 lane matrix RIDES THE SORT as payload
+    operands — ~1.7 ns/row/lane (measured) vs ~15 ns/row for the gathers
+    the materialize phase would otherwise pay: ``rspec`` kills the
+    dependent ``idx_s[mpos]`` + right lane-matrix gathers, ``lspec`` folds
+    the left values into the meta-stack gather that phase 2 already does.
+    Payload layout: left (emit) lanes first, then right (match) lanes."""
 
     def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-                  rg_cols, rg_valids):
+                  lg_cols, lg_valids, rg_cols, rg_valids):
         cap_l = l_datas[0].shape[0]
+        cap_r = r_datas[0].shape[0]
         payloads = ()
+        if lspec is not None:
+            lmat = lanes.pack_lanes(lspec, lg_cols, lg_valids)
+            zr = jnp.zeros(cap_r, jnp.uint32)
+            payloads += tuple(jnp.concatenate([lmat[:, j], zr])
+                              for j in range(lspec.n_lanes))
         if rspec is not None:
             rmat = lanes.pack_lanes(rspec, rg_cols, rg_valids)
             zl = jnp.zeros(cap_l, jnp.uint32)
-            payloads = tuple(jnp.concatenate([zl, rmat[:, j]])
-                             for j in range(rspec.n_lanes))
+            payloads += tuple(jnp.concatenate([zl, rmat[:, j]])
+                              for j in range(rspec.n_lanes))
         bnd, idx_s, live, pl_s = _sorted_state(
-            vcl, vcr, l_datas, l_valids, r_datas, r_valids, narrow, payloads)
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids, narrow, payloads,
+            all_live)
         n, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
         return (n.reshape(1),) + tuple(carry) + pl_s
 
-    n_pl = rspec.n_lanes if rspec is not None else 0
+    n_pl = (lspec.n_lanes if lspec is not None else 0) + \
+        (rspec.n_lanes if rspec is not None else 0)
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW,
-                                       ROW),
+                                       ROW, ROW, ROW),
                              out_specs=(ROW,) * (7 + n_pl)))
 
 
 @lru_cache(maxsize=None)
 def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                     plan: tuple, lspec: lanes.LaneSpec,
-                    rspec: lanes.LaneSpec, carry_right: bool = False):
+                    rspec: lanes.LaneSpec, carry_emit: bool = False,
+                    carry_match: bool = False):
     """Phase 2.  ``plan`` entries (static):
     ("l", i, needs_valid) — output column = left lane-matrix column i;
     ("r", j, needs_valid) — right lane-matrix column j;
     ("k", i, j, needs_valid) — coalesce left col i with right col j.
 
-    ``carry_right``: the right lane matrix arrived pre-sorted as sort
+    ``carry_match``: the right lane matrix arrived pre-sorted as sort
     payload (phase 1) — right values come from ONE (out, Lr) gather of the
     sorted lanes at the match positions instead of idx_s[mpos] + a second
-    lane-matrix gather."""
+    lane-matrix gather.  ``carry_emit``: the left lane matrix arrived the
+    same way and rides join_take's meta-stack gather — no separate left
+    gather at all.  Both only for how in (inner, left)."""
 
     def per_shard(carry, pl_s, l_cols, l_valids, r_cols, r_valids):
-        l_take, r_take, _total, mpos = joink.join_take(
-            joink.JoinCarry(*carry), cap_l, how, out_cap)
-        ldat, lval = lanes.gather_columns(lspec, l_cols, l_valids, l_take)
-        l_ok = l_take >= 0
-        r_ok = r_take >= 0
-        if carry_right:
-            smat = jnp.stack(pl_s, axis=1)          # (N, Lr) sorted lanes
-            rrows = smat[jnp.clip(mpos, 0, smat.shape[0] - 1)]
+        n_e = lspec.n_lanes if carry_emit else 0
+        pl_e, pl_m = pl_s[:n_e], pl_s[n_e:]
+        tk = joink.join_take(joink.JoinCarry(*carry), cap_l, how, out_cap,
+                             extra=pl_e, carry_emit=carry_emit,
+                             carry_match=carry_match)
+        if carry_emit:
+            emat = jnp.stack(tk.extra, axis=1)      # already at out slots
+            ldat, lval = lanes.unpack_lanes(lspec, emat)
+            l_ok = tk.valid
+        else:
+            ldat, lval = lanes.gather_columns(lspec, l_cols, l_valids,
+                                              tk.l_take)
+            l_ok = tk.l_take >= 0
+        if carry_match:
+            smat = jnp.stack(pl_m, axis=1)          # (N, Lr) sorted lanes
+            rrows = smat[jnp.clip(tk.mpos, 0, smat.shape[0] - 1)]
             rdat, rval = lanes.unpack_lanes(rspec, rrows)
+            r_ok = tk.matched
         else:
             rdat, rval = lanes.gather_columns(rspec, r_cols, r_valids,
-                                              r_take)
+                                              tk.r_take)
+            r_ok = tk.r_take >= 0
 
         def side_out(datas, vals, ok, i, needs_valid):
             d = datas[i]
@@ -384,12 +415,19 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         side_list.append(col)
         return len(side_list) - 1
 
-    plan, names, types, dicts = [], [], [], []
+    plan, names, types, dicts, bounds = [], [], [], [], []
+
+    def merged_bounds(a: Column, b: Column):
+        if a.bounds is None or b.bounds is None:
+            return None
+        return (min(a.bounds[0], b.bounds[0]), max(a.bounds[1], b.bounds[1]))
+
     for n in lwork.column_names:
         col = lwork.column(n)
         if coalesce and n in key_set_l:
             ki = left_on.index(n)
             rcol = rwork.column(right_on[ki])
+            bounds.append(merged_bounds(col, rcol))
             # the coalesced key only needs BOTH sides for outer joins; for
             # inner/left every output row has a live left key (and for right
             # a live right key) — one lane set instead of two
@@ -407,6 +445,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         else:
             needs_valid = col.validity is not None or how in ("right", "outer")
             plan.append(("l", lane_col(l_cols_list, col), needs_valid))
+            bounds.append(col.bounds)
             n = n + suffixes[0] if n in overlap else n
         names.append(n)
         types.append(col.type)
@@ -420,37 +459,52 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         names.append(n + suffixes[1] if n in overlap else n)
         types.append(col.type)
         dicts.append(col.dictionary)
+        bounds.append(col.bounds)
 
+    # host-known bounds narrow 64-bit lanes to one u32 lane each
     lspec = lanes.plan_lanes(
         tuple(str(c.data.dtype) for c in l_cols_list),
-        tuple(c.validity is not None for c in l_cols_list))
+        tuple(c.validity is not None for c in l_cols_list),
+        narrow32_flags(l_cols_list))
     rspec = lanes.plan_lanes(
         tuple(str(c.data.dtype) for c in r_cols_list),
-        tuple(c.validity is not None for c in r_cols_list))
+        tuple(c.validity is not None for c in r_cols_list),
+        narrow32_flags(r_cols_list))
 
-    # ride the right lane matrix through the phase-1 sort when every right
-    # output column is laneable (no f64 side channels) and the lane count
-    # is small — payload operands cost ~2 ns/row vs ~20 ns/row gathers
-    carry_right = bool(how in ("inner", "left") and r_cols_list
-                       and all(c.lanes for c in rspec.cols)
-                       and rspec.n_lanes <= 8)
+    # ride a side's lane matrix through the phase-1 sort when every one of
+    # its output columns is laneable (no f64 side channels) and the lane
+    # count is small — payload operands cost ~1.7 ns/row vs ~15 ns/row
+    # gathers.  carry_match (right side) kills the dependent idx_s[mpos] +
+    # right lane-matrix gathers; carry_emit (left side) folds the left
+    # values into the meta-stack gather join_take already performs.
+    def _can_carry(spec, col_list, budget: int) -> bool:
+        return bool(how in ("inner", "left") and col_list
+                    and all(c.lanes for c in spec.cols)
+                    and spec.n_lanes <= budget)
 
+    carry_match = _can_carry(rspec, r_cols_list, 8)
+    carry_emit = _can_carry(lspec, l_cols_list, 6)
+
+    l_gather_args = (tuple(c.data for c in l_cols_list),
+                     tuple(c.validity for c in l_cols_list))
     r_gather_args = (tuple(c.data for c in r_cols_list),
                      tuple(c.validity for c in r_cols_list))
+    all_live = bool((vcl == lwork.capacity).all()
+                    and (vcr == rwork.capacity).all())
     with timing.region("join.sort_count"):
-        # phase 1 only consumes the right columns when they ride the sort;
-        # keep them out of the trace otherwise (no needless retraces)
-        count_r_args = r_gather_args if carry_right else ((), ())
+        # phase 1 only consumes the columns that ride the sort; keep the
+        # rest out of the trace (no needless retraces)
+        count_l_args = l_gather_args if carry_emit else ((), ())
+        count_r_args = r_gather_args if carry_match else ((), ())
         res = _count_fn(env.mesh, how, narrow,
-                        rspec if carry_right else None)(
-            vcl, vcr, l_datas, l_valids, r_datas, r_valids, *count_r_args)
+                        lspec if carry_emit else None,
+                        rspec if carry_match else None, all_live)(
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids,
+            *count_l_args, *count_r_args)
         counts_dev, carry = res[0], res[1:7]
         pl_s = tuple(res[7:])
 
-    mat_args = (carry, pl_s,
-                tuple(c.data for c in l_cols_list),
-                tuple(c.validity for c in l_cols_list),
-                *r_gather_args)
+    mat_args = (carry, pl_s, *l_gather_args, *r_gather_args)
 
     with timing.region("join.materialize"):
         out_d = out_v = None
@@ -458,16 +512,19 @@ def join_tables(left: Table, right: Table, left_on, right_on,
             # speculative dispatch at the predicted capacity BEFORE the
             # blocking count pull — the sync overlaps device work
             fn = _materialize_fn(env.mesh, how, predicted, lwork.capacity,
-                                 tuple(plan), lspec, rspec, carry_right)
+                                 tuple(plan), lspec, rspec, carry_emit,
+                                 carry_match)
             out_d, out_v = fn(*mat_args)
         counts = host_array(counts_dev).astype(np.int64)
         out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
         _cap_cache_put(cache_key, out_cap)
         if out_d is None or out_cap > predicted:
             fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
-                                 tuple(plan), lspec, rspec, carry_right)
+                                 tuple(plan), lspec, rspec, carry_emit,
+                                 carry_match)
             out_d, out_v = fn(*mat_args)
-    out = build_table(names, out_d, out_v, types, dicts, counts, env)
+    out = build_table(names, out_d, out_v, types, dicts, counts, env,
+                      bounds=bounds)
     if coalesce and not skew_split:
         # join output rows are key-grouped per shard (sorted merge order) and
         # keys are co-located across shards (hash shuffle) -> groupby on the

@@ -27,6 +27,8 @@ import pandas as pd
 
 import jax
 
+# run from any cwd / without the package installed
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import cylon_tpu as ct
 from cylon_tpu.ctx.context import TPUConfig
 from cylon_tpu.relational import groupby_aggregate, join_tables, sort_table

@@ -92,3 +92,26 @@ def test_narrow_key_join_matches_wide(env, rng):
     j2 = join_tables(ct.Table.from_pandas(ldf2, env),
                      ct.Table.from_pandas(rdf2, env), "k", "k", how="outer")
     assert_table_matches(j2, ldf2.merge(rdf2, on="k", how="outer"))
+
+
+def test_grouped_uint64_wide_keys_and_values(env4, rng):
+    """uint64 keys/values beyond int32 range through the grouped fast path
+    (regression: the u32 lane split must mask with the source dtype, and
+    wide values must keep 2-lane sum prefixes)."""
+    n = 256
+    base = np.uint64(1) << np.uint64(33)
+    kdf = pd.DataFrame({"k": (rng.integers(0, 6, n).astype(np.uint64) + base),
+                        "a": rng.integers(0, 1 << 40, n).astype(np.uint64)})
+    rdf = pd.DataFrame({"k": (rng.integers(0, 6, n // 2).astype(np.uint64)
+                              + base),
+                        "b": rng.integers(0, 100, n // 2).astype(np.uint64)})
+    lt = ct.Table.from_pandas(kdf, env4)
+    rt = ct.Table.from_pandas(rdf, env4)
+    j = join_tables(lt, rt, "k", "k", how="inner")
+    g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum"),
+                                   ("a", "count")])
+    exp = (kdf.merge(rdf, on="k", how="inner")
+           .groupby("k", as_index=False)
+           .agg(a_sum=("a", "sum"), b_sum=("b", "sum"),
+                a_count=("a", "count")))
+    assert_table_matches(g, exp)

@@ -74,3 +74,23 @@ def test_empty_table(env4):
     t = ct.Table.from_pandas(df, env4)
     assert t.row_count == 0
     assert len(t.to_pandas()) == 0
+
+
+def test_from_pandas_extension_dtypes(env4):
+    """pandas StringDtype / nullable Int64 / boolean nulls must ingest as
+    real nulls, not stringified '<NA>' (regression: verify-drive finding)."""
+    import pandas as pd
+    df = pd.DataFrame({
+        "s": pd.array(["a", None, "b", None], dtype="str"),
+        "i": pd.array([1, None, 3, 4], dtype="Int64"),
+        "f": pd.array([1.5, 2.5, None, 4.0], dtype="Float64"),
+        "b": pd.array([True, None, False, True], dtype="boolean"),
+    })
+    t = ct.Table.from_pandas(df, env4)
+    rt = t.to_pandas()
+    assert rt["s"].isna().tolist() == [False, True, False, True]
+    assert "<NA>" not in rt["s"].astype(str).tolist()[0]
+    assert rt["i"].isna().tolist() == [False, True, False, False]
+    assert rt["i"].dropna().tolist() == [1, 3, 4]
+    assert rt["f"].isna().tolist() == [False, False, True, False]
+    assert rt["b"].isna().tolist() == [False, True, False, False]
