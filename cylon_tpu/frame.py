@@ -391,6 +391,50 @@ class DataFrame:
         return self._rebuild_cols(
             {c: self[c].notna().column for c in self.columns})
 
+    # pandas/pycylon aliases (reference data/table.pyx isnull/notnull)
+    isnull = isna
+    notnull = notna
+
+    def add_prefix(self, prefix: str) -> "DataFrame":
+        """Rename every visible column to ``prefix + name`` (reference
+        data/table.pyx add_prefix)."""
+        return self.rename({c: prefix + c for c in self.columns})
+
+    def add_suffix(self, suffix: str) -> "DataFrame":
+        return self.rename({c: c + suffix for c in self.columns})
+
+    def where(self, cond: "DataFrame | Series", other=None) -> "DataFrame":
+        """Keep values where ``cond`` holds; elsewhere ``other`` (null when
+        ``other`` is None) — pandas/pycylon ``where`` semantics over a bool
+        frame or a single bool Series applied to every column."""
+        from .relational.common import valid_flag
+        cols = {}
+        for name in self.columns:
+            col = self._table.column(name)
+            c_ser = cond[name] if isinstance(cond, DataFrame) else cond
+            flag = valid_flag(c_ser.column)
+            if other is None:
+                v = flag if col.validity is None else (col.validity & flag)
+                cols[name] = Column(col.data, col.type, v, col.dictionary)
+            else:
+                s = Series(name, col, self.env, self._table.valid_counts)
+                filled = s._fill_where(~flag, other)
+                cols[name] = filled.column
+        return self._rebuild_cols(cols)
+
+    def to_pydict(self) -> dict:
+        """Materialize as {column: list} (reference data/table.pyx
+        to_pydict)."""
+        return {c: list(self[c].to_numpy()) for c in self.columns}
+
+    def to_string(self) -> str:
+        return self.to_pandas().to_string()
+
+    def show(self, n: int = 10) -> None:
+        """Print the first n rows (reference data/table.pyx show /
+        Table::PrintToOStream, table.hpp:96)."""
+        print(self.head(n).to_pandas().to_string())
+
     def dropna(self, how: str = "any", subset=None) -> "DataFrame":
         """Drop rows with missing values (any/all over ``subset``)."""
         from .status import InvalidError as _IE

@@ -250,8 +250,6 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
             else:
                 v = lanes_mod._from_lanes([g_u[:, li] for li in lane_ids],
                                           dt_name, nrw)
-                if np.issubdtype(np.dtype(dt_name), np.floating):
-                    v = v.astype(jnp.dtype(dt_name))
             if kind == "key":
                 key_out[slot] = v
             else:  # validity lanes are always planned as bool

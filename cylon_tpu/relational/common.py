@@ -28,6 +28,21 @@ REP = P()
 PAD_L, PAD_R = 4, 5
 
 
+class BoundedCache(dict):
+    """Bounded FIFO mapping for callsite -> capacity-prediction caches
+    (join output caps, groupby segment caps): oldest entry evicted at
+    ``maxlen`` so varying input shapes cannot grow it without limit."""
+
+    def __init__(self, maxlen: int = 512):
+        super().__init__()
+        self.maxlen = maxlen
+
+    def put(self, key, value) -> None:
+        if len(self) >= self.maxlen:
+            self.pop(next(iter(self)))
+        self[key] = value
+
+
 def sample_positions(n, m: int, cap: int) -> jax.Array:
     """m evenly spaced in-range row positions over a live prefix of traced
     length ``n`` (float stride: arange(m)*n would overflow int32 under

@@ -41,7 +41,8 @@ from ..ops import pack
 from ..status import InvalidError
 from ..utils import timing
 from ..utils.host import host_array
-from .common import (PAD_L, PAD_R, REP, ROW, build_table, check_same_env,
+from .common import (PAD_L, PAD_R, REP, ROW, BoundedCache, build_table,
+                     check_same_env,
                      sample_positions,
                      col_arrays, live_mask, narrow32_flags, promote_key_pair)
 from .repart import shuffle_table
@@ -55,16 +56,8 @@ HOW = ("inner", "left", "right", "outer")
 #: before the (blocking) count pull, overlapping the host sync with device
 #: work; a mispredict (counts exceed the prediction) just re-dispatches at
 #: the correct bucket.  Steady-state loops (benchmarks, iterative pipelines)
-#: hit every time.  Bounded FIFO so varying input sizes can't grow it
-#: without limit.
-_CAP_CACHE: dict = {}
-_CAP_CACHE_MAX = 512
-
-
-def _cap_cache_put(key, value) -> None:
-    if len(_CAP_CACHE) >= _CAP_CACHE_MAX:
-        _CAP_CACHE.pop(next(iter(_CAP_CACHE)))
-    _CAP_CACHE[key] = value
+#: hit every time.
+_CAP_CACHE = BoundedCache()
 
 #: heavy-key detection: per-shard sample size and global-share threshold
 SKEW_SAMPLE = 4096
@@ -517,7 +510,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
             out_d, out_v = fn(*mat_args)
         counts = host_array(counts_dev).astype(np.int64)
         out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
-        _cap_cache_put(cache_key, out_cap)
+        _CAP_CACHE.put(cache_key, out_cap)
         if out_d is None or out_cap > predicted:
             fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
                                  tuple(plan), lspec, rspec, carry_emit,

@@ -248,9 +248,18 @@ class Series:
         return ~self.isna()
 
     def fillna(self, value) -> "Series":
+        # mask covers every invalid slot -> the result is fully valid
+        return self._fill_where(self.isna()._col.data, value,
+                                all_valid=True)
+
+    def _fill_where(self, mask, value, all_valid: bool = False) -> "Series":
+        """Replace positions where ``mask`` (bool data array) holds with
+        ``value``; the filled positions become valid.  Backs ``fillna``
+        (mask = isna, all_valid=True since every null gets filled) and
+        ``DataFrame.where`` (mask = ~cond)."""
         if self._col.type == LogicalType.STRING:
             if not isinstance(value, str):
-                raise CylonTypeError("fillna on string series needs str")
+                raise CylonTypeError("fill on string series needs str")
             d = self._col.dictionary
             pos = int(np.searchsorted(d, value))
             if not (pos < len(d) and d[pos] == value):
@@ -263,14 +272,15 @@ class Series:
             else:
                 col = self._col
             code = int(np.searchsorted(col.dictionary, value))
-            if col.validity is None:
-                return Series(self.name, col, self._env, self._valid)
-            data = jnp.where(col.validity, col.data, jnp.int32(code))
-            return self._wrap(data, None, LogicalType.STRING, col.dictionary)
-        na = self.isna()._col.data
-        data = jnp.where(na, np.asarray(value, self._col.data.dtype),
+            data = jnp.where(mask, jnp.int32(code), col.data)
+            v = None if (all_valid or col.validity is None) \
+                else (col.validity | mask)
+            return self._wrap(data, v, LogicalType.STRING, col.dictionary)
+        data = jnp.where(mask, np.asarray(value, self._col.data.dtype),
                          self._col.data)
-        return self._wrap(data, None, self._col.type)
+        v = None if (all_valid or self._col.validity is None) \
+            else (self._col.validity | mask)
+        return self._wrap(data, v, self._col.type)
 
     def astype(self, dtype) -> "Series":
         lt = from_numpy_dtype(np.dtype(dtype)) if not isinstance(

@@ -11,6 +11,8 @@ On TPU hardware the same script uses every visible chip (TPUConfig).
 import numpy as np
 import pandas as pd
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run without install
 import cylon_tpu as ct
 from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
 

@@ -7,6 +7,8 @@ Run: python examples/local_join.py
 import numpy as np
 import pandas as pd
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run without install
 from cylon_tpu import DataFrame
 
 df1 = DataFrame(pd.DataFrame({"key": [1, 2, 3, 4], "a": [10., 20., 30., 40.]}))

@@ -8,6 +8,8 @@ Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 import numpy as np
 import pandas as pd
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run without install
 import cylon_tpu as ct
 from cylon_tpu.ctx.context import CPUMeshConfig
 

@@ -17,6 +17,8 @@ import time
 
 import numpy as np
 
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run without install
 import cylon_tpu as ct
 from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
 
@@ -28,11 +30,14 @@ def main():
     ap.add_argument("-s", "--scaling", choices=["w", "s"], default="w")
     ap.add_argument("-i", "--iters", type=int, default=3)
     ap.add_argument("-u", "--unique", type=float, default=0.9)
+    ap.add_argument("-w", "--world", type=int, default=None,
+                    help="world size (CPU mesh only; default = all devices)")
     args = ap.parse_args()
 
     import jax
     on_accel = jax.devices()[0].platform != "cpu"
-    env = ct.CylonEnv(config=TPUConfig() if on_accel else CPUMeshConfig())
+    cfg = TPUConfig() if on_accel else CPUMeshConfig(world_size=args.world)
+    env = ct.CylonEnv(config=cfg)
     w = env.world_size
 
     if args.scaling == "w":

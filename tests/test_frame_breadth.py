@@ -139,3 +139,28 @@ def test_index_drop_false_survives_loc_iloc_arith(env, data):
     # row() hides a dropped index column
     r = d.row(0)
     assert "id" not in r.to_dict()
+
+
+def test_prefix_suffix_aliases_where_pydict(env1):
+    import pandas as pd
+    df = pd.DataFrame({"a": [1, 2, 3, 4], "b": [1.0, None, 3.0, 4.0]})
+    f = ct.DataFrame(df, env=env1)
+    assert f.add_prefix("x_").columns == ["x_a", "x_b"]
+    assert f.add_suffix("_y").columns == ["a_y", "b_y"]
+    # isnull/notnull aliases
+    assert f.isnull().to_pandas()["b"].tolist() == [False, True, False, False]
+    assert f.notnull().to_pandas()["a"].all()
+    # where with a bool Series: masked slots null (pandas parity)
+    cond = f["a"] > 2
+    w = f.where(cond).to_pandas()
+    exp = df.where(df["a"] > 2)
+    assert w["b"].isna().tolist() == exp["b"].isna().tolist()
+    # where with other: masked slots filled
+    w2 = f.where(cond, 0).to_pandas()
+    assert w2["a"].tolist() == [0, 0, 3, 4]
+    # to_pydict round trip
+    pd2 = f.to_pydict()
+    assert pd2["a"] == [1, 2, 3, 4]
+    # show/to_string smoke
+    assert "a" in f.to_string()
+    f.show(2)
