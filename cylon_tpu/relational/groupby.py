@@ -112,6 +112,21 @@ def _value_mask(mask, val, valid):
     return vmask
 
 
+def _plan_vspec(val_cols, by_cols, narrow):
+    """Sort-path eligibility: a LaneSpec over (value cols ++ key cols) when
+    every column lane-packs (no f64 data) and the lane budget is modest —
+    payload ~1.7 ns/row/lane vs ~12 ns/row per scatter-reduce; else None."""
+    from ..ops import lanes
+    cand = lanes.plan_lanes(
+        tuple(str(c.data.dtype) for c in val_cols + by_cols),
+        tuple(c.validity is not None for c in val_cols + by_cols),
+        narrow32_flags(val_cols) + narrow)
+    budget = 12
+    if all(c.lanes for c in cand.cols) and cand.n_lanes <= budget:
+        return cand
+    return None
+
+
 def _rep_keys(by_datas, by_valids, gids, seg_cap):
     """Representative key row per group (first source index)."""
     rep = gbk.group_first_index(gids, seg_cap)
@@ -121,22 +136,113 @@ def _rep_keys(by_datas, by_valids, gids, seg_cap):
     return key_out, kval_out
 
 
+def _sort_state(vc, by_datas, by_valids, val_datas, val_valids, narrow,
+                vspec):
+    """THE SORT PATH (non-grouped input): key operands + value/key u32
+    payload lanes through one ``lax.sort`` — the input becomes
+    run-contiguous, so downstream reductions use the grouped machinery.
+    Returns (gids, n_groups, mask, first, by_datas, by_valids, val_datas,
+    val_valids) with the column arrays replaced by their sorted versions.
+    Padding rows sort last (pad-key operand), so the live prefix is exactly
+    the first vc[rank] positions."""
+    from ..ops import lanes
+    cap = by_datas[0].shape[0]
+    my = jax.lax.axis_index(ROW_AXIS)
+    n_live = vc[my].astype(jnp.int32)
+    mask0 = live_mask(vc, cap)
+    ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask0,
+                           pad_key=PAD_L, narrow32=narrow)
+    vmat = lanes.pack_lanes(vspec, list(val_datas) + list(by_datas),
+                            list(val_valids) + list(by_valids))
+    nk = len(ko.ops)
+    sorted_all = jax.lax.sort(
+        ko.ops + tuple(vmat[:, j] for j in range(vspec.n_lanes)),
+        num_keys=nk, is_stable=False)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    mask = pos < n_live
+    first = (pack.neighbor_flags(sorted_all[:nk], ko.kinds)
+             .astype(bool) | (pos == 0)) & mask
+    gid = jnp.cumsum(first.astype(jnp.int32)).astype(jnp.int32) - 1
+    n_groups = (jnp.max(jnp.where(mask, gid, -1)) + 1).astype(jnp.int32)
+    gids = jnp.where(mask, gid, cap)
+    smat = jnp.stack(sorted_all[nk:], axis=1)
+    sdatas, svalids = lanes.unpack_lanes(vspec, smat)
+    nv = len(val_datas)
+    return (gids, n_groups, mask, first, tuple(sdatas[nv:]),
+            tuple(svalids[nv:]), tuple(sdatas[:nv]), tuple(svalids[:nv]))
+
+
+def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
+                 seg_cap, by_datas, by_valids, narrow, vnarrow):
+    """Per-op intermediate dicts + representative keys for run-contiguous
+    (grouped or freshly sorted) input: every cumsum-able intermediate AND
+    the min/max ops' counts ride grouped_reduce's single prefix-diff
+    gather; only the min/max extrema themselves need segment scatters.
+    Ops outside CUMSUMMABLE/min/max get no intermediate entry (callers'
+    non-associative branches compute their own)."""
+    my = jax.lax.axis_index(ROW_AXIS)
+    n_live = vc[my].astype(jnp.int32)
+    starts = gbk.grouped_starts(gids, first, mask, n_live, seg_cap)
+    batch = []      # (batched op name, spec index)
+    for i, op in enumerate(specs_ops):
+        if op in gbk.CUMSUMMABLE:
+            batch.append((op, i))
+        elif op in ("min", "max"):
+            batch.append(("count", i))
+    inters_b, key_out, kval_out = gbk.grouped_reduce(
+        [b[0] for b in batch], [val_datas[b[1]] for b in batch],
+        [vmasks[b[1]] for b in batch], starts, n_live,
+        list(by_datas), list(by_valids), seg_cap, key_narrow=narrow,
+        value_narrow=[(bool(vnarrow[b[1]]) if vnarrow else False)
+                      for b in batch])
+    inters: dict = {}
+    for (op, i), d in zip(batch, inters_b):
+        inters.setdefault(i, {}).update(d)
+    for i, op in enumerate(specs_ops):
+        if op == "min":
+            inters[i]["min"] = gbk.seg_min(val_datas[i], gids, seg_cap,
+                                           vmasks[i])
+        elif op == "max":
+            inters[i]["max"] = gbk.seg_max(val_datas[i], gids, seg_cap,
+                                           vmasks[i])
+    return inters, key_out, kval_out
+
+
 @lru_cache(maxsize=None)
 def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
-                narrow: tuple):
-    """Phase 1 per shard: dense-rank keys, segment-reduce each (col, op) into
-    intermediate arrays of static length seg_cap (rank-ordered dense prefix),
-    gather per-group key representatives."""
+                narrow: tuple, vspec=None):
+    """Phase 1 per shard: group keys, reduce each (col, op) into
+    intermediate arrays of static length seg_cap (rank-ordered dense
+    prefix), gather per-group key representatives.  With ``vspec`` the
+    value/key columns ride the rank sort (see :func:`_sort_state`) and the
+    intermediates come from the run-contiguous prefix-diff machinery
+    instead of per-op segment scatters.  Sum intermediates are never
+    narrowed here — phase 2 sums them AGAIN across shards, so the
+    single-shard rows·max|v| < 2^31 proof does not cover them."""
 
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
-        gids, n_groups, mask, _ = _group_keys(by_datas, by_valids, vc,
-                                              grouped, narrow)
-        key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
-        inter_out = []
-        for i, op in enumerate(ops):
-            vmask = _value_mask(mask, val_datas[i], val_valids[i])
-            inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap, vmask)
-            inter_out.append(tuple(inter[k] for k in INTER_NAMES[op]))
+        if vspec is not None and not grouped:
+            (gids, n_groups, mask, first, by_datas, by_valids, val_datas,
+             val_valids) = _sort_state(vc, by_datas, by_valids, val_datas,
+                                       val_valids, narrow, vspec)
+        else:
+            gids, n_groups, mask, first = _group_keys(by_datas, by_valids,
+                                                      vc, grouped, narrow)
+        vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
+                  for i in range(len(ops))]
+        if first is not None:
+            inters, key_out, kval_out = _runs_reduce(
+                ops, val_datas, vmasks, gids, first, mask, vc, seg_cap,
+                by_datas, by_valids, narrow, ())
+            inter_out = [tuple(inters[i][k] for k in INTER_NAMES[op])
+                         for i, op in enumerate(ops)]
+        else:
+            key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
+            inter_out = []
+            for i, op in enumerate(ops):
+                inter = gbk.combine_locally(op, val_datas[i], gids, seg_cap,
+                                            vmasks[i])
+                inter_out.append(tuple(inter[k] for k in INTER_NAMES[op]))
         return key_out, kval_out, tuple(inter_out), n_groups.reshape(1)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
@@ -179,71 +285,36 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
     narrow integer sum-prefix lanes.
 
     ``vspec`` (non-grouped inputs only): a :class:`~.lanes.LaneSpec` over
-    (value columns per spec ++ key columns) — the SORT PATH.  Instead of
-    dense-ranking keys (sort + gid scatter-back) and then scatter-reducing
-    every aggregation in source order (~12 ns/row per op, measured), the
-    value and key columns ride THE rank sort as u32 payload lanes
-    (~1.7 ns/row/lane) and the input becomes grouped — every cumsum-able
-    aggregation and the representative keys then come from the grouped
-    path's single prefix-diff gather.  The reference's pipeline groupby
-    (groupby/pipeline_groupby.cpp) is the moral analog: sort once, reduce
-    runs."""
-    from ..ops import lanes
+    (value columns per spec ++ key columns) — the SORT PATH
+    (:func:`_sort_state`).  Instead of dense-ranking keys (sort + gid
+    scatter-back) and then scatter-reducing every aggregation in source
+    order (~12 ns/row per op, measured), the value and key columns ride
+    THE rank sort as u32 payload lanes (~1.7 ns/row/lane) and the input
+    becomes grouped — every cumsum-able aggregation, the min/max counts
+    and the representative keys then come from the run machinery's single
+    prefix-diff gather (:func:`_runs_reduce`).  The reference's pipeline
+    groupby (groupby/pipeline_groupby.cpp) is the moral analog: sort once,
+    reduce runs."""
 
     def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
         if vspec is not None and not grouped:
-            # --- sort path: one sort carrying value+key lanes -------------
-            cap = by_datas[0].shape[0]
-            my = jax.lax.axis_index(ROW_AXIS)
-            n_live = vc[my].astype(jnp.int32)
-            mask0 = live_mask(vc, cap)
-            ko = pack.key_operands(list(by_datas), list(by_valids),
-                                   row_mask=mask0, pad_key=PAD_L,
-                                   narrow32=narrow)
-            vmat = lanes.pack_lanes(vspec,
-                                    list(val_datas) + list(by_datas),
-                                    list(val_valids) + list(by_valids))
-            nk = len(ko.ops)
-            sorted_all = jax.lax.sort(
-                ko.ops + tuple(vmat[:, j] for j in range(vspec.n_lanes)),
-                num_keys=nk, is_stable=False)
-            pos = jnp.arange(cap, dtype=jnp.int32)
-            mask = pos < n_live   # padding sorts last (pad-key operand)
-            first = (pack.neighbor_flags(sorted_all[:nk], ko.kinds)
-                     .astype(bool) | (pos == 0)) & mask
-            gid = jnp.cumsum(first.astype(jnp.int32)).astype(jnp.int32) - 1
-            n_groups = (jnp.max(jnp.where(mask, gid, -1)) + 1).astype(
-                jnp.int32)
-            gids = jnp.where(mask, gid, cap)
-            smat = jnp.stack(sorted_all[nk:], axis=1)
-            sdatas, svalids = lanes.unpack_lanes(vspec, smat)
-            nv = len(specs)
-            val_datas = tuple(sdatas[:nv])
-            val_valids = tuple(svalids[:nv])
-            by_datas = tuple(sdatas[nv:])
-            by_valids = tuple(svalids[nv:])
+            (gids, n_groups, mask, first, by_datas, by_valids, val_datas,
+             val_valids) = _sort_state(vc, by_datas, by_valids, val_datas,
+                                       val_valids, narrow, vspec)
         else:
             gids, n_groups, mask, first = _group_keys(
                 by_datas, by_valids, vc, grouped, narrow)
         vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
                   for i in range(len(specs))]
         # grouped/sorted fast path: ONE batched prefix-diff pass computes
-        # every cumsum-able aggregation AND the representative keys
+        # every cumsum-able aggregation, min/max counts AND the
+        # representative keys
         batched: dict[int, dict] = {}
-        if grouped or vspec is not None:
-            my = jax.lax.axis_index(ROW_AXIS)
-            n_live = vc[my].astype(jnp.int32)
-            starts = gbk.grouped_starts(gids, first, mask, n_live, seg_cap)
-            sel = [i for i, (op, _) in enumerate(specs)
-                   if op in gbk.CUMSUMMABLE]
-            inters, key_out, kval_out = gbk.grouped_reduce(
-                [specs[i][0] for i in sel], [val_datas[i] for i in sel],
-                [vmasks[i] for i in sel], starts, n_live,
-                list(by_datas), list(by_valids), seg_cap,
-                key_narrow=narrow,
-                value_narrow=[vnarrow[i] if vnarrow else False
-                              for i in sel])
-            batched = dict(zip(sel, inters))
+        if first is not None:
+            batched, key_out, kval_out = _runs_reduce(
+                tuple(op for op, _ in specs), val_datas, vmasks, gids,
+                first, mask, vc, seg_cap, by_datas, by_valids, narrow,
+                vnarrow)
         else:
             key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         res_d, res_v = [], []
@@ -355,15 +426,17 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     narrow = narrow32_flags(by_cols)
 
     if distributed and all_assoc and not grouped:
-        # phase 1: local pre-combine (reference groupby.cpp:76-81)
+        # phase 1: local pre-combine (reference groupby.cpp:76-81), riding
+        # the sort path when the columns lane-pack (see _raw_fn/vspec)
         by_datas, by_valids = col_arrays(by_cols)
         val_datas = tuple(c.data for c in val_cols)
         val_valids = tuple(c.validity for c in val_cols)
         vc = np.asarray(table.valid_counts, np.int32)
         ops_t = tuple(op for _, op, _, _ in specs)
         seg_cap = max(table.capacity, 1)
+        cspec = _plan_vspec(val_cols, by_cols, narrow)
         key_out, kval_out, inter_out, n_groups = _combine_fn(
-            env.mesh, ops_t, seg_cap, False, narrow)(
+            env.mesh, ops_t, seg_cap, False, narrow, cspec)(
                 vc, by_datas, by_valids, val_datas, val_valids)
         n_groups = host_array(n_groups).astype(np.int64)
         # intermediate table: keys + flat intermediate columns
@@ -422,15 +495,8 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     # modest (payload ~1.7 ns/row/lane vs ~12 ns/row per scatter-reduce)
     vspec = None
     if not grouped:
-        from ..ops import lanes as lanes_mod
-        vcols = [work.column(c) for c, _, _, _ in specs]
-        wb_cols = [work.column(n) for n in by]
-        cand = lanes_mod.plan_lanes(
-            tuple(str(c.data.dtype) for c in vcols + wb_cols),
-            tuple(c.validity is not None for c in vcols + wb_cols),
-            narrow32_flags(vcols) + narrow)
-        if all(c.lanes for c in cand.cols) and cand.n_lanes <= 12:
-            vspec = cand
+        vspec = _plan_vspec([work.column(c) for c, _, _, _ in specs],
+                            [work.column(n) for n in by], narrow)
     # segment-capacity hysteresis: every reduction/scatter/gather in _raw_fn
     # runs over seg_cap slots, but the true group count is usually far below
     # row capacity — dispatch at the previous call's observed bucket and

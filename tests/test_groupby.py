@@ -104,3 +104,38 @@ def test_single_group(env8, rng):
     got = groupby_aggregate(t, "k", [("v", "sum")]).to_pandas()
     exp = data.groupby("k", as_index=False).agg(v_sum=("v", "sum"))
     assert_frames_equal(got, exp, sort_by=["k"])
+
+
+def test_sortpath_laneable_dtypes(env4, rng):
+    """The groupby SORT PATH (value/key columns riding the rank sort as u32
+    lanes) requires laneable dtypes — f64 columns silently fall back, so
+    the general float tests never exercise it.  This pins it with
+    f32/int/string columns (eligibility asserted) against the pandas
+    oracle, covering the two-phase distributed pre-combine."""
+    import pandas as pd
+    from cylon_tpu.relational import groupby as rg
+    from cylon_tpu.relational.common import narrow32_flags
+
+    n = 3000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 150, n),
+        "s": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        "v": np.where(rng.random(n) < 0.15, np.nan,
+                      rng.random(n) * 100).astype(np.float32),
+        "w": rng.integers(-1000, 1000, n),
+    })
+    t = ct.Table.from_pandas(df, env4)
+    vcols = [t.column(c) for c in ("v", "w", "w", "v", "w")]
+    bcols = [t.column("k"), t.column("s")]
+    assert rg._plan_vspec(vcols, bcols, narrow32_flags(bcols)) is not None
+
+    g = groupby_aggregate(t, ["k", "s"], [("v", "mean"), ("w", "min"),
+                                          ("w", "max"), ("v", "std"),
+                                          ("w", "sum")])
+    exp = (df.groupby(["k", "s"], as_index=False)
+           .agg(v_mean=("v", "mean"), w_min=("w", "min"),
+                w_max=("w", "max"), v_std=("v", "std"), w_sum=("w", "sum")))
+    got = g.to_pandas().sort_values(["k", "s"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, exp.sort_values(["k", "s"]).reset_index(drop=True),
+        check_dtype=False, check_exact=False, rtol=1e-4)
