@@ -210,7 +210,7 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
 
 @lru_cache(maxsize=None)
 def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
-                narrow: tuple, vspec=None):
+                narrow: tuple, vspec=None, val_map: tuple = ()):
     """Phase 1 per shard: group keys, reduce each (col, op) into
     intermediate arrays of static length seg_cap (rank-ordered dense
     prefix), gather per-group key representatives.  With ``vspec`` the
@@ -220,14 +220,16 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
     narrowed here — phase 2 sums them AGAIN across shards, so the
     single-shard rows·max|v| < 2^31 proof does not cover them."""
 
-    def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
+    def per_shard(vc, by_datas, by_valids, uval_datas, uval_valids):
         if vspec is not None and not grouped:
-            (gids, n_groups, mask, first, by_datas, by_valids, val_datas,
-             val_valids) = _sort_state(vc, by_datas, by_valids, val_datas,
-                                       val_valids, narrow, vspec)
+            (gids, n_groups, mask, first, by_datas, by_valids, uval_datas,
+             uval_valids) = _sort_state(vc, by_datas, by_valids, uval_datas,
+                                        uval_valids, narrow, vspec)
         else:
             gids, n_groups, mask, first = _group_keys(by_datas, by_valids,
                                                       vc, grouped, narrow)
+        val_datas = tuple(uval_datas[j] for j in val_map)
+        val_valids = tuple(uval_valids[j] for j in val_map)
         vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
                   for i in range(len(ops))]
         if first is not None:
@@ -275,7 +277,8 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple):
 
 @lru_cache(maxsize=None)
 def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
-            narrow: tuple, vnarrow: tuple = (), vspec=None):
+            narrow: tuple, vnarrow: tuple = (), vspec=None,
+            val_map: tuple = ()):
     """Single-phase per shard over raw (already co-located) rows — used for
     non-associative ops, the local path, and the grouped-input fast path
     (join/sort output: no shuffle, no rank sort).  ``vnarrow``: host-proven
@@ -296,14 +299,18 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
     groupby (groupby/pipeline_groupby.cpp) is the moral analog: sort once,
     reduce runs."""
 
-    def per_shard(vc, by_datas, by_valids, val_datas, val_valids):
+    def per_shard(vc, by_datas, by_valids, uval_datas, uval_valids):
+        # uval_*: one array per DISTINCT value column; val_map expands to
+        # per-spec lists (repeated aggs over one column share lanes/sorts)
         if vspec is not None and not grouped:
-            (gids, n_groups, mask, first, by_datas, by_valids, val_datas,
-             val_valids) = _sort_state(vc, by_datas, by_valids, val_datas,
-                                       val_valids, narrow, vspec)
+            (gids, n_groups, mask, first, by_datas, by_valids, uval_datas,
+             uval_valids) = _sort_state(vc, by_datas, by_valids, uval_datas,
+                                        uval_valids, narrow, vspec)
         else:
             gids, n_groups, mask, first = _group_keys(
                 by_datas, by_valids, vc, grouped, narrow)
+        val_datas = tuple(uval_datas[j] for j in val_map)
+        val_valids = tuple(uval_valids[j] for j in val_map)
         vmasks = [_value_mask(mask, val_datas[i], val_valids[i])
                   for i in range(len(specs))]
         # grouped/sorted fast path: ONE batched prefix-diff pass computes
@@ -429,15 +436,18 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         # phase 1: local pre-combine (reference groupby.cpp:76-81), riding
         # the sort path when the columns lane-pack (see _raw_fn/vspec)
         by_datas, by_valids = col_arrays(by_cols)
-        val_datas = tuple(c.data for c in val_cols)
-        val_valids = tuple(c.validity for c in val_cols)
+        uniq_names = list(dict.fromkeys(c for c, _, _, _ in specs))
+        val_map = tuple(uniq_names.index(c) for c, _, _, _ in specs)
+        uval_cols = [table.column(c) for c in uniq_names]
+        uval_datas = tuple(c.data for c in uval_cols)
+        uval_valids = tuple(c.validity for c in uval_cols)
         vc = np.asarray(table.valid_counts, np.int32)
         ops_t = tuple(op for _, op, _, _ in specs)
         seg_cap = max(table.capacity, 1)
-        cspec = _plan_vspec(val_cols, by_cols, narrow)
+        cspec = _plan_vspec(uval_cols, by_cols, narrow)
         key_out, kval_out, inter_out, n_groups = _combine_fn(
-            env.mesh, ops_t, seg_cap, False, narrow, cspec)(
-                vc, by_datas, by_valids, val_datas, val_valids)
+            env.mesh, ops_t, seg_cap, False, narrow, cspec, val_map)(
+                vc, by_datas, by_valids, uval_datas, uval_valids)
         n_groups = host_array(n_groups).astype(np.int64)
         # intermediate table: keys + flat intermediate columns
         cols = {}
@@ -475,8 +485,11 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     if distributed and not grouped:
         work = shuffle_table(work, by)
     by_datas, by_valids = col_arrays([work.column(n) for n in by])
-    val_datas = tuple(work.column(c).data for c, _, _, _ in specs)
-    val_valids = tuple(work.column(c).validity for c, _, _, _ in specs)
+    uniq_names = list(dict.fromkeys(c for c, _, _, _ in specs))
+    val_map = tuple(uniq_names.index(c) for c, _, _, _ in specs)
+    uval_cols = [work.column(c) for c in uniq_names]
+    uval_datas = tuple(c.data for c in uval_cols)
+    uval_valids = tuple(c.validity for c in uval_cols)
     vc = np.asarray(work.valid_counts, np.int32)
     spec_t = tuple((op, q) for _, op, q, _ in specs)
     cap_full = max(work.capacity, 1)
@@ -495,8 +508,7 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     # modest (payload ~1.7 ns/row/lane vs ~12 ns/row per scatter-reduce)
     vspec = None
     if not grouped:
-        vspec = _plan_vspec([work.column(c) for c, _, _, _ in specs],
-                            [work.column(n) for n in by], narrow)
+        vspec = _plan_vspec(uval_cols, [work.column(n) for n in by], narrow)
     # segment-capacity hysteresis: every reduction/scatter/gather in _raw_fn
     # runs over seg_cap slots, but the true group count is usually far below
     # row capacity — dispatch at the previous call's observed bucket and
@@ -506,17 +518,17 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     seg_key = (id(env.mesh), spec_t, tuple(by), grouped, narrow, ddof,
                cap_full, int(work.valid_counts.sum()))
     pred = _SEG_CACHE.get(seg_key)
-    args = (vc, by_datas, by_valids, val_datas, val_valids)
+    args = (vc, by_datas, by_valids, uval_datas, uval_valids)
     with timing.region("groupby.raw"):
         seg_cap = pred if (pred is not None and pred < cap_full) else cap_full
         res = _raw_fn(env.mesh, spec_t, seg_cap, ddof, grouped, narrow,
-                      vnarrow, vspec)(*args)
+                      vnarrow, vspec, val_map)(*args)
         n_groups = host_array(res[4]).astype(np.int64)
         ng_cap = min(config.pow2ceil(int(n_groups.max()) if n_groups.size
                                      else 1), cap_full)
         if ng_cap > seg_cap:
             res = _raw_fn(env.mesh, spec_t, ng_cap, ddof, grouped, narrow,
-                          vnarrow, vspec)(*args)
+                          vnarrow, vspec, val_map)(*args)
         _SEG_CACHE.put(seg_key, ng_cap)
         key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
     out = _result_table(env, by, by_cols, key_out, kval_out, res_names, res_d,

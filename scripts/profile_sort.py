@@ -1,0 +1,44 @@
+"""Sort throughput spot check (local multi-key sort with lane carriage).
+Not part of the suite."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import cylon_tpu as ct
+from cylon_tpu.relational import sort_table
+
+_pull = jax.jit(lambda x: x.reshape(-1)[:2].astype(jnp.float32).sum())
+
+
+def sync(t):
+    np.asarray(_pull(next(iter(t.columns.values())).data))
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 64_000_000
+    rng = np.random.default_rng(0)
+    t = ct.Table.from_pydict(
+        {"k": rng.integers(0, rows, rows).astype(np.int64),
+         "a": rng.integers(0, rows, rows).astype(np.int64),
+         "b": rng.random(rows).astype(np.float32)})
+    sync(sort_table(t, "k"))  # compile
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(sort_table(t, "k"))
+        best = min(best, time.perf_counter() - t0)
+    print(f"sort_table {rows} rows, 3 cols: {best*1e3:.0f} ms "
+          f"= {rows/best/1e6:.1f}M rows/s")
+
+
+if __name__ == "__main__":
+    main()
