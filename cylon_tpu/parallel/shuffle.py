@@ -232,12 +232,18 @@ def exchange_block_cap(total: int, w: int) -> int:
 
 
 def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple):
-    """Run the (possibly multi-round) padded all-to-all for every column
-    array in ``cols``.
+    """Run the (possibly multi-round) padded all-to-all for every array in
+    ``cols`` (payload-agnostic: callers pre-pack laneable columns into one
+    (cap, L) u32 lane matrix — relational/repart._flatten_for_exchange —
+    so the per-round scatter/all_to_all/scatter chain runs once per ARRAY,
+    and a whole table is typically one matrix + f64 side arrays).
 
     Returns (new_cols tuple, new_valid_counts np (W,)).  Capacities are
     bucketed (config.pow2ceil) so the family of compiled programs stays
-    small; rounds bound peak send-buffer memory under skew.
+    small; rounds bound peak send-buffer memory under skew (note: the
+    caller's packed matrix is a full-shard copy that lives for the whole
+    exchange alongside the source table — the W·block bound applies to the
+    per-round send/recv buffers).
     """
     w = counts.shape[0]
     max_c = int(counts.max()) if counts.size else 1

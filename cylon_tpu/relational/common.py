@@ -69,21 +69,24 @@ def valid_flag(col: Column):
     return flag
 
 
+def fits_int32(c: Column) -> bool:
+    """Host-known: this 64-bit integer column's value bounds fit int32, so
+    any lane/operand packing may use one native 32-bit lane instead of a
+    (hi, lo) pair.  Non-64-bit columns return False (already native)."""
+    if c.data.dtype.itemsize != 8 or c.data.dtype.kind not in ("i", "u"):
+        return False
+    return c.bounds is not None and c.bounds[0] >= -(1 << 31) \
+        and c.bounds[1] <= (1 << 31) - 1
+
+
 def narrow32_flags(*col_lists) -> tuple:
     """Static per-key-column flags: True when every listed column's
-    host-known bounds (``Column.bounds``) fit int32, so sort-operand packing
-    may use one native operand instead of a (hi, lo) pair.  Pass the aligned
-    key columns of all tables that will be ranked together."""
-    lo32, hi32 = -(1 << 31), (1 << 31) - 1
-
-    def fits(c: Column) -> bool:
-        if c.data.dtype.itemsize != 8 or c.data.dtype.kind not in ("i", "u"):
-            return False  # non-64-bit never needs narrowing (already native)
-        return c.bounds is not None and c.bounds[0] >= lo32 \
-            and c.bounds[1] <= hi32
-
+    host-known bounds fit int32 (:func:`fits_int32`), so sort-operand
+    packing may use one native operand instead of a (hi, lo) pair.  Pass
+    the aligned key columns of all tables that will be ranked together."""
     n = len(col_lists[0])
-    return tuple(all(fits(cl[i]) for cl in col_lists) for i in range(n))
+    return tuple(all(fits_int32(cl[i]) for cl in col_lists)
+                 for i in range(n))
 
 
 def col_arrays(cols: list[Column]):

@@ -40,28 +40,86 @@ shard_map = jax.shard_map
 # column flattening for the exchange engine
 # ---------------------------------------------------------------------------
 
+@lru_cache(maxsize=None)
+def _pack_cols_fn(spec):
+    from ..ops import lanes
+
+    def fn(datas, valids):
+        return lanes.pack_lanes(spec, list(datas), list(valids))
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _unpack_cols_fn(spec):
+    from ..ops import lanes
+
+    def fn(mat):
+        datas, valids = lanes.unpack_lanes(spec, mat)
+        return (tuple(d for d in datas if d is not None),
+                tuple(v for v in valids if v is not None))
+
+    return jax.jit(fn)
+
+
 def _flatten_for_exchange(table: Table):
-    """Table columns -> flat tuple of device arrays (data then validity for
-    nullable cols) + a rebuild recipe."""
-    flat, recipe = [], []
-    for name, c in table.columns.items():
-        di = len(flat)
-        flat.append(c.data)
-        vi = -1
-        if c.validity is not None:
-            vi = len(flat)
-            flat.append(c.validity)
-        recipe.append((name, di, vi, c.type, c.dictionary, c.bounds))
+    """Table columns -> the exchange/collective payload tuple + a rebuild
+    recipe.
+
+    Every laneable column (data AND bit-packed validity — 32 nullable
+    columns per u32 lane) packs into ONE (cap, L) u32 lane matrix via
+    :mod:`cylon_tpu.ops.lanes`, so whatever moves the payload (all_to_all
+    rounds, allgather, bcast) issues one collective/scatter chain per
+    ROUND, not per column; host-known ``Column.bounds``
+    (:func:`~.common.fits_int32`) narrow int64 columns to one lane.  f64
+    columns (not laneable on TPU) travel as side arrays.  The matrix is a
+    full-shard copy that lives until the move completes — the exchange's
+    W·block memory bound applies to its per-round buffers, not to this
+    staging copy."""
+    from ..ops import lanes
+    from .common import fits_int32
+    items = list(table.columns.items())
+    cols = [c for _, c in items]
+    spec = lanes.plan_lanes(tuple(str(c.data.dtype) for c in cols),
+                            tuple(c.validity is not None for c in cols),
+                            tuple(fits_int32(c) for c in cols))
+    flat = []
+    if spec.n_lanes:
+        flat.append(_pack_cols_fn(spec)(tuple(c.data for c in cols),
+                                        tuple(c.validity for c in cols)))
+    for c, cl in zip(cols, spec.cols):
+        if not cl.lanes:
+            flat.append(c.data)
+    recipe = (spec, tuple((name, c.type, c.dictionary, c.bounds)
+                          for name, c in items))
     return tuple(flat), recipe
 
 
 def _rebuild(recipe, new_flat, valid_counts, env: CylonEnv) -> Table:
+    spec, metas = recipe
+    if spec.n_lanes:
+        datas, valids = _unpack_cols_fn(spec)(new_flat[0])
+        side = list(new_flat[1:])
+    else:
+        datas, valids = (), ()
+        side = list(new_flat)
+    datas, valids = list(datas), list(valids)
     cols = {}
-    for name, di, vi, t, dc, b in recipe:
-        v = new_flat[vi] if vi >= 0 else None
+    di = vi = si = 0
+    for (name, t, dc, b), cl in zip(metas, spec.cols):
+        if cl.lanes:
+            d = datas[di]
+            di += 1
+        else:
+            d = side[si]
+            si += 1
+        v = None
+        if cl.valid_bit >= 0:
+            v = valids[vi]
+            vi += 1
         # exchanged rows are a permutation + zero padding of the input values
         nb = (min(b[0], 0), max(b[1], 0)) if b is not None else None
-        cols[name] = Column(new_flat[di], t, v, dc, bounds=nb)
+        cols[name] = Column(d, t, v, dc, bounds=nb)
     return Table(cols, env, np.asarray(valid_counts, np.int64))
 
 
