@@ -10,7 +10,8 @@ import pytest
 import cylon_tpu as ct
 from cylon_tpu import config
 from cylon_tpu.exec import chunk_table, pipelined_join
-from cylon_tpu.relational import concat_tables, join_tables
+from cylon_tpu.relational import (concat_tables, groupby_aggregate,
+                                  join_tables)
 
 from utils import assert_table_matches
 
@@ -63,3 +64,26 @@ def test_chunked_capacity_bounded(env8, rng):
     assert max(c.capacity for c in chunks) <= -(-lt.capacity // 8)
     out = pipelined_join(lt, rt, "k", "k", n_chunks=8)
     assert out.row_count == mono.row_count
+
+
+def test_pipelined_groupby_sink_combines(env4, rng):
+    """Streaming aggregation: per-chunk groupby sink + one partial combine
+    equals the monolithic join+groupby (the out-of-HBM recipe that
+    scripts/bench_pipelined.py runs at 96M rows/chip)."""
+    n = 4000
+    ldf = pd.DataFrame({"k": rng.integers(0, 300, n),
+                        "a": rng.integers(0, 50, n)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 300, n // 2),
+                        "b": rng.integers(0, 50, n // 2)})
+    lt = ct.Table.from_pandas(ldf, env4)
+    rt = ct.Table.from_pandas(rdf, env4)
+    parts = pipelined_join(
+        lt, rt, "k", "k", n_chunks=3,
+        sink=lambda c: groupby_aggregate(c, "k", [("a", "sum"),
+                                                  ("b", "sum")]))
+    partial = concat_tables(parts)
+    got = groupby_aggregate(partial, "k", [("a_sum", "sum"),
+                                           ("b_sum", "sum")])
+    exp = (ldf.merge(rdf, on="k").groupby("k", as_index=False)
+           .agg(a_sum_sum=("a", "sum"), b_sum_sum=("b", "sum")))
+    assert_table_matches(got, exp)
