@@ -42,17 +42,10 @@ import numpy as np  # noqa: E402
 BASELINE_ROWS_PER_SEC_PER_WORKER = 1_000_000 / 0.60
 
 
-_sync_fn = None
-
-
 def _sync(arr):
-    """Force execution and wait (block_until_ready is unreliable over the
-    axon tunnel — a tiny host pull is the only real barrier)."""
-    global _sync_fn
-    import jax.numpy as jnp
-    if _sync_fn is None:
-        _sync_fn = jax.jit(lambda x: jnp.sum(x[:4].astype(jnp.float32)))
-    np.asarray(_sync_fn(arr))
+    """Force execution and wait (see cylon_tpu.utils.host.sync_pull)."""
+    from cylon_tpu.utils.host import sync_pull
+    sync_pull(arr)
 
 
 def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:

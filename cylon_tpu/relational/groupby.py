@@ -92,7 +92,8 @@ def _group_keys(by_datas, by_valids, vc, grouped: bool = False,
     mask = live_mask(vc, cap)
     if grouped:
         gids, n_groups, first = pack.grouped_gids(list(by_datas),
-                                                  list(by_valids), mask)
+                                                  list(by_valids), mask,
+                                                  narrow)
         return gids, n_groups, mask, first
     ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask,
                            pad_key=PAD_L, narrow32=narrow)

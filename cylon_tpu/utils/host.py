@@ -25,3 +25,22 @@ def host_array(x) -> np.ndarray:
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(x, tiled=True))
     return np.asarray(x)
+
+
+_pull_fn = None
+
+
+def sync_pull(arr) -> None:
+    """Force execution of everything feeding ``arr`` and wait.
+
+    ``jax.block_until_ready`` is unreliable over the axon tunnel — a tiny
+    jitted reduction pulled to the host is the only real barrier.  Shared by
+    the bench drivers (bench.py, scripts/*) so the barrier technique lives
+    in one place."""
+    global _pull_fn
+    import jax
+    import jax.numpy as jnp
+    if _pull_fn is None:
+        _pull_fn = jax.jit(
+            lambda x: x.reshape(-1)[:4].astype(jnp.float32).sum())
+    np.asarray(_pull_fn(arr))
