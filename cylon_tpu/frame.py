@@ -635,13 +635,23 @@ class GroupByDataFrame:
         ren = {k: v for k, v in ren.items() if k in out.columns}
         return DataFrame(_table=out._table.rename(ren))
 
-    def agg(self, spec: Mapping[str, Any]) -> DataFrame:
-        """pandas .agg({'col': 'sum'|['sum','mean']}) spelling."""
+    def agg(self, spec) -> DataFrame:
+        """pandas .agg spellings: a single op name ('sum'), a list of op
+        names applied to every value column, {'col': 'sum'|['sum','mean']},
+        or an explicit [(col, op), ...] list (ops may repeat across
+        columns)."""
+        if isinstance(spec, str):
+            return self._all(spec)
         aggs = []
-        for col, ops in spec.items():
-            ops = [ops] if isinstance(ops, str) else list(ops)
-            for op in ops:
-                aggs.append((col, op))
+        if isinstance(spec, Mapping):
+            for col, ops in spec.items():
+                ops = [ops] if isinstance(ops, str) else list(ops)
+                for op in ops:
+                    aggs.append((col, op))
+        elif spec and all(isinstance(a, str) for a in spec):
+            aggs = [(c, op) for c in self._value_cols for op in spec]
+        else:
+            aggs = [tuple(a) for a in spec]
         return self._run(aggs)
 
 

@@ -1,4 +1,4 @@
-"""TPC-H subset: data generator + Q3/Q5 on the DataFrame API.
+"""TPC-H subset: data generator + Q1/Q3/Q5/Q6 on the DataFrame API.
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -70,14 +70,25 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
     })
     l_orderkey = np.repeat(orders["o_orderkey"].to_numpy(), lines_per_order)
     ship_delay = rng.integers(1, 122, n_line) * day
+    shipdate = (np.repeat(orders["o_orderdate"].to_numpy(),
+                          lines_per_order).astype(np.int64)
+                + ship_delay).astype("datetime64[ns]")
+    # returnflag/linestatus per the spec's date rules: lines shipped after
+    # the dataset's currentdate-ish cutoff are still Open/None, earlier
+    # lines are Fulfilled and split A/R
+    cutoff = np.datetime64("1995-06-17")
+    open_line = shipdate > cutoff
+    ar = rng.integers(0, 2, n_line)
     lineitem = pd.DataFrame({
         "l_orderkey": l_orderkey.astype(np.int64),
         "l_suppkey": rng.integers(0, n_supp, n_line).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_line).astype(np.int64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_line), 2),
         "l_discount": np.round(rng.integers(0, 11, n_line) * 0.01, 2),
-        "l_shipdate": (np.repeat(orders["o_orderdate"].to_numpy(),
-                                 lines_per_order).astype(np.int64)
-                       + ship_delay).astype("datetime64[ns]"),
+        "l_tax": np.round(rng.integers(0, 9, n_line) * 0.01, 2),
+        "l_returnflag": np.where(open_line, "N", np.where(ar == 0, "A", "R")),
+        "l_linestatus": np.where(open_line, "O", "F"),
+        "l_shipdate": shipdate,
     })
     supplier = pd.DataFrame({
         "s_suppkey": np.arange(n_supp, dtype=np.int64),
@@ -101,6 +112,80 @@ def generate_tables(scale: float = 0.01, env=None, seed: int = 0) -> dict:
     from .frame import DataFrame
     pdfs = generate_pandas(scale, seed)
     return {k: DataFrame(v, env=env) for k, v in pdfs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Q1 — pricing summary report
+# ---------------------------------------------------------------------------
+
+def q1(dfs: dict, env=None, date: str = "1998-09-02"):
+    """SELECT l_returnflag, l_linestatus, sum(l_quantity), sum(price),
+    sum(price*(1-disc)), sum(price*(1-disc)*(1+tax)), avg(qty), avg(price),
+    avg(disc), count(*) FROM lineitem WHERE l_shipdate <= :date GROUP BY
+    l_returnflag, l_linestatus ORDER BY l_returnflag, l_linestatus."""
+    line = dfs["lineitem"]
+    l = line[line["l_shipdate"] <= _ts(date)]
+    l["disc_price"] = l["l_extendedprice"] * (1.0 - l["l_discount"])
+    l["charge"] = l["disc_price"] * (1.0 + l["l_tax"])
+    g = (l.groupby(["l_returnflag", "l_linestatus"], env=env)
+         .agg([("l_quantity", "sum"), ("l_extendedprice", "sum"),
+               ("disc_price", "sum"), ("charge", "sum"),
+               ("l_quantity", "mean"), ("l_extendedprice", "mean"),
+               ("l_discount", "mean"), ("l_orderkey", "count")]))
+    return g.sort_values(["l_returnflag", "l_linestatus"], env=env)
+
+
+def q1_pandas(pdfs: dict, date: str = "1998-09-02") -> pd.DataFrame:
+    l = pdfs["lineitem"]
+    l = l[l.l_shipdate <= pd.Timestamp(date)].copy()
+    l["disc_price"] = l.l_extendedprice * (1.0 - l.l_discount)
+    l["charge"] = l.disc_price * (1.0 + l.l_tax)
+    g = (l.groupby(["l_returnflag", "l_linestatus"], as_index=False)
+         .agg(l_quantity_sum=("l_quantity", "sum"),
+              l_extendedprice_sum=("l_extendedprice", "sum"),
+              disc_price_sum=("disc_price", "sum"),
+              charge_sum=("charge", "sum"),
+              l_quantity_mean=("l_quantity", "mean"),
+              l_extendedprice_mean=("l_extendedprice", "mean"),
+              l_discount_mean=("l_discount", "mean"),
+              l_orderkey_count=("l_orderkey", "count")))
+    return g.sort_values(["l_returnflag", "l_linestatus"]) \
+        .reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q6 — revenue-change forecast
+# ---------------------------------------------------------------------------
+
+def q6(dfs: dict, env=None, date_lo: str = "1994-01-01",
+       date_hi: str = "1995-01-01", discount: float = 0.06,
+       quantity: int = 24):
+    """SELECT sum(l_extendedprice*l_discount) AS revenue FROM lineitem
+    WHERE l_shipdate >= :lo AND l_shipdate < :hi AND l_discount BETWEEN
+    :d - 0.01 AND :d + 0.01 AND l_quantity < :q (the filter widens the
+    BETWEEN bounds by 0.001 — float tolerance for the 0.01-grid discount
+    values, matching the oracle)."""
+    l = dfs["lineitem"]
+    sel = ((l["l_shipdate"] >= _ts(date_lo)) & (l["l_shipdate"] < _ts(date_hi))
+           & (l["l_discount"] >= discount - 0.011)
+           & (l["l_discount"] <= discount + 0.011)
+           & (l["l_quantity"] < quantity))
+    f = l[sel]
+    rev = f["l_extendedprice"] * f["l_discount"]
+    return float(rev.sum())
+
+
+def q6_pandas(pdfs: dict, date_lo: str = "1994-01-01",
+              date_hi: str = "1995-01-01", discount: float = 0.06,
+              quantity: int = 24) -> float:
+    l = pdfs["lineitem"]
+    sel = ((l.l_shipdate >= pd.Timestamp(date_lo))
+           & (l.l_shipdate < pd.Timestamp(date_hi))
+           & (l.l_discount >= discount - 0.011)
+           & (l.l_discount <= discount + 0.011)
+           & (l.l_quantity < quantity))
+    f = l[sel]
+    return float((f.l_extendedprice * f.l_discount).sum())
 
 
 # ---------------------------------------------------------------------------
@@ -227,7 +312,8 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
     def run_query(fn):
         def step():
             out = fn(dfs, env=env)
-            out.to_pandas()  # materialize to host = full completion barrier
+            if hasattr(out, "to_pandas"):
+                out.to_pandas()  # materialize to host = completion barrier
             return out
         step()  # warmup/compile
         ts = []
@@ -237,14 +323,17 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
+    t1 = run_query(q1)
     t3 = run_query(q3)
     t5 = run_query(q5)
+    t6 = run_query(q6)
     return {
-        "metric": f"TPC-H SF{scale:g} Q3+Q5 wall time",
-        "value": round(t3 + t5, 4),
+        "metric": f"TPC-H SF{scale:g} Q1+Q3+Q5+Q6 wall time",
+        "value": round(t1 + t3 + t5 + t6, 4),
         "unit": "seconds",
         "vs_baseline": 0.0,
         "detail": {"world": env.world_size, "platform": devs[0].platform,
-                   "scale": scale, "q3_s": round(t3, 4),
-                   "q5_s": round(t5, 4)},
+                   "scale": scale, "q1_s": round(t1, 4),
+                   "q3_s": round(t3, 4), "q5_s": round(t5, 4),
+                   "q6_s": round(t6, 4)},
     }

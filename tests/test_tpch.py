@@ -48,3 +48,22 @@ def test_generator_cardinalities():
     od = pdfs["orders"].set_index("o_orderkey").o_orderdate
     assert (li.l_shipdate.to_numpy()
             > od.loc[li.l_orderkey].to_numpy()).all()
+
+
+def test_q1_matches_pandas(env):
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.002, seed=3)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q1(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q1_pandas(pdfs)
+    pd.testing.assert_frame_equal(got, exp[got.columns], check_dtype=False,
+                                  check_exact=False, rtol=1e-6)
+
+
+def test_q6_matches_pandas(env):
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.002, seed=4)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q6(dfs, env=env)
+    exp = tpch.q6_pandas(pdfs)
+    assert abs(got - exp) <= 1e-6 * max(abs(exp), 1.0), (got, exp)
