@@ -89,6 +89,16 @@ def narrow32_flags(*col_lists) -> tuple:
                  for i in range(n))
 
 
+def table_lane_spec(cols: list[Column]):
+    """LaneSpec over a table's full column list (bounds-narrowed) — the
+    static half of moving whole rows with ONE lane-matrix gather
+    (ops/lanes.gather_columns) instead of one gather per column."""
+    from ..ops import lanes
+    return lanes.plan_lanes(tuple(str(c.data.dtype) for c in cols),
+                            tuple(c.validity is not None for c in cols),
+                            narrow32_flags(cols))
+
+
 def col_arrays(cols: list[Column]):
     """Split columns into parallel (datas, valids) tuples; valids entries may
     be None (all-valid) — None is an empty pytree so it passes through jit."""

@@ -76,13 +76,10 @@ def _flatten_for_exchange(table: Table):
     full-shard copy that lives until the move completes — the exchange's
     W·block memory bound applies to its per-round buffers, not to this
     staging copy."""
-    from ..ops import lanes
-    from .common import fits_int32
+    from .common import table_lane_spec
     items = list(table.columns.items())
     cols = [c for _, c in items]
-    spec = lanes.plan_lanes(tuple(str(c.data.dtype) for c in cols),
-                            tuple(c.validity is not None for c in cols),
-                            tuple(fits_int32(c) for c in cols))
+    spec = table_lane_spec(cols)
     flat = []
     if spec.n_lanes:
         flat.append(_pack_cols_fn(spec)(tuple(c.data for c in cols),
@@ -255,17 +252,17 @@ def repad_table(table: Table, new_cap: int) -> Table:
 # ---------------------------------------------------------------------------
 
 @lru_cache(maxsize=None)
-def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, ncols: int):
+def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, spec):
+    from ..ops import lanes
+
     def per_shard(vc, offs, lo, hi, datas, valids):
         my = jax.lax.axis_index(shuffle.ROW_AXIS)
         mask = jnp.arange(cap) < vc[my]
         gpos = offs[my] + jnp.arange(cap, dtype=jnp.int64)
         keep = mask & (gpos >= lo) & (gpos < hi)
         idx, _total = sortk.compact_by_flag(keep, out_cap)
-        safe = jnp.clip(idx, 0, max(cap - 1, 0))
-        out_d = tuple(d[safe] for d in datas)
-        out_v = tuple(v[safe] if v is not None else None for v in valids)
-        return out_d, out_v
+        # ONE lane-matrix gather for all columns (+ f64 side gathers)
+        return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
     return jax.jit(shard_map(
         per_shard, mesh=mesh,
@@ -284,7 +281,9 @@ def slice_table(table: Table, offset: int, length: int) -> Table:
     cols = list(table.columns.items())
     datas = tuple(c.data for _, c in cols)
     valids = tuple(c.validity for _, c in cols)
-    fn = _compact_range_fn(env.mesh, table.capacity, out_cap, len(cols))
+    from .common import table_lane_spec
+    fn = _compact_range_fn(env.mesh, table.capacity, out_cap,
+                           table_lane_spec([c for _, c in cols]))
     out_d, out_v = fn(np.asarray(vc, np.int32), offs,
                       np.int64(lo), np.int64(hi), datas, valids)
     names = [n for n, _ in cols]
@@ -318,14 +317,14 @@ def _filter_count_fn(mesh: Mesh, cap: int):
 
 
 @lru_cache(maxsize=None)
-def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int):
+def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int, spec):
+    from ..ops import lanes
+
     def per_shard(vc, flag, datas, valids):
         mask = live_mask(vc, cap)
         idx, _ = sortk.compact_by_flag(flag & mask, out_cap)
-        safe = jnp.clip(idx, 0, max(cap - 1, 0))
-        out_d = tuple(d[safe] for d in datas)
-        out_v = tuple(v[safe] if v is not None else None for v in valids)
-        return out_d, out_v
+        # ONE lane-matrix gather for all columns (+ f64 side gathers)
+        return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW),
@@ -346,8 +345,10 @@ def filter_table(table: Table, flag) -> Table:
     items = list(table.columns.items())
     datas = tuple(c.data for _, c in items)
     valids = tuple(c.validity for _, c in items)
-    out_d, out_v = _filter_mat_fn(env.mesh, cap, out_cap)(vc, flag, datas,
-                                                          valids)
+    from .common import table_lane_spec
+    spec = table_lane_spec([c for _, c in items])
+    out_d, out_v = _filter_mat_fn(env.mesh, cap, out_cap, spec)(vc, flag,
+                                                                datas, valids)
     return rebuild_like(items, out_d, out_v, counts, env)
 
 

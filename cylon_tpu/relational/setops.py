@@ -61,15 +61,14 @@ def _unique_count_fn(mesh: Mesh, keep: str):
 
 
 @lru_cache(maxsize=None)
-def _unique_mat_fn(mesh: Mesh, keep: str, out_cap: int):
+def _unique_mat_fn(mesh: Mesh, keep: str, out_cap: int, spec):
+    from ..ops import lanes
+
     def per_shard(vc, key_datas, key_valids, datas, valids):
         flags, _ = _unique_flags_per_shard(vc, key_datas, key_valids, keep)
         idx, _total = sortk.compact_by_flag(flags, out_cap)
-        cap = key_datas[0].shape[0]
-        safe = jnp.clip(idx, 0, max(cap - 1, 0))
-        out_d = tuple(d[safe] for d in datas)
-        out_v = tuple(v[safe] if v is not None else None for v in valids)
-        return out_d, out_v
+        # ONE lane-matrix gather for all columns (+ f64 side gathers)
+        return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW, ROW),
@@ -95,7 +94,9 @@ def unique_table(table: Table, subset=None, keep: str = "first") -> Table:
     items = list(table.columns.items())
     datas = tuple(c.data for _, c in items)
     valids = tuple(c.validity for _, c in items)
-    out_d, out_v = _unique_mat_fn(env.mesh, keep, out_cap)(
+    from .common import table_lane_spec
+    out_d, out_v = _unique_mat_fn(env.mesh, keep, out_cap,
+                                  table_lane_spec([c for _, c in items]))(
         vc, key_datas, key_valids, datas, valids)
     return rebuild_like(items, out_d, out_v, counts, env)
 

@@ -201,16 +201,12 @@ def sort_table(table: Table, by, ascending=True,
         vc = np.asarray(table.valid_counts, np.int32)
 
     # ---- local sort per shard -------------------------------------------
-    from ..ops import lanes
     items = list(table.columns.items())
     datas = tuple(c.data for _, c in items)
     valids = tuple(c.validity for _, c in items)
-    all_cols = [c for _, c in items]
+    from .common import table_lane_spec
     narrow = narrow32_flags(by_cols)
-    vspec = lanes.plan_lanes(
-        tuple(str(c.data.dtype) for c in all_cols),
-        tuple(c.validity is not None for c in all_cols),
-        narrow32_flags(all_cols))
+    vspec = table_lane_spec([c for _, c in items])
     f64_idx = tuple(i for i, c in enumerate(vspec.cols) if not c.lanes)
     out_d, out_v = _local_sort_fn(env.mesh, descendings, npos, narrow,
                                   vspec, f64_idx)(
