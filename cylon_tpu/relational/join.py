@@ -455,14 +455,9 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         bounds.append(col.bounds)
 
     # host-known bounds narrow 64-bit lanes to one u32 lane each
-    lspec = lanes.plan_lanes(
-        tuple(str(c.data.dtype) for c in l_cols_list),
-        tuple(c.validity is not None for c in l_cols_list),
-        narrow32_flags(l_cols_list))
-    rspec = lanes.plan_lanes(
-        tuple(str(c.data.dtype) for c in r_cols_list),
-        tuple(c.validity is not None for c in r_cols_list),
-        narrow32_flags(r_cols_list))
+    from .common import table_lane_spec
+    lspec = table_lane_spec(l_cols_list)
+    rspec = table_lane_spec(r_cols_list)
 
     # ride a side's lane matrix through the phase-1 sort when every one of
     # its output columns is laneable (no f64 side channels) and the lane

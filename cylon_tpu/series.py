@@ -75,6 +75,22 @@ class Series:
     def __len__(self) -> int:
         return int(self._valid.sum())
 
+    # reference series.py properties: id/data/shape
+    @property
+    def id(self) -> str:
+        return self.name
+
+    @property
+    def data(self) -> np.ndarray:
+        """Materialized values (valid prefixes compacted across shards,
+        string codes decoded) — NOT the raw padded device buffer, which
+        holds per-shard padding garbage (use .column.data for that)."""
+        return self.to_numpy()
+
+    @property
+    def shape(self) -> tuple:
+        return (len(self),)
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"Series({self.name!r}, {self.dtype.value}, len={len(self)})"
 
