@@ -116,7 +116,12 @@ def _value_mask(mask, val, valid):
 def _plan_vspec(val_cols, by_cols, narrow):
     """Sort-path eligibility: a LaneSpec over (value cols ++ key cols) when
     every column lane-packs (no f64 data) and the lane budget is modest —
-    payload ~1.7 ns/row/lane vs ~12 ns/row per scatter-reduce; else None."""
+    payload ~1.7 ns/row/lane vs ~12 ns/row per scatter-reduce; else None.
+
+    f64 columns DISQUALIFY the sort path: riding them as raw f64 payload
+    operands is correct on CPU but SIGSEGVs the XLA:TPU compiler (measured
+    on v5e libtpu, 2026-07; lax.sort with f64 payload operands under x64).
+    f64 workloads take the dense-rank + segment-scatter fallback."""
     from ..ops import lanes
     cand = lanes.plan_lanes(
         tuple(str(c.data.dtype) for c in val_cols + by_cols),
@@ -153,6 +158,8 @@ def _sort_state(vc, by_datas, by_valids, val_datas, val_valids, narrow,
     mask0 = live_mask(vc, cap)
     ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask0,
                            pad_key=PAD_L, narrow32=narrow)
+    # every column lane-packs (_plan_vspec gates out f64: raw f64 sort
+    # payloads SIGSEGV the XLA:TPU compiler)
     vmat = lanes.pack_lanes(vspec, list(val_datas) + list(by_datas),
                             list(val_valids) + list(by_valids))
     nk = len(ko.ops)
@@ -168,6 +175,7 @@ def _sort_state(vc, by_datas, by_valids, val_datas, val_valids, narrow,
     gids = jnp.where(mask, gid, cap)
     smat = jnp.stack(sorted_all[nk:], axis=1)
     sdatas, svalids = lanes.unpack_lanes(vspec, smat)
+    sdatas = list(sdatas)
     nv = len(val_datas)
     return (gids, n_groups, mask, first, tuple(sdatas[nv:]),
             tuple(svalids[nv:]), tuple(sdatas[:nv]), tuple(svalids[:nv]))

@@ -139,3 +139,25 @@ def test_sortpath_laneable_dtypes(env4, rng):
     pd.testing.assert_frame_equal(
         got, exp.sort_values(["k", "s"]).reset_index(drop=True),
         check_dtype=False, check_exact=False, rtol=1e-4)
+
+
+def test_sortpath_f64_payload_riding(env4, rng):
+    """f64 value/key columns DISQUALIFY the sort path (raw f64 sort
+    payloads SIGSEGV the XLA:TPU compiler — see _plan_vspec) and must take
+    the dense-rank fallback; mixed f64+laneable shapes must match pandas
+    either way."""
+    import pandas as pd
+    n = 3000
+    df = pd.DataFrame({"k": rng.integers(0, 150, n).astype(np.float64),
+                       "v": rng.random(n),
+                       "w": rng.integers(0, 100, n)})
+    t = ct.Table.from_pandas(df, env4)
+    g = groupby_aggregate(t, "k", [("v", "sum"), ("w", "mean"),
+                                   ("v", "max"), ("w", "min")])
+    exp = (df.groupby("k", as_index=False)
+           .agg(v_sum=("v", "sum"), w_mean=("w", "mean"),
+                v_max=("v", "max"), w_min=("w", "min")))
+    got = g.to_pandas().sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False, check_exact=False)
