@@ -32,7 +32,7 @@ import time
 if "--cpu-mesh" in sys.argv:
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # hard set: axon presets the var
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -48,7 +48,8 @@ def _sync(arr):
     sync_pull(arr)
 
 
-def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:
+def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
+        skew: float = 0.0) -> dict:
     import cylon_tpu as ct
     from cylon_tpu import config
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
@@ -64,9 +65,17 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:
     n = rows_per_chip * w
     max_val = max(int(n * unique), 1)
     rng = np.random.default_rng(42)
+    lk = rng.integers(0, max_val, n).astype(np.int64)
+    if skew > 0.0:
+        # BASELINE.json config 5 (skewed-key join): a ``skew`` fraction of
+        # probe rows share ONE hot key (tests/test_skew.py convention) —
+        # exercises the heavy-hitter split path (probe hot keys spread
+        # round-robin, build hot rows duplicate-broadcast).  The build side
+        # stays uniform so the join output stays ~O(n).
+        hot = np.int64(max_val // 2)
+        lk = np.where(rng.random(n) < skew, hot, lk)
     lt = ct.Table.from_pydict(
-        {"k": rng.integers(0, max_val, n).astype(np.int64),
-         "a": rng.integers(0, max_val, n).astype(np.int64)}, env)
+        {"k": lk, "a": rng.integers(0, max_val, n).astype(np.int64)}, env)
     rt = ct.Table.from_pydict(
         {"k": rng.integers(0, max_val, n).astype(np.int64),
          "b": rng.integers(0, max_val, n).astype(np.int64)}, env)
@@ -92,7 +101,8 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:
     best = min(times)
     rows_per_sec_per_chip = (2 * n) / best / w
     return {
-        "metric": "dist join+groupby throughput (int64 keys)",
+        "metric": ("dist join+groupby throughput (int64 keys"
+                   + (f", skew={skew:g}" if skew else "") + ")"),
         "value": round(rows_per_sec_per_chip, 1),
         "unit": "rows/sec/chip",
         "vs_baseline": round(rows_per_sec_per_chip
@@ -102,6 +112,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4) -> dict:
             "platform": devs[0].platform,
             "rows_per_chip": rows_per_chip,
             "unique": unique,
+            "skew": skew,
             "best_iter_s": round(best, 4),
             "all_iters_s": [round(t, 4) for t in times],
             "phases_s": {k: v["s"] for k, v in timing.snapshot().items()},
@@ -114,6 +125,7 @@ def main() -> dict:
     unique = 0.9
     iters = 4
     scale = None
+    skew = 0.0
     for a in sys.argv[1:]:
         if a.startswith("--rows="):
             rows = int(a.split("=", 1)[1])
@@ -123,6 +135,8 @@ def main() -> dict:
             unique = float(a.split("=", 1)[1])
         elif a.startswith("--iters="):
             iters = int(a.split("=", 1)[1])
+        elif a.startswith("--skew="):
+            skew = float(a.split("=", 1)[1])
 
     if "--tpch" in sys.argv:
         from cylon_tpu.tpch import bench_tpch
@@ -134,7 +148,8 @@ def main() -> dict:
     # halve on device OOM so the driver always gets a number
     while True:
         try:
-            return run(rows_per_chip=rows, unique=unique, iters=iters)
+            return run(rows_per_chip=rows, unique=unique, iters=iters,
+                       skew=skew)
         except Exception as e:  # noqa: BLE001
             msg = str(e)
             if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg

@@ -117,8 +117,9 @@ def table_to_arrow(table):
     Table::ToArrowTable)."""
     import pyarrow as pa
     arrays, names = [], []
+    hosts = table.host_columns()
     for name, c in table.columns.items():
-        data, valid = table.host_column(name)
+        data, valid = hosts[name]
         mask = ~valid if valid is not None else None
         if c.type == LogicalType.STRING:
             idx = pa.array(data.astype(np.int32), mask=mask)

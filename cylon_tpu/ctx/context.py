@@ -117,6 +117,11 @@ class CylonEnv:
     C++ ``CylonContext``).  Holds the device mesh, rank/world bookkeeping, a
     string config map, and the per-collective sequence counter."""
 
+    #: monotonically assigned per-env id — prediction caches key on this
+    #: instead of id(mesh) (CPython reuses ids after GC, which would let a
+    #: new env inherit a dead env's capacity predictions)
+    _next_serial = 0
+
     def __init__(self, config: CommConfig | None = None, verbose: bool = False):
         self.config = config or LocalConfig()
         self.verbose = verbose
@@ -125,6 +130,8 @@ class CylonEnv:
         self._mesh = Mesh(np.asarray(devs, dtype=object), (ROW_AXIS,))
         self._conf: dict[str, str] = {}
         self._finalized = False
+        self.serial = CylonEnv._next_serial
+        CylonEnv._next_serial += 1
 
     # -- reference CylonContext surface ------------------------------------
     @property

@@ -405,8 +405,12 @@ class DataFrame:
 
     def where(self, cond: "DataFrame | Series", other=None) -> "DataFrame":
         """Keep values where ``cond`` holds; elsewhere ``other`` (null when
-        ``other`` is None) — pandas/pycylon ``where`` semantics over a bool
-        frame or a single bool Series applied to every column."""
+        ``other`` is None) over a bool frame or a single bool Series.
+
+        Divergence from pandas (intentional, pycylon-style): a Series
+        ``cond`` is applied ROW-WISE to every column (what pandas spells
+        ``where(cond, axis=0)``); pandas' default would align the Series
+        on column labels, which is never useful for a row-predicate."""
         from .relational.common import valid_flag
         cols = {}
         for name in self.columns:
@@ -652,6 +656,8 @@ class GroupByDataFrame:
             aggs = [(c, op) for c in self._value_cols for op in spec]
         else:
             aggs = [tuple(a) for a in spec]
+        if not aggs:
+            raise InvalidError("no aggregations specified")
         return self._run(aggs)
 
 

@@ -46,25 +46,64 @@ def _route(gids, num_segments, mask):
     return jnp.where(mask, gids, jnp.int32(num_segments)), num_segments + 1
 
 
+#: below this segment count a dense one-hot masked reduction replaces the
+#: scatter: XLA's scatter-add serializes on colliding indices (~72 ns/row
+#: measured on v5e at any small segment count, vs ~9-36 ns/row for the
+#: dense broadcast-compare-reduce, which the VPU vectorizes across segment
+#: lanes; crossover ~8-16k segments)
+_DENSE_SEG_MAX = 4096
+
+
+def _ident(kind: str, dt):
+    if kind == "min":
+        if jnp.issubdtype(dt, jnp.floating):
+            return jnp.asarray(jnp.inf, dt)
+        if dt == jnp.bool_:
+            return jnp.asarray(True)
+        return jnp.asarray(jnp.iinfo(dt).max, dt)
+    if kind == "max":
+        if jnp.issubdtype(dt, jnp.floating):
+            return jnp.asarray(-jnp.inf, dt)
+        if dt == jnp.bool_:
+            return jnp.asarray(False)
+        return jnp.asarray(jnp.iinfo(dt).min, dt)
+    return jnp.asarray(0, dt)  # sum
+
+
+def _seg_apply(kind: str, values, g, ns: int, out_len: int):
+    """Segment reduce over ROUTED gids ``g`` (trash segment included in
+    ``ns``), returning the first ``out_len`` segments.  Dense one-hot
+    reduction below :data:`_DENSE_SEG_MAX`, scatter otherwise — both yield
+    the reduction identity for empty segments."""
+    if ns <= _DENSE_SEG_MAX:
+        eq = g[:, None] == jnp.arange(out_len, dtype=g.dtype)[None, :]
+        src = jnp.where(eq, values[:, None], _ident(kind, values.dtype))
+        red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[kind]
+        return red(src, axis=0)
+    fn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+          "max": jax.ops.segment_max}[kind]
+    return fn(values, g, num_segments=ns)[:out_len]
+
+
 def seg_sum(values, gids, num_segments, mask=None):
     g, ns = _route(gids, num_segments, mask)
-    return jax.ops.segment_sum(values, g, num_segments=ns)[:num_segments]
+    return _seg_apply("sum", values, g, ns, num_segments)
 
 
 def seg_count(values, gids, num_segments, mask=None):
     g, ns = _route(gids, num_segments, mask)
     ones = jnp.ones(gids.shape[0], _int_dtype())
-    return jax.ops.segment_sum(ones, g, num_segments=ns)[:num_segments]
+    return _seg_apply("sum", ones, g, ns, num_segments)
 
 
 def seg_min(values, gids, num_segments, mask=None):
     g, ns = _route(gids, num_segments, mask)
-    return jax.ops.segment_min(values, g, num_segments=ns)[:num_segments]
+    return _seg_apply("min", values, g, ns, num_segments)
 
 
 def seg_max(values, gids, num_segments, mask=None):
     g, ns = _route(gids, num_segments, mask)
-    return jax.ops.segment_max(values, g, num_segments=ns)[:num_segments]
+    return _seg_apply("max", values, g, ns, num_segments)
 
 
 def _ftype(values):
@@ -109,7 +148,7 @@ def _u32(x):
 
 def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
                    key_valids, seg_cap: int, key_narrow=None,
-                   value_narrow=None):
+                   value_narrow=None, pad_lanes: int = 0):
     """Grouped-input fast path, fully batched: per-group sums for the
     cumsum-able ops (sum/count/mean/var/std) AND the representative-key
     gather share ONE u32 lane-matrix gather (plus one f64 side gather when
@@ -215,6 +254,12 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
         g_next = jnp.concatenate([g[1:], tailv], axis=0)
         return g, g_next
 
+    if pad_lanes:
+        # XLA:TPU compiler landmine: specific (u32, f64) gather-lane width
+        # combinations SIGSEGV tpu_compile_helper (v5e libtpu 2026-07; e.g.
+        # 7xu32+6xf64 crashes while 8xu32+6xf64 compiles).  Callers retry a
+        # crashed compile with pad_lanes>0 dummy lanes to shift the width.
+        u32_cols = u32_cols + [jnp.zeros(n + 1, jnp.uint32)] * pad_lanes
     g_u = gn_u = g_f = gn_f = None
     if u32_cols:
         g_u, gn_u = gather_pair(u32_cols)
@@ -347,7 +392,7 @@ def nunique(value_keyops, gids, num_segments, mask=None):
                              jnp.zeros(gs.shape[0] - 1, jnp.int32)]) \
         if gs.shape[0] else jnp.zeros(0, jnp.int32)
     neq = neighbor_flags(srt, kinds) | first
-    return jax.ops.segment_sum(neq, gs, num_segments=ns)[:num_segments]
+    return _seg_apply("sum", neq, gs, ns, num_segments)
 
 
 def quantile(values, gids, num_segments, q: float, mask=None):
@@ -357,8 +402,8 @@ def quantile(values, gids, num_segments, q: float, mask=None):
     g, ns = _route(gids, num_segments, mask)
     v = f if mask is None else jnp.where(mask, f, jnp.inf)
     g_s, v_s = jax.lax.sort((g, v), num_keys=2, is_stable=False)
-    cnt_all = jax.ops.segment_sum(jnp.ones_like(g, dtype=_int_dtype()), g,
-                                  num_segments=ns)
+    cnt_all = _seg_apply("sum", jnp.ones_like(g, dtype=_int_dtype()), g,
+                         ns, ns)
     offs_all = jnp.concatenate(
         [jnp.zeros(1, cnt_all.dtype), jnp.cumsum(cnt_all)[:-1]])
     cnt, offs = cnt_all[:num_segments], offs_all[:num_segments]
@@ -378,7 +423,7 @@ def group_first_index(gids, num_segments, mask=None):
     n = gids.shape[0]
     g, ns = _route(gids, num_segments, mask)
     idx = jnp.arange(n, dtype=jnp.int32)
-    return jax.ops.segment_min(idx, g, num_segments=ns)[:num_segments]
+    return _seg_apply("min", idx, g, ns, num_segments)
 
 
 def np_result_dtype(op: str, src: np.dtype) -> np.dtype:

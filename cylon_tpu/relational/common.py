@@ -38,7 +38,7 @@ class BoundedCache(dict):
         self.maxlen = maxlen
 
     def put(self, key, value) -> None:
-        if len(self) >= self.maxlen:
+        if key not in self and len(self) >= self.maxlen:
             self.pop(next(iter(self)))
         self[key] = value
 

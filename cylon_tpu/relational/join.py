@@ -388,7 +388,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     vcl = np.asarray(lwork.valid_counts, np.int32)
     vcr = np.asarray(rwork.valid_counts, np.int32)
 
-    cache_key = (id(env.mesh), how, narrow, lwork.capacity, rwork.capacity,
+    cache_key = (env.serial, how, narrow, lwork.capacity, rwork.capacity,
                  int(lwork.valid_counts.sum()), int(rwork.valid_counts.sum()),
                  tuple(left_on), tuple(right_on),
                  tuple(lwork.column_names), tuple(rwork.column_names))

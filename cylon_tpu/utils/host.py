@@ -27,6 +27,22 @@ def host_array(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def host_arrays(xs) -> list:
+    """Batched :func:`host_array`: ONE overlapped fetch for many device
+    arrays.  The axon tunnel charges ~100 ms latency per FIRST fetch of
+    each buffer when pulled sequentially; ``jax.device_get`` issues every
+    copy async before blocking, collapsing N round-trips into ~one
+    (measured v5e tunnel: 20 buffers 3.0 s sequential → 0.14 s batched).
+    Entries may be numpy arrays or None (passed through)."""
+    import jax
+    if jax.process_count() > 1:
+        return [None if x is None else host_array(x) for x in xs]
+    devs = [x for x in xs if x is not None and not isinstance(x, np.ndarray)]
+    fetched = iter(jax.device_get(devs))
+    return [x if x is None or isinstance(x, np.ndarray) else next(fetched)
+            for x in xs]
+
+
 _pull_fn = None
 
 

@@ -50,8 +50,11 @@ def main():
         (a, b), num_keys=1, is_stable=True), x, x2)
     timed("sort 1key+3payload", lambda a, b, c, d: jax.lax.sort(
         (a, b, c, d), num_keys=1, is_stable=True), x, x2, pos, pos)
+    # distinct payload arrays per operand — XLA CSEs identical operands,
+    # which would understate the per-lane payload cost
     timed("sort 1key+5payload", lambda a, b, c, d: jax.lax.sort(
-        (a, b, c, d, b, c), num_keys=1, is_stable=True), x, x2, pos, pos)
+        (a, b, c, d, b + 1, c + 1), num_keys=1, is_stable=True),
+        x, x2, pos, pos)
     timed("sort 2key+2payload", lambda a, b, c, d: jax.lax.sort(
         (a, b, c, d), num_keys=2, is_stable=True), x, x2, pos, pos)
     timed("sort i64 key + payload", lambda a, b: jax.lax.sort(

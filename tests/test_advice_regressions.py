@@ -127,3 +127,27 @@ class TestReviewFindings:
         df = _df({"x": [np.nan, np.nan]}, env1)
         assert np.isnan(df["x"].min())
         assert np.isnan(df["x"].max())
+
+
+class TestRound2Advice:
+    """Round-2 advisor findings (ADVICE.md r2)."""
+
+    def test_bounded_cache_refresh_keeps_other_entries(self):
+        from cylon_tpu.relational.common import BoundedCache
+        c = BoundedCache(maxlen=2)
+        c.put("a", 1)
+        c.put("b", 2)
+        c.put("a", 3)  # refresh at capacity must NOT evict "b"
+        assert c.get("b") == 2 and c.get("a") == 3 and len(c) == 2
+
+    def test_empty_agg_spec_raises(self, env1):
+        df = _df({"k": [1, 1, 2], "v": [1.0, 2.0, 3.0]}, env1)
+        with pytest.raises(InvalidError):
+            df.groupby("k").agg([])
+        with pytest.raises(InvalidError):
+            df.groupby("k").agg({})
+
+    def test_env_serial_monotonic(self, env1):
+        assert isinstance(env1.serial, int)
+        e2 = ct.CylonEnv()  # LocalConfig: no mesh cost
+        assert e2.serial > env1.serial

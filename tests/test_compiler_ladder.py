@@ -1,0 +1,114 @@
+"""Regression tests for the XLA:TPU compiler-crash mitigation machinery.
+
+On v5e libtpu (2026-07) specific groupby programs SIGSEGV the TPU compiler
+subprocess (e.g. TPC-H Q1's exact 8-agg spec: 7xu32+6xf64 gather lanes),
+while close variants compile.  ``relational.groupby._pad_ladder`` retries a
+crashed compile with dummy gather lanes and finally the scatter fallback,
+remembering the winning variant per program signature.  The crash itself
+cannot reproduce on CPU; these tests pin the ladder mechanics and the
+dense/scatter segment-reduction parity that makes the fallback fast.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cylon_tpu.ops import groupby as gbk
+from cylon_tpu.relational import groupby as rel_gb
+
+
+def _crash(msg="INTERNAL: http://127.0.0.1:1/remote_compile: HTTP 500: "
+                "tpu_compile_helper subprocess exit signal SIGSEGV (11)"):
+    raise RuntimeError(msg)
+
+
+class TestPadLadder:
+    def test_advances_past_compiler_crash_and_remembers(self):
+        rel_gb._PAD_CACHE.clear()
+        calls = []
+
+        def make(tag, fail):
+            def thunk():
+                calls.append(tag)
+                if fail:
+                    _crash()
+                return tag
+            return (tag, thunk)
+
+        attempts = [make("pad0", True), make("pad1", True),
+                    make("scatter", False)]
+        key = ("sig", 1)
+        assert rel_gb._pad_ladder(key, attempts) == "scatter"
+        assert calls == ["pad0", "pad1", "scatter"]
+        # second run dispatches straight to the remembered variant
+        calls.clear()
+        assert rel_gb._pad_ladder(key, attempts) == "scatter"
+        assert calls == ["scatter"]
+
+    def test_non_crash_errors_propagate(self):
+        rel_gb._PAD_CACHE.clear()
+
+        def bad():
+            raise ValueError("data error, not a compiler crash")
+
+        with pytest.raises(ValueError):
+            rel_gb._pad_ladder(("sig", 2), [("pad0", bad),
+                                            ("scatter", lambda: "x")])
+
+    def test_remembered_index_clamped_to_ladder_length(self):
+        rel_gb._PAD_CACHE.clear()
+        rel_gb._PAD_CACHE.put(("sig", 3), 5)
+        assert rel_gb._pad_ladder(("sig", 3),
+                                  [("only", lambda: "ok")]) == "ok"
+
+    def test_crash_detector(self):
+        e = RuntimeError("INTERNAL: http://x/remote_compile: HTTP 500: "
+                         "tpu_compile_helper subprocess exit signal SIGSEGV")
+        assert rel_gb._is_compiler_crash(e)
+        assert not rel_gb._is_compiler_crash(RuntimeError("RESOURCE_EXHAUSTED"))
+
+
+class TestDenseSegmentParity:
+    """The dense one-hot reduction (num_segments <= _DENSE_SEG_MAX) must
+    agree exactly with the scatter path it replaces (measured v5e: scatter
+    ~72 ns/row at small segment counts from collision serialization, dense
+    ~9 ns/row)."""
+
+    @pytest.mark.parametrize("kind", ["sum", "min", "max", "count"])
+    @pytest.mark.parametrize("dtype", [np.int64, np.float64, np.int32])
+    def test_parity(self, kind, dtype, monkeypatch):
+        rng = np.random.default_rng(7)
+        n, ns = 4096, 17
+        gids = jnp.asarray(rng.integers(0, ns, n).astype(np.int32))
+        vals = jnp.asarray(rng.integers(-50, 50, n).astype(dtype))
+        mask = jnp.asarray(rng.integers(0, 2, n).astype(bool))
+        fn = getattr(gbk, f"seg_{kind}")
+        dense = fn(vals, gids, ns, mask)
+        monkeypatch.setattr(gbk, "_DENSE_SEG_MAX", 0)
+        scatter = fn(vals, gids, ns, mask)
+        np.testing.assert_array_equal(np.asarray(dense), np.asarray(scatter))
+
+    def test_empty_segment_identities(self):
+        gids = jnp.asarray(np.array([0, 0, 2], np.int32))
+        vals = jnp.asarray(np.array([5.0, 3.0, 1.0]))
+        mn = np.asarray(gbk.seg_min(vals, gids, 4))
+        mx = np.asarray(gbk.seg_max(vals, gids, 4))
+        assert mn[1] == np.inf and mx[1] == -np.inf
+        assert mn[0] == 3.0 and mx[0] == 5.0 and mn[2] == 1.0
+
+
+def test_all_laneless_f64_key_and_value(env8):
+    """Zero-lane vspec (every column laneless f64, none nullable): the sort
+    path must ride the index lane alone, not crash in pack_lanes."""
+    import pandas as pd
+    import cylon_tpu as ct
+    from cylon_tpu.relational import groupby_aggregate
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({"k": rng.integers(0, 5, 200).astype(np.float64),
+                       "v": rng.random(200)})
+    t = ct.Table.from_pandas(df, env8)
+    g = groupby_aggregate(t, ["k"], [("v", "sum")]).to_pandas()
+    exp = df.groupby("k", as_index=False).agg(v_sum=("v", "sum"))
+    g = g.sort_values("k").reset_index(drop=True)
+    np.testing.assert_allclose(g["v_sum"].to_numpy(),
+                               exp["v_sum"].to_numpy(), rtol=1e-12)
