@@ -144,7 +144,12 @@ def main() -> dict:
                           iters=iters)
 
     if rows is None:
-        rows = 64_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
+        # 32M/chip: the largest bucket where the fused join+groupby runs
+        # monolithically in 16 GB HBM with headroom AND the best measured
+        # throughput (36.4M rows/s vs 34.6M at 48M rows/chip; 64M OOMs the
+        # fused path and auto-halves).  Larger-than-HBM runs take the
+        # pipelined path (scripts/bench_pipelined.py).
+        rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
     # halve on device OOM so the driver always gets a number
     while True:
         try:

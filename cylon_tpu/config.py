@@ -34,6 +34,12 @@ BENCH_TIMINGS = _env_flag("CYLON_TPU_BENCH", False)
 #: Round variable capacities up to powers of two to bound recompilation.
 POW2_CAPACITIES = _env_flag("CYLON_TPU_POW2_CAPS", True)
 
+#: Defer inner-join output materialization so a same-key groupby can consume
+#: the pre-expansion sorted state (relational/fused.py); any other access
+#: materializes transparently.  Reference analog: the streaming ops DAG
+#: (cpp/src/cylon/ops/, SURVEY §2 C9).
+DEFER_JOIN = _env_flag("CYLON_TPU_DEFER_JOIN", True)
+
 
 def pow2ceil(n: int) -> int:
     """Bucket a dynamic capacity to the next 2^(b-5) step for n in

@@ -228,6 +228,77 @@ class Table:
                 f"world={self._env.world_size}, cap={self.capacity})")
 
 
+class DeferredTable(Table):
+    """A Table whose columns materialize lazily on first data access.
+
+    The TPU analog of the reference's streaming operator DAG
+    (cpp/src/cylon/ops/, SURVEY §2 C9): an upstream operator (join) may
+    hand its *pre-materialization state* to a compatible downstream
+    consumer (groupby pushdown, relational/fused.py) without ever paying
+    for the intermediate table; any other access runs the deferred
+    materialization transparently.
+
+    Schema queries (``column_names``/``schema``/``capacity``/counts)
+    answer from stored metadata so DataFrame-level bookkeeping does not
+    force materialization; ``column()``/``columns`` do."""
+
+    __slots__ = ("_thunk", "_cap", "_meta", "op_state")
+
+    def __init__(self, env, valid_counts, capacity: int, thunk,
+                 meta, op_state=None):
+        """``meta`` = (names, types, dicts, has_nulls) tuples parallel to
+        the eventual columns; ``thunk()`` -> dict[str, Column]; ``op_state``
+        is consumed by fused downstream operators (cleared on
+        materialization)."""
+        self._thunk = None
+        super().__init__({}, env, valid_counts)
+        self._cap = int(capacity)
+        self._meta = meta
+        self._thunk = thunk
+        self.op_state = op_state
+
+    # _cols shadows the Table slot: reads trigger materialization
+    @property
+    def _cols(self):
+        if self._thunk is not None:
+            thunk, self._thunk = self._thunk, None
+            # drop the fused-consumer state BEFORE materializing: it pins
+            # N-length device buffers the thunk never reads, and peak HBM
+            # during the expansion is the binding constraint
+            self.op_state = None
+            Table._cols.__set__(self, dict(thunk()))
+        return Table._cols.__get__(self)
+
+    @_cols.setter
+    def _cols(self, v):
+        Table._cols.__set__(self, v)
+
+    @property
+    def materialized(self) -> bool:
+        return self._thunk is None
+
+    # -- schema without materialization ------------------------------------
+    @property
+    def column_names(self) -> list[str]:
+        return list(self._meta[0])
+
+    @property
+    def column_count(self) -> int:
+        return len(self._meta[0])
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    @property
+    def schema(self) -> list[Field]:
+        return [Field(n, t, hn) for n, t, hn in
+                zip(self._meta[0], self._meta[1], self._meta[3])]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._meta[0]
+
+
 def _column_from_series(s) -> Column:
     """pandas Series -> HOST Column, nullable-extension-dtype aware: masked
     numeric/boolean dtypes (Int64/Float64/boolean, with .numpy_dtype) keep

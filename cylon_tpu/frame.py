@@ -590,11 +590,13 @@ class GroupByDataFrame:
 
     def _all(self, op: str) -> DataFrame:
         from .core.dtypes import LogicalType
+        # types via schema, NOT column(): column access would materialize a
+        # DeferredTable join result and forfeit the fused groupby pushdown
+        types = {f.name: f.type for f in self._df._table.schema}
         aggs = []
         for c in self._value_cols:
-            lt = self._df._table.column(c).type
-            if lt == LogicalType.STRING and op not in ("count", "nunique",
-                                                       "min", "max"):
+            if types[c] == LogicalType.STRING and op not in (
+                    "count", "nunique", "min", "max"):
                 continue
             aggs.append((c, op))
         if not aggs:

@@ -1,0 +1,262 @@
+"""Fused join→groupby: aggregate through the join without materializing it.
+
+The TPU realization of the reference's streaming operator DAG
+(cpp/src/cylon/ops/ — ``DisJoinOP`` feeding downstream ops through queues,
+SURVEY §2 C9): when a groupby's keys are exactly an inner join's keys and
+every aggregation is multiplicity-algebraic, the per-group answer is
+computable from the join's *pre-expansion sorted state* (phase 1) — the
+output-space expansion (two ~15 ns/slot gathers over every output row, the
+dominant join cost) never runs.
+
+The algebra: an inner join's output rows for key group g are the L_g × R_g
+cross product, so over the join output
+
+  sum(c_left)   = S_g(c) · R_g          count(c_left) = C_g(c) · R_g
+  mean(c_left)  = S_g(c) / C_g(c)       (multiplicity cancels)
+  var/std       = moments scale by R_g; ddof applies to the full C·R count
+
+with S/C the per-group masked sum/valid-count of c over the *left rows of
+the sorted state* (symmetrically with L_g for right columns).  All of
+S, C, L, R come out of the groupby engine's batched prefix-diff machinery
+(ops/groupby.grouped_reduce) over the already-sorted state — a few cumsums
+and ONE (seg_cap, L) gather.  min/max/quantile/nunique do not reduce to
+prefix sums over the state and take the materialize path.
+
+Trigger: ``groupby_aggregate`` calls :func:`try_join_groupby_pushdown`
+first; it returns None (and the DeferredTable later materializes
+transparently) unless every condition holds.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import config
+from ..core.column import Column
+from ..core.table import DeferredTable, Table
+from ..ctx.context import ROW_AXIS
+from ..ops import groupby as gbk
+from ..ops import lanes
+from ..utils import timing
+from ..utils.host import host_array
+from .common import REP, ROW, BoundedCache
+
+shard_map = jax.shard_map
+
+#: ops whose join pushdown is exact multiplicity algebra
+PUSHDOWN_OPS = {"sum", "count", "mean", "var", "std"}
+
+#: callsite-signature -> last observed kept-group-count bucket
+_SEG_CACHE = BoundedCache()
+
+
+class JoinState(NamedTuple):
+    """Pre-expansion inner-join state a DeferredTable carries for fused
+    consumers (built in relational/join.py; device arrays stay sharded)."""
+    vcl: np.ndarray      # left per-shard valid counts
+    vcr: np.ndarray      # right per-shard valid counts
+    idx_s: jax.Array     # (N,) concat-row index at each sorted position
+    bnd: jax.Array       # (N,) key-boundary flags of the sorted state
+    pl_s: tuple          # sorted payload lanes: left lanes ++ right lanes
+    lspec: lanes.LaneSpec
+    rspec: lanes.LaneSpec
+    plan: tuple          # output plan entries parallel to names
+    names: tuple
+    types: tuple
+    dicts: tuple
+    key_names: tuple     # join-key output column names (== left_on)
+    cap_l: int
+    cap_r: int
+    all_live: bool
+
+
+def _col_entry(state: JoinState, name: str):
+    """(side, lane-col-index) of output column ``name`` in the carried
+    state; None when the column is not a plain carried l/r column."""
+    try:
+        i = state.names.index(name)
+    except ValueError:
+        return None
+    e = state.plan[i]
+    if e[0] in ("l", "r"):
+        return e[0], e[1]
+    return None
+
+
+@lru_cache(maxsize=None)
+def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
+              vspecs: tuple, key_cols: tuple, key_narrow: tuple,
+              seg_cap: int, ddof: int):
+    """Per-shard fused join+groupby kernel.
+
+    ``vspecs``: per aggregation (side, lane_col_idx, op); ``key_cols``:
+    left lane-col index per groupby key.  Live rows form a sorted PREFIX
+    (the row-liveness operand sorts padding last), so liveness is a
+    position compare — no gather."""
+
+    def per_shard(vcl, vcr, idx_s, bnd, pl_s):
+        N = bnd.shape[0]
+        pos = jnp.arange(N, dtype=jnp.int32)
+        my = jax.lax.axis_index(ROW_AXIS)
+        side_r = idx_s >= n_l
+        if all_live:
+            live = jnp.ones(N, bool)
+        else:
+            live = pos < (vcl[my] + vcr[my]).astype(jnp.int32)
+        lefts_b = ~side_r & live
+        rights_b = side_r & live
+        lefts = lefts_b.astype(jnp.int32)
+        rights = rights_b.astype(jnp.int32)
+        first = bnd.astype(bool) | (pos == 0)
+        s_l = jnp.cumsum(lefts).astype(jnp.int32)
+        s_r = jnp.cumsum(rights).astype(jnp.int32)
+        ebnd = jnp.concatenate([first[1:], jnp.ones(1, bool)])
+        imax = jnp.int32(2**31 - 1)
+        e_l = jax.lax.cummin(jnp.where(ebnd, s_l, imax), reverse=True)
+        e_r = jax.lax.cummin(jnp.where(ebnd, s_r, imax), reverse=True)
+        b_l = jax.lax.cummax(jnp.where(first, s_l - lefts, jnp.int32(0)))
+        b_r = jax.lax.cummax(jnp.where(first, s_r - rights, jnp.int32(0)))
+        l_grp = e_l - b_l        # own group's left count, per position
+        r_grp = e_r - b_r
+        keep = (l_grp > 0) & (r_grp > 0) & live
+        kstart = first & keep
+        kgid = jnp.cumsum(kstart.astype(jnp.int32)).astype(jnp.int32) - 1
+        n_groups = (jnp.max(jnp.where(keep, kgid, -1)) + 1).astype(jnp.int32)
+        starts = jnp.full(seg_cap, N, jnp.int32).at[
+            jnp.where(kstart, kgid, jnp.int32(seg_cap))].set(pos, mode="drop")
+
+        nl_lanes = lspec.n_lanes
+        lmat = jnp.stack(pl_s[:nl_lanes], axis=1)
+        ldat, lval = lanes.unpack_lanes(lspec, lmat)
+        rmat = jnp.stack(pl_s[nl_lanes:], axis=1)
+        rdat, rval = lanes.unpack_lanes(rspec, rmat)
+
+        def value_of(side, ci):
+            d = ldat[ci] if side == "l" else rdat[ci]
+            v = lval[ci] if side == "l" else rval[ci]
+            sidemask = lefts_b if side == "l" else rights_b
+            vm = sidemask & keep
+            if v is not None:
+                vm = vm & v
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                vm = vm & ~jnp.isnan(d)
+            return d, vm
+
+        ops_list, vals, masks = [], [], []
+        for side, ci, op in vspecs:
+            d, vm = value_of(side, ci)
+            ops_list.append(op)
+            vals.append(d)
+            masks.append(vm)
+        # the two multiplicity counts ride the same batched pass
+        ops_list += ["count", "count"]
+        vals += [s_l, s_l]
+        masks += [lefts_b & keep, rights_b & keep]
+
+        key_datas = [ldat[ci] for ci in key_cols]
+        key_valids = [lval[ci] for ci in key_cols]
+        inters, key_out, kval_out = gbk.grouped_reduce(
+            ops_list, vals, masks, starts, jnp.int32(N), key_datas,
+            key_valids, seg_cap, key_narrow=key_narrow)
+        l_cnt = inters[-2]["count"]
+        r_cnt = inters[-1]["count"]
+
+        res_d, res_v = [], []
+        for i, (side, ci, op) in enumerate(vspecs):
+            mult = (r_cnt if side == "l" else l_cnt)
+            inter = inters[i]
+            if op == "sum":
+                s = inter["sum"]
+                d, v = s * mult.astype(s.dtype), None
+            elif op == "count":
+                d, v = inter["count"] * mult, None
+            elif op == "mean":
+                d, v = gbk.finalize("mean", inter, ddof)
+            else:  # var/std: moments scale by mult; ddof sees the full count
+                scaled = {k: (a * mult.astype(a.dtype) if k != "count"
+                              else a * mult) for k, a in inter.items()}
+                d, v = gbk.finalize(op, scaled, ddof)
+            res_d.append(d)
+            res_v.append(v)
+        return (tuple(key_out), tuple(kval_out), tuple(res_d), tuple(res_v),
+                n_groups.reshape(1))
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, REP, ROW, ROW, ROW),
+                             out_specs=(ROW, ROW, ROW, ROW, ROW)))
+
+
+def try_join_groupby_pushdown(table: Table, by: list, specs: list,
+                              ddof: int):
+    """Fused path when ``table`` is an unmaterialized inner-join result and
+    the groupby reduces to multiplicity algebra over its sorted state.
+    Returns the result Table, or None to take the normal path."""
+    if not isinstance(table, DeferredTable) or table.materialized:
+        return None
+    state = table.op_state
+    if not isinstance(state, JoinState):
+        return None
+    if tuple(by) != state.key_names:
+        return None
+    vspecs = []
+    for col, op, _q, _name in specs:
+        if op not in PUSHDOWN_OPS:
+            return None
+        ent = _col_entry(state, col)
+        if ent is None:
+            return None
+        vspecs.append((ent[0], ent[1], op))
+    key_cols, key_narrow = [], []
+    for k in by:
+        ent = _col_entry(state, k)
+        if ent is None or ent[0] != "l":
+            return None
+        key_cols.append(ent[1])
+        key_narrow.append(bool(state.lspec.cols[ent[1]].narrow))
+
+    env = table.env
+    from .groupby import _result_table, _shrink
+    # result typing from the join output schema
+    class _C:  # minimal stand-in with .type/.dictionary for _result_types
+        def __init__(self, t, dc):
+            self.type, self.dictionary = t, dc
+    from .groupby import _result_types
+    val_cols = [_C(state.types[state.names.index(c)],
+                   state.dicts[state.names.index(c)]) for c, _, _, _ in specs]
+    res_types, res_dicts = _result_types(specs, val_cols)
+    by_cols = [_C(state.types[state.names.index(k)],
+                  state.dicts[state.names.index(k)]) for k in by]
+    res_names = [n for _, _, _, n in specs]
+
+    cap_total = state.cap_l + state.cap_r
+    args = (state.vcl, state.vcr, state.idx_s, state.bnd, state.pl_s)
+    sig = (env.serial, tuple(by), tuple(vspecs), state.cap_l, state.cap_r,
+           int(state.vcl.sum()), int(state.vcr.sum()), ddof)
+    pred = _SEG_CACHE.get(sig)
+
+    def call(sc):
+        return _fused_fn(env.mesh, state.cap_l, state.all_live, state.lspec,
+                         state.rspec, tuple(vspecs), tuple(key_cols),
+                         tuple(key_narrow), sc, ddof)(*args)
+
+    with timing.region("groupby.fused"):
+        seg_cap = pred if (pred is not None and pred < cap_total) \
+            else config.pow2ceil(cap_total)
+        res = call(seg_cap)
+        n_groups = host_array(res[4]).astype(np.int64)
+        ng_cap = config.pow2ceil(int(n_groups.max()) if n_groups.size else 1)
+        if ng_cap > seg_cap:
+            res = call(ng_cap)
+        _SEG_CACHE.put(sig, ng_cap)
+        key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
+    out = _result_table(env, by, by_cols, key_out, kval_out, res_names,
+                        res_d, res_v, res_types, res_dicts, n_groups)
+    out = _shrink(out, n_groups)
+    out.grouped_by = tuple(by)
+    return out

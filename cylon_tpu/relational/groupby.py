@@ -552,6 +552,14 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     env = table.env
     by = [by] if isinstance(by, str) else list(by)
     specs = _normalize_aggs(aggs)
+    # fused path: an unmaterialized inner-join result grouped by the join
+    # keys aggregates straight off the pre-expansion sorted state
+    # (relational/fused.py) — must run before any column access below,
+    # which would materialize the join
+    from .fused import try_join_groupby_pushdown
+    pushed = try_join_groupby_pushdown(table, by, specs, ddof)
+    if pushed is not None:
+        return pushed
     by_cols = [table.column(n) for n in by]
     val_cols = [table.column(c) for c, _, _, _ in specs]
     for (c, op, _, _), col in zip(specs, val_cols):

@@ -233,7 +233,8 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
 @lru_cache(maxsize=None)
 def _count_fn(mesh: Mesh, how: str, narrow: tuple,
               lspec: lanes.LaneSpec | None = None,
-              rspec: lanes.LaneSpec | None = None, all_live: bool = False):
+              rspec: lanes.LaneSpec | None = None, all_live: bool = False,
+              slim: bool = False):
     """Phase 1: sort once; return per-shard exact counts + carried state.
 
     With ``lspec``/``rspec`` (inner/left joins over fully-laneable output
@@ -263,14 +264,21 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
             vcl, vcr, l_datas, l_valids, r_datas, r_valids, narrow, payloads,
             all_live)
         n, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
+        if slim:
+            # deferred-join state: only what the fused consumer needs
+            # (relational/fused.py) — dropping the other carry arrays frees
+            # ~5 N-length buffers of HBM while the state is held; a later
+            # materialization re-runs this fn un-slim (compiled-cache hit)
+            return (n.reshape(1), idx_s, bnd) + pl_s
         return (n.reshape(1),) + tuple(carry) + pl_s
 
     n_pl = (lspec.n_lanes if lspec is not None else 0) + \
         (rspec.n_lanes if rspec is not None else 0)
+    n_out = (3 + n_pl) if slim else (7 + n_pl)
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW,
                                        ROW, ROW, ROW),
-                             out_specs=(ROW,) * (7 + n_pl)))
+                             out_specs=(ROW,) * n_out))
 
 
 @lru_cache(maxsize=None)
@@ -479,16 +487,68 @@ def join_tables(left: Table, right: Table, left_on, right_on,
                      tuple(c.validity for c in r_cols_list))
     all_live = bool((vcl == lwork.capacity).all()
                     and (vcr == rwork.capacity).all())
+    # phase 1 only consumes the columns that ride the sort; keep the
+    # rest out of the trace (no needless retraces)
+    count_l_args = l_gather_args if carry_emit else ((), ())
+    count_r_args = r_gather_args if carry_match else ((), ())
+    count_args = (vcl, vcr, l_datas, l_valids, r_datas, r_valids,
+                  *count_l_args, *count_r_args)
+    cl_spec = lspec if carry_emit else None
+    cr_spec = rspec if carry_match else None
+
+    # ---- deferred materialization (reference ops-DAG slot, C9) -----------
+    # Inner joins whose output columns fully ride the phase-1 sort can hand
+    # the pre-expansion sorted state to a fused downstream consumer
+    # (groupby pushdown, relational/fused.py) — the output expansion (two
+    # ~15 ns/slot gathers over every output row, the dominant join cost)
+    # never runs for join->groupby-on-the-join-keys pipelines.  Any other
+    # access materializes transparently (core.table.DeferredTable).  Phase 1
+    # runs SLIM (no carry outputs, ~5 N-length HBM buffers freed) — a later
+    # materialization re-runs it un-slim against the compiled cache.
+    defer = (config.DEFER_JOIN and how == "inner" and carry_emit
+             and carry_match and coalesce and not skew_split)
+    if defer:
+        with timing.region("join.sort_count"):
+            res = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
+                            all_live, slim=True)(*count_args)
+        counts_dev, idx_s_s, bnd_s = res[0], res[1], res[2]
+        pl_s = tuple(res[3:])
+        counts = host_array(counts_dev).astype(np.int64)
+        out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+        _CAP_CACHE.put(cache_key, out_cap)
+
+        def thunk():
+            with timing.region("join.materialize"):
+                full = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
+                                 all_live)(*count_args)
+                fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
+                                     tuple(plan), lspec, rspec, carry_emit,
+                                     carry_match)
+                out_d, out_v = fn(full[1:7], tuple(full[7:]),
+                                  *l_gather_args, *r_gather_args)
+            return {nme: Column(d, t, v, dc, bounds=b)
+                    for nme, d, v, t, dc, b in
+                    zip(names, out_d, out_v, types, dicts, bounds)}
+
+        from ..core.table import DeferredTable
+        from .fused import JoinState
+        state = JoinState(
+            vcl=vcl, vcr=vcr, idx_s=idx_s_s, bnd=bnd_s, pl_s=pl_s,
+            lspec=lspec, rspec=rspec, plan=tuple(plan),
+            names=tuple(names), types=tuple(types), dicts=tuple(dicts),
+            key_names=tuple(left_on),
+            cap_l=lwork.capacity, cap_r=rwork.capacity, all_live=all_live)
+        out = DeferredTable(
+            env, counts, out_cap, thunk,
+            (tuple(names), tuple(types), tuple(dicts),
+             tuple(bool(e[-1]) for e in plan)),
+            op_state=state)
+        out.grouped_by = tuple(left_on)
+        return out
+
     with timing.region("join.sort_count"):
-        # phase 1 only consumes the columns that ride the sort; keep the
-        # rest out of the trace (no needless retraces)
-        count_l_args = l_gather_args if carry_emit else ((), ())
-        count_r_args = r_gather_args if carry_match else ((), ())
-        res = _count_fn(env.mesh, how, narrow,
-                        lspec if carry_emit else None,
-                        rspec if carry_match else None, all_live)(
-            vcl, vcr, l_datas, l_valids, r_datas, r_valids,
-            *count_l_args, *count_r_args)
+        res = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
+                        all_live)(*count_args)
         counts_dev, carry = res[0], res[1:7]
         pl_s = tuple(res[7:])
 
