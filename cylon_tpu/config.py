@@ -34,6 +34,22 @@ BENCH_TIMINGS = _env_flag("CYLON_TPU_BENCH", False)
 #: Round variable capacities up to powers of two to bound recompilation.
 POW2_CAPACITIES = _env_flag("CYLON_TPU_POW2_CAPS", True)
 
+#: High-cardinality string-key crossover: columns with at least MIN_ROWS
+#: rows whose sampled distinct ratio reaches RATIO take the hashed-codes
+#: path (core.column.HashedStrings) instead of building a sorted
+#: dictionary — dictionary construction (np.unique over every value) is a
+#: host-memory wall at ~1e8+ distinct strings.
+STRING_HASH_MIN_ROWS = int(os.environ.get("CYLON_TPU_STRING_HASH_MIN",
+                                          str(4_000_000)))
+STRING_HASH_RATIO = float(os.environ.get("CYLON_TPU_STRING_HASH_RATIO",
+                                         "0.5"))
+
+#: Per-factory bound on cached compiled programs (shard_map/jit factories
+#: are memoized on static args; long-lived processes joining many distinct
+#: schemas would otherwise accumulate executables without limit).  LRU:
+#: eviction drops the jit wrapper (and its executables); re-use recompiles.
+PROGRAM_CACHE_SIZE = int(os.environ.get("CYLON_TPU_PROGRAM_CACHE", "256"))
+
 #: Defer inner-join output materialization so a same-key groupby can consume
 #: the pre-expansion sorted state (relational/fused.py); any other access
 #: materializes transparently.  Reference analog: the streaming ops DAG

@@ -122,12 +122,18 @@ def table_to_arrow(table):
         data, valid = hosts[name]
         mask = ~valid if valid is not None else None
         if c.type == LogicalType.STRING:
-            idx = pa.array(data.astype(np.int32), mask=mask)
-            arr = pa.DictionaryArray.from_arrays(
-                idx, pa.array(c.dictionary.astype(object)))
-            # faithful schema: sources are typically plain utf8, and our
-            # dictionary-encoding is an internal representation choice
-            arr = arr.dictionary_decode()
+            from .column import HashedStrings
+            if isinstance(c.dictionary, HashedStrings):
+                arr = pa.array(c.dictionary.take(data), type=pa.string(),
+                               mask=mask)
+            else:
+                idx = pa.array(data.astype(np.int32), mask=mask)
+                arr = pa.DictionaryArray.from_arrays(
+                    idx, pa.array(c.dictionary.astype(object)))
+                # faithful schema: sources are typically plain utf8, and
+                # our dictionary-encoding is an internal representation
+                # choice
+                arr = arr.dictionary_decode()
         elif c.type == LogicalType.DATE64:
             arr = pa.array(data, type=pa.timestamp("ns"), mask=mask)
         elif c.type == LogicalType.TIMEDELTA:

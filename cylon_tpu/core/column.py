@@ -21,6 +21,78 @@ from ..status import CylonTypeError, InvalidError
 from .dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 
 
+class HashedStrings:
+    """High-cardinality string 'dictionary': device codes are stable 64-bit
+    value hashes (int64 bit-pattern) instead of sorted-dictionary indices.
+
+    Rides the existing ``Column.dictionary`` slot so every column rebuild
+    site propagates it untouched.  Semantics vs a real dictionary:
+
+    * EQUALITY on codes is (probabilistically) value equality — joins,
+      groupbys, set ops, unique and ==/!= filters are exact up to 64-bit
+      hash collisions (birthday bound: ~3e-20·n² chance of any collision —
+      ~0.3% at 100M distinct values); the reference compares flattened
+      binary exactly (util/flatten_array.cpp), this path trades that for
+      never building an n-entry dictionary.
+    * ORDER of codes is NOT value order: lexical sorts, range compares and
+      min/max on such columns raise (the caller sees a clear error, never
+      a wrong answer).
+    * decode goes through a lazily built hash->value map over the source
+      values (only paid if the strings are actually materialized).
+
+    Construction cost is one stable 64-bit hash per row
+    (:func:`cylon_tpu.native.hash_strings` — native murmur64a when the
+    toolchain is present).
+    """
+
+    __slots__ = ("_hashes", "_values", "_sorted")
+
+    def __init__(self, hashes: np.ndarray, values: np.ndarray):
+        self._hashes = hashes      # uint64, aligned with _values
+        self._values = values      # object array of source strings
+        self._sorted = None
+
+    def _lookup(self):
+        if self._sorted is None:
+            order = np.argsort(self._hashes)
+            hs = self._hashes[order]
+            vs = self._values[order]
+            keep = np.concatenate([[True], hs[1:] != hs[:-1]])
+            self._sorted = (hs[keep], vs[keep])
+        return self._sorted
+
+    def take(self, codes: np.ndarray) -> np.ndarray:
+        """Decode int64-bit-pattern codes to their string values."""
+        hs, vs = self._lookup()
+        u = np.asarray(codes).astype(np.int64).view(np.uint64)
+        idx = np.clip(np.searchsorted(hs, u), 0, max(len(hs) - 1, 0))
+        if len(hs) == 0:
+            return np.asarray([""] * len(u), dtype=object)
+        return vs[idx]
+
+    def hash_values(self, values) -> np.ndarray:
+        """int64-bit-pattern codes for new values (filter literals,
+        dictionary-side re-encoding in joins)."""
+        from .. import native
+        return native.hash_strings(np.asarray(values, dtype=object)) \
+            .view(np.int64)
+
+    def merged_with(self, other: "HashedStrings") -> "HashedStrings":
+        return HashedStrings(
+            np.concatenate([self._hashes, other._hashes]),
+            np.concatenate([self._values, other._values]))
+
+    def __len__(self):  # distinct-count queries on the lookup
+        return len(self._lookup()[0])
+
+
+def hashed_codes(values: np.ndarray):
+    """(codes int64, HashedStrings) for a host string/object array."""
+    from .. import native
+    hashes = native.hash_strings(np.asarray(values, dtype=object))
+    return hashes.view(np.int64), HashedStrings(hashes, values)
+
+
 class Column:
     __slots__ = ("data", "validity", "type", "dictionary", "bounds")
 
@@ -83,11 +155,39 @@ class Column:
                 return v.decode("utf-8", "replace")
             return str(v)
 
-        values = np.asarray([as_str(v) for v in safe], dtype=object)
-        # np.unique returns a *sorted* dictionary so code order == lexical
-        # order: sorts/joins on codes are exact on the decoded values.
-        dictionary, codes = np.unique(values, return_inverse=True)
+        if safe.dtype.kind == "U":
+            values = safe.astype(object)
+        elif all(isinstance(v, str) for v in safe[:64]):
+            # object arrays from pandas are usually already str (np.str_
+            # included) — probe a prefix, stringify only the exceptions
+            values = np.asarray(
+                [v if isinstance(v, str) else as_str(v) for v in safe],
+                dtype=object)
+        else:
+            values = np.asarray([as_str(v) for v in safe], dtype=object)
         validity = ~mask if mask.any() else None
+        # crossover heuristic: a sampled distinct-ratio estimate decides
+        # between the sorted dictionary (order-isomorphic codes — lexical
+        # sorts/compares work) and the hashed-codes path (HashedStrings:
+        # no n-entry dictionary is ever built; equality-only semantics).
+        # Reference analog: flatten-then-hash of non-fixed keys
+        # (util/flatten_array.cpp + util/murmur3.cpp).
+        from .. import config
+        n = len(values)
+        # x64 opt-out downcasts 8-byte transfers: 32-bit hash equality
+        # would collide at birthday rates, so the crossover requires x64
+        if n >= config.STRING_HASH_MIN_ROWS and config.X64_ENABLED:
+            samp = values[::max(n // 65536, 1)][:65536]
+            if len(np.unique(samp)) >= config.STRING_HASH_RATIO * len(samp):
+                codes, lookup = hashed_codes(values)
+                return Column(codes, LogicalType.STRING, validity, lookup)
+        # sorted dictionary so code order == lexical order: sorts/joins on
+        # codes are exact on the decoded values.  pd.factorize(sort=True)
+        # is the C-speed np.unique(return_inverse) (several x faster on
+        # object arrays — the ingest hot loop at TPC-H scale).
+        import pandas as pd
+        codes, uniques = pd.factorize(values, sort=True)
+        dictionary = np.asarray(uniques, dtype=object)
         return Column(codes.astype(np.int32), LogicalType.STRING, validity,
                       dictionary)
 
@@ -110,8 +210,12 @@ class Column:
         valid = (np.asarray(self.validity)[: len(data)]
                  if self.validity is not None else None)
         if self.type == LogicalType.STRING:
-            out = self.dictionary[np.clip(data, 0, len(self.dictionary) - 1)]
-            out = out.astype(object)
+            if isinstance(self.dictionary, HashedStrings):
+                out = self.dictionary.take(data)
+            else:
+                out = self.dictionary[
+                    np.clip(data, 0, len(self.dictionary) - 1)]
+            out = np.asarray(out).astype(object)
             if valid is not None:
                 out[~valid] = None
             return out

@@ -266,7 +266,18 @@ class DeferredTable(Table):
             # N-length device buffers the thunk never reads, and peak HBM
             # during the expansion is the binding constraint
             self.op_state = None
-            Table._cols.__set__(self, dict(thunk()))
+            out = thunk()
+            if isinstance(out, Table):
+                # OOM-fallback protocol: the thunk re-ran the whole
+                # operator down a streaming path and produced a fresh
+                # Table — adopt its layout (per-shard counts/capacity may
+                # differ from the deferred prediction; global rows match)
+                Table._cols.__set__(self, dict(out.columns))
+                self._valid = out.valid_counts
+                self._cap = out.capacity
+                self.grouped_by = out.grouped_by
+            else:
+                Table._cols.__set__(self, dict(out))
         return Table._cols.__get__(self)
 
     @_cols.setter

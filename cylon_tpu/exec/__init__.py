@@ -2,4 +2,4 @@
 re-think of the reference's streaming operator DAG (cpp/src/cylon/ops/,
 SURVEY.md §2 C9)."""
 
-from .pipeline import chunk_table, pipelined_join  # noqa: F401
+from .pipeline import GroupBySink, chunk_table, pipelined_join  # noqa: F401

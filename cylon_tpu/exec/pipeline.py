@@ -42,7 +42,7 @@ from ..status import InvalidError
 shard_map = jax.shard_map
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _chunk_fn(mesh: Mesh, cap: int, step: int):
     """Per-shard dynamic slice [start, start+step) of every column."""
 
@@ -86,6 +86,79 @@ def chunk_table(table: Table, n_chunks: int) -> list[Table]:
             cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
         out.append(Table(cols, table.env, vc.astype(np.int64)))
     return out
+
+
+class GroupBySink:
+    """Streaming groupby consumer for :func:`pipelined_join` — the
+    downstream ``Op`` of the reference's dis-join DAG (dis_join_op.hpp:44
+    feeding a groupby op through its queue).
+
+    Each joined chunk is partially aggregated (and released); ``finalize``
+    combines the partials.  Ops must decompose through PUBLIC aggregations
+    of their partials: sum/count/min/max/mean (mean = sum & count).
+    var/std need a sum-of-squares intermediate the public surface does not
+    expose — use ``groupby_aggregate`` on a materialized table for those.
+
+    Usage::
+
+        sink = GroupBySink("k", [("a", "sum"), ("b", "mean")])
+        pipelined_join(lt, rt, "k", "k", n_chunks=8, sink=sink)
+        out = sink.finalize()          # Table, same schema as the
+                                       # monolithic groupby_aggregate
+    """
+
+    _DECOMP = {"sum": ("sum",), "count": ("count",), "min": ("min",),
+               "max": ("max",), "mean": ("sum", "count")}
+    _COMBINE = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+    def __init__(self, by, aggs):
+        self.by = [by] if isinstance(by, str) else list(by)
+        self.aggs = list(aggs)
+        for col, op, *_ in self.aggs:
+            if op not in self._DECOMP:
+                raise InvalidError(
+                    f"GroupBySink does not support {op!r}; supported: "
+                    f"{sorted(self._DECOMP)}")
+        # one partial agg per distinct (col, intermediate-op)
+        self._chunk_aggs = sorted({(c, i) for c, op, *_ in self.aggs
+                                   for i in self._DECOMP[op]})
+        self._parts: list[Table] = []
+
+    def __call__(self, chunk: Table) -> None:
+        from ..relational.groupby import groupby_aggregate
+        self._parts.append(
+            groupby_aggregate(chunk, self.by, list(self._chunk_aggs)))
+        return None
+
+    def finalize(self) -> Table:
+        from ..relational.groupby import groupby_aggregate
+        if not self._parts:
+            raise InvalidError("GroupBySink saw no chunks")
+        partial = concat_tables(self._parts) if len(self._parts) > 1 \
+            else self._parts[0]
+        self._parts = []
+        combine = [(f"{c}_{i}", self._COMBINE[i]) for c, i in
+                   self._chunk_aggs]
+        comb = groupby_aggregate(partial, self.by, combine)
+        # final columns in requested order, renamed to the public contract
+        from ..frame import DataFrame
+        df = DataFrame(_table=comb)
+        out_cols = list(self.by)
+        # means first: they READ sum/count intermediates that a sibling
+        # sum/count agg over the same column will rename away below
+        for col, op, *_ in self.aggs:
+            if op == "mean":
+                df[f"{col}_mean"] = (df[f"{col}_sum_sum"]
+                                     / df[f"{col}_count_sum"])
+        for col, op, *_ in self.aggs:
+            name = f"{col}_{op}"
+            if op != "mean":
+                i = self._DECOMP[op][0]
+                df = df.rename({f"{col}_{i}_{self._COMBINE[i]}": name})
+            out_cols.append(name)
+        out = df[out_cols]._table
+        out.grouped_by = None  # combine order is chunk-partial order
+        return out
 
 
 def pipelined_join(left: Table, right: Table, left_on, right_on,

@@ -31,6 +31,26 @@ from .status import CylonKeyError, InvalidError
 __all__ = ["DataFrame", "GroupByDataFrame", "concat", "read_pandas"]
 
 
+def _check_join_algorithm(algorithm: str) -> None:
+    """The reference's SORT|HASH join choice (join_config.hpp:25,37).  On
+    TPU the single-sort merge dominates a hash build/probe at every
+    build-side size (measured v5e: ≥15.5 ns/row per random probe gather vs
+    ~3.5 ns/row sort operand + ~1.7/payload lane; see docs/DESIGN.md
+    "HASH join option"), so "hash" warns and runs the sort path."""
+    if algorithm == "sort":
+        return
+    if algorithm == "hash":
+        import warnings
+        warnings.warn(
+            "algorithm='hash' is not implemented on TPU: a hash probe "
+            "costs >=15.5 ns/row (random gather) vs ~3.5 ns/row for a "
+            "sort operand, so the single-sort merge join is used instead "
+            "(see docs/DESIGN.md)", UserWarning, stacklevel=3)
+        return
+    raise InvalidError(f"algorithm must be 'sort' or 'hash', got "
+                       f"{algorithm!r}")
+
+
 def _resolve_env(df_env: CylonEnv, env: CylonEnv | None) -> CylonEnv:
     return env if env is not None else df_env
 
@@ -267,7 +287,15 @@ class DataFrame:
     def merge(self, right: "DataFrame", how: str = "inner", on=None,
               left_on=None, right_on=None, suffixes=("_x", "_y"),
               env: CylonEnv | None = None, algorithm: str = "sort") -> "DataFrame":
-        """pandas.merge parity (reference frame.py:1852 + dispatch :2063)."""
+        """pandas.merge parity (reference frame.py:1852 + dispatch :2063).
+
+        ``algorithm``: the reference offers SORT|HASH (join_config.hpp:25);
+        on TPU every join runs the single-sort merge — a hash build/probe
+        needs ≥1 random gather per probe row (~15.5 ns/row measured on
+        v5e) while a sort operand costs ~3.5 ns/row, so the sort path
+        dominates at every build-side size (docs/DESIGN.md).  Passing
+        ``algorithm="hash"`` warns and uses sort."""
+        _check_join_algorithm(algorithm)
         env = _resolve_env(self.env, env)
         lhs, rhs = self._to_env(env), right._to_env(env)
         if on is not None:
@@ -286,7 +314,9 @@ class DataFrame:
              lsuffix: str = "l", rsuffix: str = "r",
              env: CylonEnv | None = None, algorithm: str = "sort") -> "DataFrame":
         """Key-based join with suffixed columns (reference frame.py:1723
-        joins add suffixes to every overlapping column, keys kept apart)."""
+        joins add suffixes to every overlapping column, keys kept apart).
+        ``algorithm`` as in :meth:`merge`."""
+        _check_join_algorithm(algorithm)
         env = _resolve_env(self.env, env)
         lhs, oth = self._to_env(env), other._to_env(env)
         if on is None:

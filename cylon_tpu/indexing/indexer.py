@@ -32,8 +32,14 @@ RANGE_INDEX = "__range__"
 def _label_mask(col, labels) -> Any:
     """Device bool mask: row's index value in ``labels``."""
     if col.type == LogicalType.STRING:
-        codes = []
+        from ..core.column import HashedStrings
         d = col.dictionary
+        if isinstance(d, HashedStrings):
+            # label equality on hashed codes: hash the labels (equality is
+            # an op the hashed path supports; order-based slicing is not)
+            codes = d.hash_values(list(labels))
+            return jnp.isin(col.data, np.asarray(codes, np.int64))
+        codes = []
         for lb in labels:
             pos = int(np.searchsorted(d, lb))
             if pos < len(d) and d[pos] == lb:

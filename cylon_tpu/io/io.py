@@ -141,12 +141,59 @@ def write_json(table: Table, path, **kwargs) -> None:
 
 
 def _shard_frames(table: Table):
-    """Yield (rank, pandas frame of that shard's valid prefix)."""
-    from ..relational import slice_table
-    off = 0
-    for i, n in enumerate(table.valid_counts):
-        yield i, slice_table(table, off, int(n)).to_pandas()
-        off += int(n)
+    """Yield (rank, pandas frame of that shard's valid prefix), STREAMING:
+    one shard resident on the host at a time, pulled straight from each
+    column's per-shard device buffer (``addressable_shards``) in one
+    batched fetch — no whole-table materialization, no device compute
+    (the reference writes strictly per rank, distributed_io.py:344).
+    Under multi-controller execution only this process's shards yield."""
+    import jax
+    import pandas as pd
+    from ..core.column import Column
+    from ..utils.host import host_arrays
+    cols = dict(table.columns)
+    cap = max(table.capacity, 1)
+    # the ranks THIS process writes come from the mesh (single source of
+    # truth for single- and multi-controller), not from any column's shard
+    # layout — columns may be host numpy or replicated
+    me = jax.process_index()
+    mesh_devs = list(np.ravel(table.env.mesh.devices))
+    ranks = [i for i, d in enumerate(mesh_devs)
+             if getattr(d, "process_index", 0) == me]
+
+    def getter(arr):
+        """rank -> that rank's row block, without pulling other ranks."""
+        if arr is None:
+            return lambda i: None
+        if isinstance(arr, np.ndarray):
+            return lambda i: arr[i * cap:(i + 1) * cap]
+        shards, whole = {}, None
+        for s in arr.addressable_shards:
+            st = s.index[0].start if s.index else None
+            if s.data.shape[0] == arr.shape[0]:
+                whole = s.data          # replicated / single-shard world
+            else:
+                shards[int(st) // cap] = s.data
+        if shards:
+            return lambda i: shards[i]
+        return lambda i: whole[i * cap:(i + 1) * cap]
+
+    getters = [(n, c, getter(c.data), getter(c.validity))
+               for n, c in cols.items()]
+    for i in ranks:
+        n_live = int(table.valid_counts[i])
+        flat = []
+        for _, _, gd, gv in getters:
+            flat.append(gd(i))
+            flat.append(gv(i))
+        pulled = host_arrays(flat)
+        data = {}
+        for j, (name, c, _, _) in enumerate(getters):
+            d = np.asarray(pulled[2 * j])[:n_live]
+            v = pulled[2 * j + 1]
+            v = np.asarray(v)[:n_live] if v is not None else None
+            data[name] = Column(d, c.type, v, c.dictionary).to_numpy(n_live)
+        yield i, pd.DataFrame(data)
 
 
 def _dist_path(path: str, rank: int) -> str:

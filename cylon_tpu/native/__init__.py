@@ -1,0 +1,96 @@
+"""Native (C++) runtime helpers.
+
+The compute path is JAX/XLA; host-side hot loops that the reference
+implements in C++ (murmur3 row hashing, util/murmur3.cpp; the non-fixed-
+width key flattener, util/flatten_array.cpp) get native equivalents here,
+compiled on demand with the system toolchain and loaded through ctypes —
+no pybind11 dependency.
+
+Current components:
+
+* ``strhash`` — MurmurHash64A over Arrow string buffers (strhash.cpp),
+  the encode-time hot loop of the high-cardinality string-key path
+  (:meth:`cylon_tpu.core.column.Column._encode_strings`).  Falls back to
+  pandas' stable SipHash (``pd.util.hash_array``) when no C++ toolchain
+  is available.  The chosen implementation is fixed per process at first
+  use; both are process-stable, so multi-controller runs code identical
+  strings identically as long as all processes resolve the same
+  implementation (same image → same toolchain).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB = None           # ctypes CDLL once built/loaded
+_LIB_TRIED = False
+
+
+def _build_and_load():
+    """Compile strhash.cpp to a shared object (cached beside the source
+    when writable, else in a temp dir) and load it."""
+    src = os.path.join(_HERE, "strhash.cpp")
+    so = os.path.join(_HERE, "_strhash.so")
+    if not os.path.exists(so) or \
+            os.path.getmtime(so) < os.path.getmtime(src):
+        # compile to a temp file, then atomically os.replace into place: a
+        # failed/interrupted g++ must never leave a fresh-mtime partial .so
+        # (it would silently disable the native hash forever after — and
+        # worse, differently per process in multi-controller runs)
+        build_dir = _HERE if os.access(_HERE, os.W_OK) \
+            else tempfile.mkdtemp(prefix="cylon_tpu_")
+        tmp = os.path.join(build_dir, "_strhash.tmp.so")
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
+             "-o", tmp],
+            check=True, capture_output=True)
+        final = os.path.join(build_dir, "_strhash.so")
+        os.replace(tmp, final)
+        so = final
+    lib = ctypes.CDLL(so)
+    lib.cylon_hash_strings.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.cylon_hash_strings.restype = None
+    return lib
+
+
+def native_available() -> bool:
+    global _LIB, _LIB_TRIED
+    if not _LIB_TRIED:
+        _LIB_TRIED = True
+        try:
+            _LIB = _build_and_load()
+        except Exception:  # noqa: BLE001 — no toolchain / sandboxed fs
+            _LIB = None
+    return _LIB is not None
+
+
+def hash_strings(values: np.ndarray) -> np.ndarray:
+    """Stable 64-bit hash per UTF-8 string value (object/str array in,
+    uint64 out).  Native murmur64a over Arrow string buffers when the
+    toolchain is available; pandas' stable hash otherwise."""
+    if native_available():
+        import pyarrow as pa
+        arr = pa.array(values, type=pa.large_string())
+        if arr.null_count:
+            arr = arr.fill_null("")
+        bufs = arr.buffers()  # [validity, offsets(int64), data]
+        offsets = np.frombuffer(bufs[1], dtype=np.int64,
+                                count=len(arr) + 1, offset=8 * arr.offset)
+        data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+            else np.zeros(1, np.uint8)
+        out = np.empty(len(arr), np.uint64)
+        _LIB.cylon_hash_strings(
+            data.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(len(arr)),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    import pandas as pd
+    return pd.util.hash_array(np.asarray(values, dtype=object))

@@ -1,0 +1,79 @@
+// Native string hashing for the high-cardinality string-key path.
+//
+// TPU-native equivalent of the reference's row-hash machinery for
+// non-fixed-width keys (cpp/src/cylon/util/murmur3.cpp + the multi-column
+// flattener util/flatten_array.cpp): variable-length UTF-8 values are
+// flattened host-side into (data buffer, offsets) — exactly Arrow's string
+// layout, so pyarrow buffers feed this zero-copy — and each value maps to
+// a stable 64-bit hash used as its device-side code.  Joins/groupbys/set
+// ops compare the codes (two u32 lanes on device); raw values stay host
+// side and materialize through a hash->value lookup.
+//
+// Hash: MurmurHash64A (Austin Appleby's public-domain algorithm) with a
+// fixed seed — stable across processes, which multi-controller execution
+// requires (every process must code identical strings identically).
+//
+// Build: g++ -O3 -shared -fPIC strhash.cpp -o _strhash.so   (see loader in
+// cylon_tpu/native/__init__.py; falls back to pandas' stable hash_array
+// when no toolchain is present).
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+inline uint64_t murmur64a(const void* key, int len, uint64_t seed) {
+  const uint64_t m = 0xc6a4a7935bd1e995ULL;
+  const int r = 47;
+  uint64_t h = seed ^ (static_cast<uint64_t>(len) * m);
+
+  const uint8_t* data = static_cast<const uint8_t*>(key);
+  const uint8_t* end = data + (len & ~7);
+
+  while (data != end) {
+    uint64_t k;
+    __builtin_memcpy(&k, data, 8);
+    data += 8;
+    k *= m;
+    k ^= k >> r;
+    k *= m;
+    h ^= k;
+    h *= m;
+  }
+
+  switch (len & 7) {
+    case 7: h ^= static_cast<uint64_t>(data[6]) << 48; [[fallthrough]];
+    case 6: h ^= static_cast<uint64_t>(data[5]) << 40; [[fallthrough]];
+    case 5: h ^= static_cast<uint64_t>(data[4]) << 32; [[fallthrough]];
+    case 4: h ^= static_cast<uint64_t>(data[3]) << 24; [[fallthrough]];
+    case 3: h ^= static_cast<uint64_t>(data[2]) << 16; [[fallthrough]];
+    case 2: h ^= static_cast<uint64_t>(data[1]) << 8;  [[fallthrough]];
+    case 1: h ^= static_cast<uint64_t>(data[0]);
+            h *= m;
+  }
+
+  h ^= h >> r;
+  h *= m;
+  h ^= h >> r;
+  return h;
+}
+
+constexpr uint64_t kSeed = 0x43594c4f4e545055ULL;  // "CYLONTPU"
+
+}  // namespace
+
+extern "C" {
+
+// Hash n UTF-8 values laid out Arrow-style: value i occupies
+// data[offsets[i] .. offsets[i+1]).  offsets has n+1 entries (int64 —
+// pyarrow large_string).  out receives n uint64 hashes.
+void cylon_hash_strings(const uint8_t* data, const int64_t* offsets,
+                        int64_t n, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t lo = offsets[i];
+    const int64_t hi = offsets[i + 1];
+    out[i] = murmur64a(data + lo, static_cast<int>(hi - lo), kSeed);
+  }
+}
+
+}  // extern "C"

@@ -46,7 +46,7 @@ ROW = P(ROW_AXIS)
 REP = P()
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _allgather_fn(mesh: Mesh, w: int, cap: int, out_cap: int, ncols: int):
     def per_shard(vc, *cols):
         k = jnp.arange(w * cap, dtype=jnp.int32)
@@ -70,7 +70,7 @@ def _allgather_fn(mesh: Mesh, w: int, cap: int, out_cap: int, ncols: int):
                              out_specs=(ROW,) * ncols))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _bcast_fn(mesh: Mesh, root: int, ncols: int):
     def per_shard(*cols):
         outs = []
@@ -99,7 +99,7 @@ def _identity_for(op: str, dtype):
     return jnp.asarray(big if op == "min" else small, dtype)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _allreduce_fn(mesh: Mesh, op: str, ncols: int):
     def per_shard(vc, *cols):
         my = jax.lax.axis_index(ROW_AXIS)

@@ -43,7 +43,7 @@ shard_map = jax.shard_map
 # Phase A: target computation + count matrix
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _hash_targets_fn(mesh: Mesh, w: int, nkeys: int, with_valids: bool):
     def per_shard(vc, *keys):
         cap = keys[0].shape[0]
@@ -77,7 +77,7 @@ def hash_targets(mesh: Mesh, key_datas, key_valids, valid_counts: np.ndarray):
     return _hash_targets_fn(mesh, w, len(key_datas), with_valids)(vc, *args)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _count_fn(mesh: Mesh, w: int):
     def per_shard(tgt):
         counts = jax.ops.segment_sum(
@@ -95,7 +95,7 @@ def count_targets(mesh: Mesh, tgt) -> np.ndarray:
     return host_array(_count_fn(mesh, w)(tgt))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _skew_targets_fn(mesh: Mesh, w: int, k_heavy: int, with_valid: bool):
     """Targets for a skew-split probe side: heavy-key rows spread evenly
     over all ranks (round-robin by global position) instead of hashing —
@@ -150,7 +150,7 @@ def skew_targets(mesh: Mesh, key_data, key_valid, valid_counts: np.ndarray,
 # stays at W·block ≈ one shard's worth regardless of skew.
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _prep_fn(mesh: Mesh, w: int):
     """Per shard: stable order rows by destination once; reused each round.
     Returns (tgt_s, perm, pos): sorted targets, source permutation, and the
@@ -173,7 +173,7 @@ def _prep_fn(mesh: Mesh, w: int):
                              out_specs=(P(ROW_AXIS),) * 3))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int):
     """One exchange round: select this round's position window, all-to-all,
     scatter received rows into their final output slots."""
@@ -215,7 +215,7 @@ def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int):
     return jax.jit(fn, donate_argnums=(5,))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _alloc_fn(mesh: Mesh, out_cap: int, dtype: str, extra_shape: tuple):
     def per_shard():
         return jnp.zeros((out_cap,) + extra_shape, jnp.dtype(dtype))

@@ -43,6 +43,37 @@ class BoundedCache(dict):
         self[key] = value
 
 
+def is_oom(e: Exception) -> bool:
+    """Device out-of-memory, as surfaced by XLA/PJRT."""
+    s = str(e)
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s)
+
+
+def run_with_oom_fallback(primary, can_fallback: bool, fallback, label: str):
+    """``primary()`` with chunked-streaming OOM retries: on device OOM
+    (and ``can_fallback``) run ``fallback(n_chunks)`` at growing chunk
+    counts; non-OOM errors always propagate.  Shared by join_tables and
+    groupby_aggregate — one retry policy, two operators."""
+    try:
+        return primary()
+    except Exception as e:  # noqa: BLE001
+        if not is_oom(e) or not can_fallback:
+            raise
+        from ..utils.logging import log
+        last = e
+        for nc in (4, 16):
+            log.warning("%s OOM (%s); retrying via streaming fallback "
+                        "with %d chunks", label, type(e).__name__, nc)
+            try:
+                return fallback(nc)
+            except Exception as e2:  # noqa: BLE001
+                if not is_oom(e2):
+                    raise
+                last = e2
+        raise last
+
+
 def sample_positions(n, m: int, cap: int) -> jax.Array:
     """m evenly spaced in-range row positions over a live prefix of traced
     length ``n`` (float stride: arange(m)*n would overflow int32 under
@@ -123,10 +154,38 @@ def promote_key_pair(a: Column, b: Column) -> tuple[Column, Column]:
     return a.cast(lt), b.cast(lt)
 
 
+def to_hashed_strings(c: Column) -> Column:
+    """Re-code a sorted-dictionary string column into hashed-codes space
+    (codes = stable 64-bit value hashes; core.column.HashedStrings) so it
+    can meet a high-cardinality hashed column in a join/set op."""
+    from ..core.column import HashedStrings
+    if isinstance(c.dictionary, HashedStrings):
+        return c
+    from .. import native
+    vals = np.asarray(c.dictionary, dtype=object)
+    hashes = native.hash_strings(vals) if len(vals) \
+        else np.zeros(0, np.uint64)
+    remap = hashes.view(np.int64)
+    data = jnp.take(jnp.asarray(remap),
+                    jnp.clip(c.data, 0, max(len(vals) - 1, 0))) \
+        if len(vals) else jnp.zeros_like(c.data, jnp.int64)
+    return Column(data, LogicalType.STRING, c.validity,
+                  HashedStrings(hashes, vals))
+
+
 def unify_dictionaries(a: Column, b: Column) -> tuple[Column, Column]:
     """Re-code two dictionary-encoded string columns into one shared sorted
     dictionary (codes stay order-isomorphic to the strings, so sorts/joins on
-    codes remain exact)."""
+    codes remain exact).  When either side is hashed (HashedStrings), both
+    land in hashed-codes space — codes are globally comparable by
+    construction (one hash function), only the decode lookups merge."""
+    from ..core.column import HashedStrings
+    if isinstance(a.dictionary, HashedStrings) \
+            or isinstance(b.dictionary, HashedStrings):
+        ah, bh = to_hashed_strings(a), to_hashed_strings(b)
+        merged = ah.dictionary.merged_with(bh.dictionary)
+        return (Column(ah.data, LogicalType.STRING, ah.validity, merged),
+                Column(bh.data, LogicalType.STRING, bh.validity, merged))
     if a.dictionary is b.dictionary or (
             len(a.dictionary) == len(b.dictionary)
             and np.array_equal(a.dictionary, b.dictionary)):
@@ -145,6 +204,14 @@ def unify_dictionaries(a: Column, b: Column) -> tuple[Column, Column]:
 
 def unify_dictionaries_many(cols: list[Column]) -> list[Column]:
     """N-way dictionary unification (used by concat / n-way set ops)."""
+    from ..core.column import HashedStrings
+    if any(isinstance(c.dictionary, HashedStrings) for c in cols):
+        hashed = [to_hashed_strings(c) for c in cols]
+        merged = hashed[0].dictionary
+        for h in hashed[1:]:
+            merged = merged.merged_with(h.dictionary)
+        return [Column(h.data, LogicalType.STRING, h.validity, merged)
+                for h in hashed]
     dicts = [c.dictionary for c in cols]
     if all(d is dicts[0] or np.array_equal(d, dicts[0]) for d in dicts[1:]):
         return list(cols)

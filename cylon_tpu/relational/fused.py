@@ -89,7 +89,7 @@ def _col_entry(state: JoinState, name: str):
     return None
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
               vspecs: tuple, key_cols: tuple, key_narrow: tuple,
               seg_cap: int, ddof: int):

@@ -283,7 +283,7 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
     return inters, key_out, kval_out
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                 narrow: tuple, vspec=None, val_map: tuple = (),
                 pad_lanes: int = 0):
@@ -328,7 +328,7 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                              out_specs=(ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
               pad_lanes: int = 0, use_runs: bool = True):
     """Phase 2 per shard: reduce shuffled intermediates under the new key
@@ -412,7 +412,7 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             narrow: tuple, vnarrow: tuple = (), vspec=None,
             val_map: tuple = (), pad_lanes: int = 0, use_runs: bool = True):
@@ -486,7 +486,7 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _shrink_fn(mesh: Mesh, new_cap: int):
     def per_shard(d):
         return d[:new_cap]
@@ -548,7 +548,26 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     std/nunique/quantile/median.  Returns key columns + one column per agg
     named ``{col}_{op}``.  Null keys form their own group (reference
     semantics: comparators treat nulls as equal).
-    """
+
+    Device OOM falls back to chunked streaming aggregation
+    (exec/pipeline.GroupBySink) when every op decomposes through public
+    partial aggregations (sum/count/min/max/mean)."""
+    from ..exec.pipeline import GroupBySink, chunk_table
+    from .common import run_with_oom_fallback
+
+    def fallback(nc):
+        sink = GroupBySink(by, aggs)
+        for ch in chunk_table(table, nc):
+            sink(ch)
+        return sink.finalize()
+
+    return run_with_oom_fallback(
+        lambda: _groupby_aggregate_impl(table, by, aggs, ddof),
+        can_fallback=all(a[1] in GroupBySink._DECOMP for a in aggs),
+        fallback=fallback, label="groupby")
+
+
+def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     env = table.env
     by = [by] if isinstance(by, str) else list(by)
     specs = _normalize_aggs(aggs)
@@ -562,10 +581,16 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
         return pushed
     by_cols = [table.column(n) for n in by]
     val_cols = [table.column(c) for c, _, _, _ in specs]
+    from ..core.column import HashedStrings
     for (c, op, _, _), col in zip(specs, val_cols):
         if col.type == LogicalType.STRING and op not in ("count", "nunique",
                                                          "min", "max"):
             raise InvalidError(f"agg {op!r} not valid for string column {c!r}")
+        if (col.type == LogicalType.STRING and op in ("min", "max")
+                and isinstance(col.dictionary, HashedStrings)):
+            raise InvalidError(
+                f"agg {op!r} on high-cardinality hashed string column "
+                f"{c!r}: hashed codes carry no lexical order")
     res_types, res_dicts = _result_types(specs, val_cols)
     res_names = [n for _, _, _, n in specs]
     all_assoc = all(op in gbk.ASSOCIATIVE for _, op, _, _ in specs)

@@ -64,7 +64,7 @@ SKEW_SAMPLE = 4096
 SKEW_MAX_KEYS = 8
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _key_sample_fn(mesh: Mesh, m: int, with_valid: bool):
     """Evenly spaced per-shard sample of a key column's live prefix."""
 
@@ -122,7 +122,7 @@ def _heavy_keys(table: Table, key_name: str, env):
     return np.asarray([u for u, _ in heavy[:SKEW_MAX_KEYS]])
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _heavy_flag_fn(mesh: Mesh, k: int, with_valid: bool):
     def per_shard(heavy_vals, key, valid):
         flag = jnp.zeros(key.shape[0], bool)
@@ -230,7 +230,7 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
     return bnd, idx_s, live_cat, pl_s
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _count_fn(mesh: Mesh, how: str, narrow: tuple,
               lspec: lanes.LaneSpec | None = None,
               rspec: lanes.LaneSpec | None = None, all_live: bool = False,
@@ -281,7 +281,7 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
                              out_specs=(ROW,) * n_out))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                     plan: tuple, lspec: lanes.LaneSpec,
                     rspec: lanes.LaneSpec, carry_emit: bool = False,
@@ -364,7 +364,31 @@ def join_tables(left: Table, right: Table, left_on, right_on,
 
     ``assume_colocated=True`` skips the shuffle: the caller guarantees equal
     keys already share a shard on both sides (pipelined execution shuffles
-    the build side once and streams pre-shuffled probe chunks)."""
+    the build side once and streams pre-shuffled probe chunks).
+
+    Device OOM falls back to the streaming chunked pipeline
+    (exec/pipeline.py — the reference's operator-DAG slot) for inner/left
+    joins: the probe side streams through in chunks so sort scratch and
+    per-chunk output each fit; retried at growing chunk counts."""
+    from .common import run_with_oom_fallback
+
+    def fallback(nc):
+        from ..exec.pipeline import pipelined_join
+        return pipelined_join(left, right, left_on, right_on, how=how,
+                              n_chunks=nc, suffixes=suffixes)
+
+    return run_with_oom_fallback(
+        lambda: _join_tables_impl(left, right, left_on, right_on, how,
+                                  suffixes, coalesce_keys, assume_colocated),
+        can_fallback=(how in ("inner", "left") and not assume_colocated
+                      and coalesce_keys),
+        fallback=fallback, label="join")
+
+
+def _join_tables_impl(left: Table, right: Table, left_on, right_on,
+                      how: str = "inner", suffixes=("_x", "_y"),
+                      coalesce_keys: bool = True,
+                      assume_colocated: bool = False) -> Table:
     if how not in HOW:
         raise InvalidError(f"how must be one of {HOW}, got {how!r}")
     env = check_same_env(left, right)
@@ -517,7 +541,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
         _CAP_CACHE.put(cache_key, out_cap)
 
-        def thunk():
+        def materialize_cols():
             with timing.region("join.materialize"):
                 full = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
                                  all_live)(*count_args)
@@ -529,6 +553,21 @@ def join_tables(left: Table, right: Table, left_on, right_on,
             return {nme: Column(d, t, v, dc, bounds=b)
                     for nme, d, v, t, dc, b in
                     zip(names, out_d, out_v, types, dicts, bounds)}
+
+        def thunk():
+            # deferred materialization OOMs outside join_tables' wrapper —
+            # give it the same streaming fallback; a fallback returns a
+            # whole Table, which DeferredTable adopts (layout may differ)
+            from .common import run_with_oom_fallback
+
+            def fb(nc):
+                from ..exec.pipeline import pipelined_join
+                return pipelined_join(left, right, left_on, right_on,
+                                      how=how, n_chunks=nc,
+                                      suffixes=suffixes)
+
+            return run_with_oom_fallback(materialize_cols, True, fb,
+                                         "deferred-join materialize")
 
         from ..core.table import DeferredTable
         from .fused import JoinState

@@ -40,7 +40,7 @@ shard_map = jax.shard_map
 # column flattening for the exchange engine
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _pack_cols_fn(spec):
     from ..ops import lanes
 
@@ -50,7 +50,7 @@ def _pack_cols_fn(spec):
     return jax.jit(fn)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _unpack_cols_fn(spec):
     from ..ops import lanes
 
@@ -150,7 +150,7 @@ def exchange_by_targets(table: Table, tgt, counts: np.ndarray) -> Table:
 # repartition (reference table.cpp:1481, repartition.hpp:94 index math)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _range_targets_fn(mesh: Mesh, cap: int):
     def per_shard(vc, offs, bounds, _probe):
         w = vc.shape[0]
@@ -214,7 +214,7 @@ def repartition(table: Table, rows_per_partition=None) -> Table:
     return exchange_by_targets(table, tgt, counts)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _repad_fn(mesh: Mesh, cap: int, new_cap: int):
     def per_shard(d):
         if new_cap <= cap:
@@ -251,7 +251,7 @@ def repad_table(table: Table, new_cap: int) -> Table:
 # slice / head / tail (reference indexing/slice.cpp:31, table.hpp:512-527)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, spec):
     from ..ops import lanes
 
@@ -306,7 +306,7 @@ def tail(table: Table, n: int) -> Table:
 # row filter (reference: compute.pyx filter path — table[bool_mask])
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _filter_count_fn(mesh: Mesh, cap: int):
     def per_shard(vc, flag):
         mask = live_mask(vc, cap)
@@ -316,7 +316,7 @@ def _filter_count_fn(mesh: Mesh, cap: int):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int, spec):
     from ..ops import lanes
 
@@ -356,7 +356,7 @@ def filter_table(table: Table, flag) -> Table:
 # concat (reference Merge/concat, frame.py:2295)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _concat_fn(mesh: Mesh, caps: tuple, out_cap: int, with_valid: tuple):
     k = len(caps)
 

@@ -50,7 +50,7 @@ def _unique_flags_per_shard(vc, key_datas, key_valids, keep: str):
     return setk.unique_flags(gids, mask, keep), mask
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _unique_count_fn(mesh: Mesh, keep: str):
     def per_shard(vc, key_datas, key_valids):
         flags, _ = _unique_flags_per_shard(vc, key_datas, key_valids, keep)
@@ -60,7 +60,7 @@ def _unique_count_fn(mesh: Mesh, keep: str):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _unique_mat_fn(mesh: Mesh, keep: str, out_cap: int, spec):
     from ..ops import lanes
 
@@ -138,7 +138,7 @@ def _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids,
     return flags
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _setop_count_fn(mesh: Mesh, op: str):
     def per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids):
         flags = _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas,
@@ -150,7 +150,7 @@ def _setop_count_fn(mesh: Mesh, op: str):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _setop_mat_fn(mesh: Mesh, op: str, out_cap: int):
     def per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids):
         flags = _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas,
@@ -205,7 +205,7 @@ def set_operation(a: Table, b: Table, op: str) -> Table:
 # equals (reference table.cpp:1389 Equals / :1440 DistributedEquals)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _equals_fn(mesh: Mesh, kinds: tuple):
     def per_shard(vc, a_datas, a_valids, b_datas, b_valids):
         cap = a_datas[0].shape[0]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import config
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -56,7 +57,7 @@ def _norm_dirs(by, ascending):
     return tuple(not a for a in ascending)
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                    narrow: tuple, vspec, f64_idx: tuple = ()):
     """Per-shard multi-key sort.  Laneable columns RIDE THE SORT as u32
@@ -102,7 +103,7 @@ def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                              out_specs=(ROW, ROW)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int,
                narrow: tuple = ()):
     """Uniform per-shard sample of transformed key operands (reference
@@ -125,7 +126,7 @@ def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int,
                              out_specs=(ROW, ROW)))
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _target_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                narrow: tuple = ()):
     """Per-row destination rank = number of splitters strictly below the row
@@ -180,6 +181,13 @@ def sort_table(table: Table, by, ascending=True,
     descendings = _norm_dirs(by, ascending)
     npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
     by_cols = [table.column(n) for n in by]
+    from ..core.column import HashedStrings
+    for n, c in zip(by, by_cols):
+        if isinstance(c.dictionary, HashedStrings):
+            raise InvalidError(
+                f"sort on high-cardinality hashed string column {n!r} is "
+                "not supported: hashed codes carry no lexical order "
+                "(equality ops — join/groupby/unique/filters — do work)")
     by_datas, by_valids = col_arrays(by_cols)
     vc = np.asarray(table.valid_counts, np.int32)
     w = env.world_size

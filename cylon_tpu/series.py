@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from . import config
 from .core.column import Column
 from .core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from .core.table import Table
@@ -149,6 +150,16 @@ class Series:
         if isinstance(other, str):
             if self._col.type != LogicalType.STRING:
                 raise CylonTypeError("string scalar vs numeric series")
+            from .core.column import HashedStrings
+            if isinstance(self._col.dictionary, HashedStrings):
+                # hashed codes have no lexical order: equality only
+                if fn not in (jnp.equal, jnp.not_equal):
+                    raise CylonTypeError(
+                        "ordered compare on a high-cardinality hashed "
+                        "string column is not supported (== and != work)")
+                h = int(self._col.dictionary.hash_values([other])[0])
+                out = fn(self._col.data, jnp.int64(h))
+                return self._wrap(out, self._col.validity, LogicalType.BOOL)
             # dictionary is sorted, so codes are order-isomorphic to values;
             # absent scalars compare via their insertion point - 0.5 (all
             # comparisons then resolve exactly in float space)
@@ -159,6 +170,17 @@ class Series:
             out = fn(self._col.data.astype(jnp.float64), rhs)
             return self._wrap(out, self._col.validity, LogicalType.BOOL)
         (col, rhs), validity = self._other_operand(other)
+        if fn not in (jnp.equal, jnp.not_equal):
+            # series-vs-series ordered compare: hashed string codes carry
+            # no lexical order (codes would compare by hash — silently
+            # wrong, never allowed)
+            from .core.column import HashedStrings
+            for c in (col, getattr(other, "_col", None)):
+                if c is not None and isinstance(
+                        getattr(c, "dictionary", None), HashedStrings):
+                    raise CylonTypeError(
+                        "ordered compare on a high-cardinality hashed "
+                        "string column is not supported (== and != work)")
         out = fn(col.data, rhs)
         return self._wrap(out, validity, LogicalType.BOOL)
 
@@ -276,7 +298,17 @@ class Series:
         if self._col.type == LogicalType.STRING:
             if not isinstance(value, str):
                 raise CylonTypeError("fill on string series needs str")
+            from .core.column import HashedStrings
             d = self._col.dictionary
+            if isinstance(d, HashedStrings):
+                code = int(d.hash_values([value])[0])
+                newd = d.merged_with(HashedStrings(
+                    np.asarray([code]).astype(np.int64).view(np.uint64),
+                    np.asarray([value], dtype=object)))
+                data = jnp.where(mask, jnp.int64(code), self._col.data)
+                v2 = None if (all_valid or self._col.validity is None) \
+                    else (self._col.validity | mask)
+                return self._wrap(data, v2, LogicalType.STRING, newd)
             pos = int(np.searchsorted(d, value))
             if not (pos < len(d) and d[pos] == value):
                 newd = np.insert(d, pos, value)
@@ -309,6 +341,12 @@ class Series:
         col, valid, lt = self._col, self._valid, self._col.type
         if lt == LogicalType.STRING and kind not in ("count", "min", "max"):
             raise CylonTypeError(f"{kind} on string series")
+        from .core.column import HashedStrings
+        if (lt == LogicalType.STRING and kind in ("min", "max")
+                and isinstance(col.dictionary, HashedStrings)):
+            raise CylonTypeError(
+                f"{kind} on a high-cardinality hashed string series: "
+                "hashed codes carry no lexical order")
         mesh = self._env.mesh
         cap = len(col) // max(valid.shape[0], 1)
         out, cnt = _reduce_fn(mesh, kind, max(cap, 1))(
@@ -331,6 +369,10 @@ class Series:
             return None if lt == LogicalType.STRING else float("nan")
         v = parts[live].min() if kind == "min" else parts[live].max()
         if lt == LogicalType.STRING:
+            from .core.column import HashedStrings
+            if isinstance(self._col.dictionary, HashedStrings):
+                return str(self._col.dictionary.take(
+                    np.asarray([int(v)], np.int64))[0])
             return str(self._col.dictionary[int(v)])
         if lt in (LogicalType.FLOAT32, LogicalType.FLOAT64):
             return float(v)
@@ -365,7 +407,7 @@ class Series:
         return unique_table(t, [self.name]).to_pandas()[self.name].to_numpy()
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _reduce_fn(mesh: Mesh, kind: str, cap: int):
     from .relational.common import REP, ROW, live_mask
 

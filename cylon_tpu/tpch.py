@@ -37,6 +37,10 @@ NATIONS = np.asarray(
 #: n_nationkey -> n_regionkey per the TPC-H spec nation table
 NATION_REGION = np.asarray([0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0,
                             0, 1, 2, 3, 4, 2, 3, 3, 1])
+PRIORITIES = np.asarray(["1-URGENT", "2-HIGH", "3-MEDIUM",
+                         "4-NOT SPECIFIED", "5-LOW"])
+SHIPMODES = np.asarray(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
+                        "TRUCK"])
 
 
 def _ts(date: str) -> int:
@@ -58,8 +62,11 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
 
     customer = pd.DataFrame({
         "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_name": np.char.add("Customer#",
+                              np.arange(n_cust).astype(np.str_)),
         "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)],
         "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
     })
     orders = pd.DataFrame({
         "o_orderkey": np.arange(n_ord, dtype=np.int64),
@@ -67,12 +74,20 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         "o_orderdate": (d0 + rng.integers(0, span, n_ord) * day
                         ).astype("datetime64[ns]"),
         "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+        "o_orderpriority": PRIORITIES[rng.integers(0, len(PRIORITIES),
+                                                   n_ord)],
     })
     l_orderkey = np.repeat(orders["o_orderkey"].to_numpy(), lines_per_order)
     ship_delay = rng.integers(1, 122, n_line) * day
     shipdate = (np.repeat(orders["o_orderdate"].to_numpy(),
                           lines_per_order).astype(np.int64)
                 + ship_delay).astype("datetime64[ns]")
+    commitdate = (shipdate.astype(np.int64)
+                  + rng.integers(-30, 61, n_line) * day
+                  ).astype("datetime64[ns]")
+    receiptdate = (shipdate.astype(np.int64)
+                   + rng.integers(1, 31, n_line) * day
+                   ).astype("datetime64[ns]")
     # returnflag/linestatus per the spec's date rules: lines shipped after
     # the dataset's currentdate-ish cutoff are still Open/None, earlier
     # lines are Fulfilled and split A/R
@@ -89,6 +104,9 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         "l_returnflag": np.where(open_line, "N", np.where(ar == 0, "A", "R")),
         "l_linestatus": np.where(open_line, "O", "F"),
         "l_shipdate": shipdate,
+        "l_commitdate": commitdate,
+        "l_receiptdate": receiptdate,
+        "l_shipmode": SHIPMODES[rng.integers(0, len(SHIPMODES), n_line)],
     })
     supplier = pd.DataFrame({
         "s_suppkey": np.arange(n_supp, dtype=np.int64),
@@ -296,6 +314,147 @@ def q5_pandas(pdfs: dict, region: str = "ASIA", date_lo: str = "1994-01-01",
 
 
 # ---------------------------------------------------------------------------
+# Q4 — order priority checking (EXISTS semi-join)
+# ---------------------------------------------------------------------------
+
+def q4(dfs: dict, env=None, date_lo: str = "1993-07-01",
+       date_hi: str = "1993-10-01"):
+    """SELECT o_orderpriority, count(*) AS order_count FROM orders WHERE
+    o_orderdate >= :lo AND o_orderdate < :hi AND EXISTS (SELECT * FROM
+    lineitem WHERE l_orderkey = o_orderkey AND l_commitdate <
+    l_receiptdate) GROUP BY o_orderpriority ORDER BY o_orderpriority.
+    The EXISTS is a semi-join: dedupe the qualifying lineitem order keys,
+    then inner-merge (reference pattern: DistributedUnique + join)."""
+    o = dfs["orders"]
+    o = o[(o["o_orderdate"] >= _ts(date_lo))
+          & (o["o_orderdate"] < _ts(date_hi))]
+    l = dfs["lineitem"]
+    l = l[l["l_commitdate"] < l["l_receiptdate"]]
+    lk = l[["l_orderkey"]].drop_duplicates(env=env)
+    j = o.merge(lk, left_on="o_orderkey", right_on="l_orderkey", env=env)
+    g = (j.groupby(["o_orderpriority"], env=env)
+         .agg([("o_orderkey", "count")]))
+    out = g.sort_values("o_orderpriority", env=env)
+    return out.rename({"o_orderkey_count": "order_count"})
+
+
+def q4_pandas(pdfs: dict, date_lo: str = "1993-07-01",
+              date_hi: str = "1993-10-01") -> pd.DataFrame:
+    o = pdfs["orders"]
+    o = o[(o.o_orderdate >= pd.Timestamp(date_lo))
+          & (o.o_orderdate < pd.Timestamp(date_hi))]
+    l = pdfs["lineitem"]
+    lk = l[l.l_commitdate < l.l_receiptdate][["l_orderkey"]] \
+        .drop_duplicates()
+    j = o.merge(lk, left_on="o_orderkey", right_on="l_orderkey")
+    g = (j.groupby("o_orderpriority", as_index=False)
+         .agg(order_count=("o_orderkey", "count")))
+    return g.sort_values("o_orderpriority").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q10 — returned item reporting
+# ---------------------------------------------------------------------------
+
+def q10(dfs: dict, env=None, date_lo: str = "1993-10-01",
+        date_hi: str = "1994-01-01", limit: int = 20):
+    """SELECT c_custkey, c_name, sum(l_extendedprice*(1-l_discount)) AS
+    revenue, c_acctbal, n_name FROM customer, orders, lineitem, nation
+    WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey AND
+    o_orderdate >= :lo AND o_orderdate < :hi AND l_returnflag = 'R' AND
+    c_nationkey = n_nationkey GROUP BY c_custkey, c_name, c_acctbal,
+    n_name ORDER BY revenue DESC LIMIT 20."""
+    o = dfs["orders"]
+    o = o[(o["o_orderdate"] >= _ts(date_lo))
+          & (o["o_orderdate"] < _ts(date_hi))]
+    l = dfs["lineitem"]
+    l = l[l["l_returnflag"] == "R"]
+    co = dfs["customer"].merge(o, left_on="c_custkey", right_on="o_custkey",
+                               env=env)
+    col = co.merge(l, left_on="o_orderkey", right_on="l_orderkey", env=env)
+    j = col.merge(dfs["nation"], left_on="c_nationkey",
+                  right_on="n_nationkey", env=env)
+    j["revenue"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    g = (j.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"],
+                   env=env)[["revenue"]].sum())
+    out = g.sort_values(["revenue", "c_custkey"], ascending=[False, True],
+                        env=env).head(limit)
+    return out[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name"]]
+
+
+def q10_pandas(pdfs: dict, date_lo: str = "1993-10-01",
+               date_hi: str = "1994-01-01", limit: int = 20) -> pd.DataFrame:
+    o = pdfs["orders"]
+    o = o[(o.o_orderdate >= pd.Timestamp(date_lo))
+          & (o.o_orderdate < pd.Timestamp(date_hi))]
+    l = pdfs["lineitem"]
+    l = l[l.l_returnflag == "R"]
+    j = (pdfs["customer"]
+         .merge(o, left_on="c_custkey", right_on="o_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+         .merge(pdfs["nation"], left_on="c_nationkey",
+                right_on="n_nationkey"))
+    j["revenue"] = j.l_extendedprice * (1.0 - j.l_discount)
+    g = (j.groupby(["c_custkey", "c_name", "c_acctbal", "n_name"],
+                   as_index=False)["revenue"].sum())
+    g = g.sort_values(["revenue", "c_custkey"],
+                      ascending=[False, True]).head(limit)
+    return g[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name"]] \
+        .reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q12 — shipping modes and order priority
+# ---------------------------------------------------------------------------
+
+def q12(dfs: dict, env=None, mode1: str = "MAIL", mode2: str = "SHIP",
+        date_lo: str = "1994-01-01", date_hi: str = "1995-01-01"):
+    """SELECT l_shipmode, sum(high_line_count), sum(low_line_count) FROM
+    orders, lineitem WHERE o_orderkey = l_orderkey AND l_shipmode IN
+    (:m1, :m2) AND l_commitdate < l_receiptdate AND l_shipdate <
+    l_commitdate AND l_receiptdate >= :lo AND l_receiptdate < :hi GROUP BY
+    l_shipmode ORDER BY l_shipmode; high = priority in (1-URGENT, 2-HIGH)."""
+    l = dfs["lineitem"]
+    sel = (((l["l_shipmode"] == mode1) | (l["l_shipmode"] == mode2))
+           & (l["l_commitdate"] < l["l_receiptdate"])
+           & (l["l_shipdate"] < l["l_commitdate"])
+           & (l["l_receiptdate"] >= _ts(date_lo))
+           & (l["l_receiptdate"] < _ts(date_hi)))
+    lf = l[sel]
+    j = lf.merge(dfs["orders"], left_on="l_orderkey", right_on="o_orderkey",
+                 env=env)
+    high = ((j["o_orderpriority"] == "1-URGENT")
+            | (j["o_orderpriority"] == "2-HIGH"))
+    j["high_line"] = high.astype("int64")
+    j["low_line"] = (~high).astype("int64")
+    g = (j.groupby(["l_shipmode"], env=env)
+         .agg([("high_line", "sum"), ("low_line", "sum")]))
+    out = g.sort_values("l_shipmode", env=env)
+    return out.rename({"high_line_sum": "high_line_count",
+                       "low_line_sum": "low_line_count"})
+
+
+def q12_pandas(pdfs: dict, mode1: str = "MAIL", mode2: str = "SHIP",
+               date_lo: str = "1994-01-01",
+               date_hi: str = "1995-01-01") -> pd.DataFrame:
+    l = pdfs["lineitem"]
+    lf = l[(l.l_shipmode.isin([mode1, mode2]))
+           & (l.l_commitdate < l.l_receiptdate)
+           & (l.l_shipdate < l.l_commitdate)
+           & (l.l_receiptdate >= pd.Timestamp(date_lo))
+           & (l.l_receiptdate < pd.Timestamp(date_hi))]
+    j = lf.merge(pdfs["orders"], left_on="l_orderkey",
+                 right_on="o_orderkey")
+    high = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    g = (j.assign(high_line=high.astype(np.int64),
+                  low_line=(~high).astype(np.int64))
+         .groupby("l_shipmode", as_index=False)
+         .agg(high_line_count=("high_line", "sum"),
+              low_line_count=("low_line", "sum")))
+    return g.sort_values("l_shipmode").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
 # bench entry (bench.py --tpch)
 # ---------------------------------------------------------------------------
 
@@ -323,17 +482,16 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    t1 = run_query(q1)
-    t3 = run_query(q3)
-    t5 = run_query(q5)
-    t6 = run_query(q6)
+    queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
+               "q10": q10, "q12": q12}
+    times = {name: run_query(fn) for name, fn in queries.items()}
     return {
-        "metric": f"TPC-H SF{scale:g} Q1+Q3+Q5+Q6 wall time",
-        "value": round(t1 + t3 + t5 + t6, 4),
+        "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"
+                  " wall time",
+        "value": round(sum(times.values()), 4),
         "unit": "seconds",
         "vs_baseline": 0.0,
         "detail": {"world": env.world_size, "platform": devs[0].platform,
-                   "scale": scale, "q1_s": round(t1, 4),
-                   "q3_s": round(t3, 4), "q5_s": round(t5, 4),
-                   "q6_s": round(t6, 4)},
+                   "scale": scale,
+                   **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }

@@ -205,3 +205,18 @@ def test_df_reductions(env4, rng):
     s = df.sum()
     assert s["a"] == data["a"].sum()
     np.testing.assert_allclose(s["b"], data["b"].sum())
+
+
+def test_merge_algorithm_option(env1):
+    import warnings
+    import cylon_tpu as ct
+    ldf = pd.DataFrame({"k": [1, 2, 3], "a": [1.0, 2.0, 3.0]})
+    rdf = pd.DataFrame({"k": [2, 3, 4], "b": [5, 6, 7]})
+    lf, rf = ct.DataFrame(ldf, env=env1), ct.DataFrame(rdf, env=env1)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = lf.merge(rf, on="k", algorithm="hash").to_pandas()
+    assert any("hash" in str(x.message) for x in w)
+    assert len(out) == 2
+    with pytest.raises(Exception):
+        lf.merge(rf, on="k", algorithm="bogus")

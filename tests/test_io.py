@@ -91,3 +91,34 @@ def test_write_dist(tmp_path, env4, data):
     back2 = pd.concat([pd.read_parquet(f) for f in pfiles],
                       ignore_index=True)
     pd.testing.assert_frame_equal(back2, data, check_dtype=False)
+
+
+def test_dist_writers_stream_per_shard(tmp_path, env8, rng):
+    """write_*_dist must pull one shard at a time (no whole-table
+    to_pandas): spy on Table.to_pandas and round-trip a table whose
+    whole-table materialization is forbidden."""
+    import cylon_tpu as ct
+    from cylon_tpu.io import io as cio
+    n = 16000
+    df = pd.DataFrame({"k": np.arange(n, dtype=np.int64),
+                       "s": np.asarray(["x", "y", "z"])[
+                           rng.integers(0, 3, n)],
+                       "v": rng.random(n)})
+    t = ct.Table.from_pandas(df, env8)
+
+    def boom(self):
+        raise AssertionError("dist writer materialized the whole table")
+
+    orig = ct.Table.to_pandas
+    ct.Table.to_pandas = boom
+    try:
+        files = cio.write_parquet_dist(t, str(tmp_path / "part.parquet"))
+        cfiles = cio.write_csv_dist(t, str(tmp_path / "part.csv"))
+    finally:
+        ct.Table.to_pandas = orig
+    assert len(files) == 8 and len(cfiles) == 8
+    back = pd.concat([pd.read_parquet(f) for f in files],
+                     ignore_index=True)
+    pd.testing.assert_frame_equal(
+        back.sort_values("k").reset_index(drop=True),
+        df.sort_values("k").reset_index(drop=True), check_dtype=False)

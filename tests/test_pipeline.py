@@ -87,3 +87,89 @@ def test_pipelined_groupby_sink_combines(env4, rng):
     exp = (ldf.merge(rdf, on="k").groupby("k", as_index=False)
            .agg(a_sum_sum=("a", "sum"), b_sum_sum=("b", "sum")))
     assert_table_matches(got, exp)
+
+
+class TestGroupBySink:
+    def test_sink_matches_monolithic(self, env4, rng):
+        import cylon_tpu as ct
+        from cylon_tpu.exec import GroupBySink, pipelined_join
+        from cylon_tpu.relational import groupby_aggregate, join_tables
+        n = 8000
+        ldf = pd.DataFrame({"k": rng.integers(0, 900, n).astype(np.int64),
+                            "a": rng.integers(0, 50, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.integers(0, 900, n).astype(np.int64),
+                            "b": rng.integers(0, 50, n).astype(np.int64)})
+        lt, rt = ct.Table.from_pandas(ldf, env4), ct.Table.from_pandas(rdf, env4)
+        aggs = [("a", "sum"), ("b", "mean"), ("a", "min"), ("b", "max"),
+                ("a", "count")]
+        sink = GroupBySink("k", aggs)
+        pipelined_join(lt, rt, "k", "k", n_chunks=5, sink=sink)
+        got = sink.finalize().to_pandas().sort_values("k").reset_index(drop=True)
+        mono = groupby_aggregate(join_tables(lt, rt, "k", "k"), "k", aggs)
+        exp = mono.to_pandas().sort_values("k").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-12)
+
+    def test_sink_rejects_var(self):
+        from cylon_tpu.exec import GroupBySink
+        from cylon_tpu.status import InvalidError
+        with pytest.raises(InvalidError):
+            GroupBySink("k", [("a", "var")])
+
+
+class TestOOMFallback:
+    def _data(self, env, rng, n=6000):
+        import cylon_tpu as ct
+        ldf = pd.DataFrame({"k": rng.integers(0, 700, n).astype(np.int64),
+                            "a": rng.integers(0, 50, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.integers(0, 700, n).astype(np.int64),
+                            "b": rng.integers(0, 50, n).astype(np.int64)})
+        return (ldf, rdf, ct.Table.from_pandas(ldf, env),
+                ct.Table.from_pandas(rdf, env))
+
+    def test_join_oom_falls_back_to_pipeline(self, env4, rng, monkeypatch):
+        from cylon_tpu.relational import join as rj
+        ldf, rdf, lt, rt = self._data(env4, rng)
+        calls = {"n": 0}
+        orig = rj._join_tables_impl
+
+        def flaky(*a, **k):
+            # OOM on the top-level attempt; chunk joins (assume_colocated)
+            # succeed
+            if not k.get("assume_colocated") and len(a) < 8:
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return orig(*a, **k)
+
+        monkeypatch.setattr(rj, "_join_tables_impl", flaky)
+        j = rj.join_tables(lt, rt, "k", "k", how="inner")
+        got = j.to_pandas().sort_values(["k", "a", "b"]).reset_index(drop=True)
+        exp = ldf.merge(rdf, on="k").sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                      check_dtype=False)
+
+    def test_groupby_oom_falls_back_to_chunked(self, env4, rng, monkeypatch):
+        import cylon_tpu as ct
+        from cylon_tpu.relational import groupby as rg
+        ldf, rdf, lt, rt = self._data(env4, rng)
+        t = ct.Table.from_pandas(ldf, env4)
+        calls = {"n": 0}
+        orig = rg._groupby_aggregate_impl
+
+        def flaky(table, by, aggs, ddof=1):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return orig(table, by, aggs, ddof)
+
+        monkeypatch.setattr(rg, "_groupby_aggregate_impl", flaky)
+        g = rg.groupby_aggregate(t, "k", [("a", "sum"), ("a", "mean")])
+        got = g.to_pandas().sort_values("k").reset_index(drop=True)
+        exp = (ldf.groupby("k", as_index=False)
+               .agg(a_sum=("a", "sum"), a_mean=("a", "mean")))
+        exp.columns = got.columns
+        pd.testing.assert_frame_equal(got, exp.sort_values("k")
+                                      .reset_index(drop=True),
+                                      check_dtype=False, rtol=1e-12)
+        assert calls["n"] > 1

@@ -67,3 +67,31 @@ def test_q6_matches_pandas(env):
     got = tpch.q6(dfs, env=env)
     exp = tpch.q6_pandas(pdfs)
     assert abs(got - exp) <= 1e-6 * max(abs(exp), 1.0), (got, exp)
+
+
+def test_q4_matches_pandas(env):
+    pdfs = tpch.generate_pandas(scale=0.005, seed=7)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = tpch.q4(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q4_pandas(pdfs)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_q10_matches_pandas(env):
+    pdfs = tpch.generate_pandas(scale=0.01, seed=8)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = tpch.q10(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q10_pandas(pdfs)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_q12_matches_pandas(env):
+    pdfs = tpch.generate_pandas(scale=0.01, seed=9)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = tpch.q12(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q12_pandas(pdfs)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
