@@ -37,6 +37,12 @@ if "--cpu-mesh" in sys.argv:
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
+if "--cpu-mesh" in sys.argv:
+    # a TPU plugin may already be registered at interpreter start (axon
+    # sitecustomize), which overrides the env var; the config knob still
+    # wins while no backend has been initialized (same as tests/conftest)
+    jax.config.update("jax_platforms", "cpu")
+
 #: reference anchor: Summit weak scaling, 1M rows/rank/iter at 0.60 s
 #: (BASELINE.md summit results-1000000) => rows/sec/worker
 BASELINE_ROWS_PER_SEC_PER_WORKER = 1_000_000 / 0.60
@@ -56,10 +62,14 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.utils import timing
 
-    devs = jax.devices()
-    on_accel = devs[0].platform != "cpu"
-    cfg = TPUConfig() if on_accel else CPUMeshConfig()
+    if os.environ.get("CYLON_TPU_DISTRIBUTED", "0") == "1":
+        # multi-host pod launch (deploy/): form the world first
+        cfg = TPUConfig(distributed=True)
+    else:
+        cfg = TPUConfig() if jax.devices()[0].platform != "cpu" \
+            else CPUMeshConfig()
     env = ct.CylonEnv(config=cfg)
+    devs = jax.devices()
     w = env.world_size
 
     n = rows_per_chip * w

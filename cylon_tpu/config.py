@@ -20,6 +20,20 @@ X64_ENABLED = os.environ.get("CYLON_TPU_X64", "1") != "0"
 if X64_ENABLED:
     jax.config.update("jax_enable_x64", True)
 
+# Persistent compiled-program cache: TPC-H-class workloads compile dozens
+# of distinct programs and remote TPU compiles cost seconds-to-minutes
+# each; the persistent cache makes every rerun warm (verified working over
+# the axon remote-compile tunnel).  Opt out with CYLON_TPU_COMPILE_CACHE=0.
+_CACHE_DIR = os.environ.get("CYLON_TPU_COMPILE_CACHE",
+                            os.path.expanduser("~/.cache/cylon_tpu/jax"))
+if _CACHE_DIR not in ("", "0"):
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — read-only fs: run uncached
+        pass
+
 
 def _env_flag(name: str, default: bool) -> bool:
     v = os.environ.get(name)

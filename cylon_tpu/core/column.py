@@ -150,7 +150,24 @@ class Column:
             mask = np.zeros(len(arr), bool)
         safe = np.where(mask, "", arr.astype(object)) if mask.any() else arr
 
+        import decimal
+
         def as_str(v):
+            # documented rejection (SURVEY C6: the reference's comparators
+            # span every Arrow type incl. lists, join_test.cpp:124): nested
+            # and decimal values have no TPU device layout here — refuse
+            # loudly instead of silently stringifying a wrong answer.
+            # Enforced on EVERY converted value (the str fast paths below
+            # cannot hold nested values).
+            if isinstance(v, (list, tuple, dict, np.ndarray)):
+                raise CylonTypeError(
+                    "list/struct columns are not supported on the TPU "
+                    "device layout; explode or serialize them before "
+                    "ingest")
+            if isinstance(v, decimal.Decimal):
+                raise CylonTypeError(
+                    "decimal columns are not supported; cast to float64 "
+                    "(or scaled int64) before ingest")
             if isinstance(v, (bytes, np.bytes_)):
                 return v.decode("utf-8", "replace")
             return str(v)

@@ -45,7 +45,11 @@ def _build_and_load():
         # worse, differently per process in multi-controller runs)
         build_dir = _HERE if os.access(_HERE, os.W_OK) \
             else tempfile.mkdtemp(prefix="cylon_tpu_")
-        tmp = os.path.join(build_dir, "_strhash.tmp.so")
+        # per-process tmp name: concurrent first-use builds (multi-rank
+        # launch) must not clobber each other mid-write — a truncated .so
+        # would silently drop one rank to the fallback hash and diverge
+        # string codes across ranks
+        tmp = os.path.join(build_dir, f"_strhash.tmp.{os.getpid()}.so")
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
              "-o", tmp],

