@@ -88,6 +88,49 @@ def chunk_table(table: Table, n_chunks: int) -> list[Table]:
     return out
 
 
+def pipelined_set_op(a: Table, b: Table, op: str, n_chunks: int = 4):
+    """Streaming chunked set operation — the reference's ``DisSetOp``
+    pipeline stage (cpp/src/cylon/ops/dis_set_op.hpp) re-thought: the
+    resident side ``b`` shuffles ONCE, ``a`` streams through in row
+    chunks (each chunk shuffled in the loop, interleaving exchange with
+    compute — ``a`` is never held shuffled in full), and per-chunk
+    partials combine under one final distinct pass:
+
+    union:      distinct(a ∪ b) = unique(concat(unique(chunk_i)…, unique(b)))
+    subtract:   rows of a not in b — per-chunk subtract vs resident b,
+                then distinct across chunks (a row can recur in chunks)
+    intersect:  symmetric to subtract.
+
+    No sink form: set semantics need the cross-chunk distinct pass, so
+    partials are not independently consumable.  Peak extra memory is the
+    partials (each ≤ one chunk) plus the final distinct input.
+    """
+    from ..relational.setops import _align_schemas, _set_operation_impl, \
+        unique_table
+    if op not in ("union", "intersect", "subtract"):
+        raise InvalidError(f"unknown set op {op!r}")
+    env = check_same_env(a, b)
+    a, b = _align_schemas(a, b)
+    names = a.column_names
+    if env.world_size > 1 and op != "union":
+        b = shuffle_table(b, names)     # resident side: ONCE
+    parts = []
+    for chunk in chunk_table(a, n_chunks):
+        if op == "union":
+            # unique_table shuffles internally; a pre-shuffle of `a`
+            # would be a redundant third pass over its rows
+            parts.append(unique_table(chunk))
+        else:
+            if env.world_size > 1:
+                chunk = shuffle_table(chunk, names)
+            parts.append(_set_operation_impl(chunk, b, op,
+                                             assume_colocated=True))
+    if op == "union":
+        parts.append(unique_table(b))
+    combined = concat_tables(parts) if len(parts) > 1 else parts[0]
+    return unique_table(combined)
+
+
 class GroupBySink:
     """Streaming groupby consumer for :func:`pipelined_join` — the
     downstream ``Op`` of the reference's dis-join DAG (dis_join_op.hpp:44

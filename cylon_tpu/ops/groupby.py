@@ -148,7 +148,8 @@ def _u32(x):
 
 def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
                    key_valids, seg_cap: int, key_narrow=None,
-                   value_narrow=None, pad_lanes: int = 0):
+                   value_narrow=None, pad_lanes: int = 0,
+                   gather_parts: int = 1):
     """Grouped-input fast path, fully batched: per-group sums for the
     cumsum-able ops (sum/count/mean/var/std) AND the representative-key
     gather share ONE u32 lane-matrix gather (plus one f64 side gather when
@@ -254,6 +255,24 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
         g_next = jnp.concatenate([g[1:], tailv], axis=0)
         return g, g_next
 
+    def gather_pair_multi(cols):
+        """gather_pair split into ``gather_parts`` narrower matrix
+        gathers, columns re-concatenated in order — another shape-shifting
+        variant for the XLA:TPU compiler-crash ladder (specific full-width
+        combinations crash; the narrower parts compile)."""
+        parts = min(gather_parts, len(cols))
+        if parts <= 1:
+            return gather_pair(cols)
+        bounds = np.linspace(0, len(cols), parts + 1).astype(int)
+        gs, gns = [], []
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi > lo:
+                g, gn = gather_pair(list(cols[lo:hi]))
+                gs.append(g)
+                gns.append(gn)
+        return (jnp.concatenate(gs, axis=1),
+                jnp.concatenate(gns, axis=1))
+
     if pad_lanes:
         # XLA:TPU compiler landmine: specific (u32, f64) gather-lane width
         # combinations SIGSEGV tpu_compile_helper (v5e libtpu 2026-07; e.g.
@@ -262,9 +281,9 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
         u32_cols = u32_cols + [jnp.zeros(n + 1, jnp.uint32)] * pad_lanes
     g_u = gn_u = g_f = gn_f = None
     if u32_cols:
-        g_u, gn_u = gather_pair(u32_cols)
+        g_u, gn_u = gather_pair_multi(u32_cols)
     if f64_cols:
-        g_f, gn_f = gather_pair(f64_cols)
+        g_f, gn_f = gather_pair_multi(f64_cols)
 
     def prefix_recon(lane_ids, meta, at_next: bool):
         """Gathered prefix lanes -> accumulator value (i32/i64/f32/f64)."""

@@ -43,6 +43,16 @@ _VALID_OPS = gbk.ASSOCIATIVE | gbk.NON_ASSOCIATIVE
 #: callsite-signature -> last observed group-count bucket
 _SEG_CACHE = BoundedCache()
 
+#: optimistic first-dispatch segment space for large-cap groupbys with no
+#: hysteresis prediction yet: small enough that the dense one-hot regime
+#: stays at its ~9 ns/row flat cost — scatter-heavy
+#: programs at multi-10M shapes have pathological XLA:TPU compile times
+#: (observed 50+ min), while the dense form compiles in seconds.  A
+#: mispredict (more groups than this) is detected via the returned
+#: n_groups and re-dispatched at the true bucket (see the dispatch
+#: comment in _groupby_aggregate_impl).
+_FIRST_SEG_CAP = 512
+
 #: program-signature -> first ladder attempt index that compiled (see
 #: :func:`_pad_ladder`)
 _PAD_CACHE = BoundedCache()
@@ -248,7 +258,7 @@ def _sort_state(vc, by_datas, by_valids, val_datas, val_valids, narrow,
 
 def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
                  seg_cap, by_datas, by_valids, narrow, vnarrow,
-                 pad_lanes: int = 0):
+                 pad_lanes: int = 0, gather_parts: int = 1):
     """Per-op intermediate dicts + representative keys for run-contiguous
     (grouped or freshly sorted) input: every cumsum-able intermediate AND
     the min/max ops' counts ride grouped_reduce's single prefix-diff
@@ -269,7 +279,8 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
         [vmasks[b[1]] for b in batch], starts, n_live,
         list(by_datas), list(by_valids), seg_cap, key_narrow=narrow,
         value_narrow=[(bool(vnarrow[b[1]]) if vnarrow else False)
-                      for b in batch], pad_lanes=pad_lanes)
+                      for b in batch], pad_lanes=pad_lanes,
+        gather_parts=gather_parts)
     inters: dict = {}
     for (op, i), d in zip(batch, inters_b):
         inters.setdefault(i, {}).update(d)
@@ -286,7 +297,7 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                 narrow: tuple, vspec=None, val_map: tuple = (),
-                pad_lanes: int = 0):
+                pad_lanes: int = 0, gather_parts: int = 1):
     """Phase 1 per shard: group keys, reduce each (col, op) into
     intermediate arrays of static length seg_cap (rank-ordered dense
     prefix), gather per-group key representatives.  With ``vspec`` the
@@ -311,7 +322,7 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
         if first is not None:
             inters, key_out, kval_out = _runs_reduce(
                 ops, val_datas, vmasks, gids, first, mask, vc, seg_cap,
-                by_datas, by_valids, narrow, (), pad_lanes)
+                by_datas, by_valids, narrow, (), pad_lanes, gather_parts)
             inter_out = [tuple(inters[i][k] for k in INTER_NAMES[op])
                          for i, op in enumerate(ops)]
         else:
@@ -330,7 +341,8 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
-              pad_lanes: int = 0, use_runs: bool = True):
+              pad_lanes: int = 0, use_runs: bool = True,
+              gather_parts: int = 1):
     """Phase 2 per shard: reduce shuffled intermediates under the new key
     grouping, finalize each op.
 
@@ -384,7 +396,8 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
         inters_b, key_out, kval_out = gbk.grouped_reduce(
             ["sum"] * len(sum_idx), [s_arrs[j] for j in sum_idx],
             [mask] * len(sum_idx), starts, n_live, list(s_by), list(s_byv),
-            seg_cap, key_narrow=narrow, pad_lanes=pad_lanes)
+            seg_cap, key_narrow=narrow, pad_lanes=pad_lanes,
+            gather_parts=gather_parts)
         red_flat = [None] * len(flat_arrs)
         for j, d in zip(sum_idx, inters_b):
             red_flat[j] = d["sum"]
@@ -415,7 +428,8 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             narrow: tuple, vnarrow: tuple = (), vspec=None,
-            val_map: tuple = (), pad_lanes: int = 0, use_runs: bool = True):
+            val_map: tuple = (), pad_lanes: int = 0, use_runs: bool = True,
+            gather_parts: int = 1):
     """Single-phase per shard over raw (already co-located) rows — used for
     non-associative ops, the local path, and the grouped-input fast path
     (join/sort output: no shuffle, no rank sort).  ``vnarrow``: host-proven
@@ -458,7 +472,7 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             batched, key_out, kval_out = _runs_reduce(
                 tuple(op for op, _ in specs), val_datas, vmasks, gids,
                 first, mask, vc, seg_cap, by_datas, by_valids, narrow,
-                vnarrow, pad_lanes)
+                vnarrow, pad_lanes, gather_parts)
         else:
             key_out, kval_out = _rep_keys(by_datas, by_valids, gids, seg_cap)
         res_d, res_v = [], []
@@ -613,20 +627,48 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
         uval_valids = tuple(c.validity for c in uval_cols)
         vc = np.asarray(table.valid_counts, np.int32)
         ops_t = tuple(op for _, op, _, _ in specs)
-        seg_cap = max(table.capacity, 1)
+        cap_full = max(table.capacity, 1)
         cspec = _plan_vspec(uval_cols, by_cols, narrow,
                             sum(len(INTER_NAMES[op]) for op in ops_t))
         cargs = (vc, by_datas, by_valids, uval_datas, uval_valids)
-        attempts = [(f"sort+pad{p}",
-                     lambda p=p: _combine_fn(env.mesh, ops_t, seg_cap, False,
-                                             narrow, cspec, val_map, p)(*cargs))
-                    for p in (0, 1, 2)] if cspec is not None else []
-        attempts.append(
-            ("scatter", lambda: _combine_fn(env.mesh, ops_t, seg_cap, False,
-                                            narrow, None, val_map)(*cargs)))
-        key_out, kval_out, inter_out, n_groups = _pad_ladder(
-            ("combine", env.serial, ops_t, narrow, cspec), attempts)
+
+        def combine_call(sc):
+            attempts = ([(f"sort+pad{p}",
+                          lambda p=p: _combine_fn(env.mesh, ops_t, sc,
+                                                  False, narrow, cspec,
+                                                  val_map, p)(*cargs))
+                         for p in (0, 1)]
+                        + [("sort+split2",
+                            lambda: _combine_fn(env.mesh, ops_t, sc, False,
+                                                narrow, cspec, val_map, 0,
+                                                2)(*cargs))]) \
+                if cspec is not None else []
+            attempts.append(
+                ("scatter",
+                 lambda: _combine_fn(env.mesh, ops_t, sc, False, narrow,
+                                     None, val_map)(*cargs)))
+            return _pad_ladder(("combine", env.serial, ops_t, narrow, cspec),
+                               attempts)
+
+        # same first-sight/hysteresis segment-space discipline as the raw
+        # path (multi-10M-segment programs have pathological compile times)
+        seg_key1 = ("combine-seg", env.serial, ops_t, tuple(by), narrow,
+                    cap_full, int(table.valid_counts.sum()))
+        pred1 = _SEG_CACHE.get(seg_key1)
+        if pred1 is not None and pred1 < cap_full:
+            seg_cap = pred1
+        elif pred1 is None and cap_full > _FIRST_SEG_CAP:
+            seg_cap = _FIRST_SEG_CAP
+        else:
+            seg_cap = cap_full
+        key_out, kval_out, inter_out, n_groups = combine_call(seg_cap)
         n_groups = host_array(n_groups).astype(np.int64)
+        ng_cap1 = min(config.pow2ceil(int(n_groups.max()) if n_groups.size
+                                      else 1), cap_full)
+        if ng_cap1 > seg_cap:
+            key_out, kval_out, inter_out, n_groups = combine_call(ng_cap1)
+            n_groups = host_array(n_groups).astype(np.int64)
+        _SEG_CACHE.put(seg_key1, ng_cap1)
         # intermediate table: keys + flat intermediate columns
         cols = {}
         for n, c, d, v in zip(by, by_cols, key_out, kval_out):
@@ -653,7 +695,11 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
         fattempts = [(f"sort+pad{p}",
                       lambda p=p: _final_fn(env.mesh, ops_t, fin_cap, ddof,
                                             narrow, p)(*fargs))
-                     for p in (0, 1, 2)]
+                     for p in (0, 1)]
+        fattempts.append(
+            ("sort+split2", lambda: _final_fn(env.mesh, ops_t, fin_cap,
+                                              ddof, narrow, 0, True,
+                                              2)(*fargs)))
         fattempts.append(
             ("scatter", lambda: _final_fn(env.mesh, ops_t, fin_cap, ddof,
                                           narrow, 0, False)(*fargs)))
@@ -714,7 +760,11 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
                      lambda p=p: _raw_fn(env.mesh, spec_t, sc, ddof, grouped,
                                          narrow, vnarrow, vspec, val_map,
                                          p)(*args))
-                    for p in (0, 1, 2)]
+                    for p in (0, 1)]
+        attempts.append(
+            ("sort+split2",
+             lambda: _raw_fn(env.mesh, spec_t, sc, ddof, grouped, narrow,
+                             vnarrow, vspec, val_map, 0, True, 2)(*args)))
         attempts.append(
             ("scatter", lambda: _raw_fn(env.mesh, spec_t, sc, ddof, grouped,
                                         narrow, vnarrow, None, val_map, 0,
@@ -723,7 +773,18 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
                             vnarrow, vspec), attempts)
 
     with timing.region("groupby.raw"):
-        seg_cap = pred if (pred is not None and pred < cap_full) else cap_full
+        if pred is not None and pred < cap_full:
+            seg_cap = pred
+        elif pred is None and cap_full > _FIRST_SEG_CAP:
+            # first sight of a large-cap groupby: dispatch at a modest
+            # segment space — most groupbys have far fewer groups than
+            # rows, and multi-10M-segment programs have pathological
+            # XLA:TPU compile times (observed: 50+ min at a 33M segment
+            # space that compiles in seconds at 1M).  A mispredict is
+            # detected via n_groups and re-dispatched at the true bucket.
+            seg_cap = _FIRST_SEG_CAP
+        else:
+            seg_cap = cap_full
         res = raw_call(seg_cap)
         n_groups = host_array(res[4]).astype(np.int64)
         ng_cap = min(config.pow2ceil(int(n_groups.max()) if n_groups.size

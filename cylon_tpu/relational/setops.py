@@ -176,16 +176,35 @@ def _setop_mat_fn(mesh: Mesh, op: str, out_cap: int):
                              out_specs=(ROW, ROW)))
 
 
-def set_operation(a: Table, b: Table, op: str) -> Table:
+def set_operation(a: Table, b: Table, op: str,
+                  assume_colocated: bool = False) -> Table:
     """union/intersect/subtract with distinct-row semantics (reference
     table.cpp:925-1110).  Distributed path shuffles both tables by full-row
-    hash first (:1152-1166)."""
+    hash first (:1152-1166).  ``assume_colocated=True`` skips the shuffle
+    AND schema alignment (pipelined execution pre-aligns and shuffles the
+    resident side once, exec/pipeline.pipelined_set_op).
+
+    Device OOM falls back to the streaming chunked pipeline."""
+    from .common import run_with_oom_fallback
+
+    def fb(nc):
+        from ..exec.pipeline import pipelined_set_op
+        return pipelined_set_op(a, b, op, n_chunks=nc)
+
+    return run_with_oom_fallback(
+        lambda: _set_operation_impl(a, b, op, assume_colocated),
+        can_fallback=not assume_colocated, fallback=fb, label="set_op")
+
+
+def _set_operation_impl(a: Table, b: Table, op: str,
+                        assume_colocated: bool = False) -> Table:
     if op not in ("union", "intersect", "subtract"):
         raise InvalidError(f"unknown set op {op!r}")
     env = check_same_env(a, b)
-    a, b = _align_schemas(a, b)
+    if not assume_colocated:
+        a, b = _align_schemas(a, b)
     names = a.column_names
-    if env.world_size > 1:
+    if env.world_size > 1 and not assume_colocated:
         a = shuffle_table(a, names)
         b = shuffle_table(b, names)
     a_datas, a_valids = col_arrays([a.column(n) for n in names])

@@ -459,6 +459,23 @@ def q12_pandas(pdfs: dict, mode1: str = "MAIL", mode2: str = "SHIP",
 # ---------------------------------------------------------------------------
 
 def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
+    """Runs the 7-query suite at ``scale``; on device OOM the scale halves
+    (the whole-working-set analog of bench.py's rows halving: TPC-H keeps
+    every base table plus query intermediates resident, so past the HBM
+    ceiling no operator-level chunking can save a single chip — the
+    deploy story for SF10+ is a pod slice, deploy/README.md)."""
+    from cylon_tpu.relational.common import is_oom
+    while True:
+        try:
+            return _bench_tpch_once(scale, iters)
+        except Exception as e:  # noqa: BLE001
+            if not is_oom(e) or scale <= 0.02:
+                raise
+            scale = scale / 2
+            print(f"# TPC-H OOM; retrying at SF{scale:g}", flush=True)
+
+
+def _bench_tpch_once(scale: float, iters: int) -> dict:
     import jax
     import cylon_tpu as ct
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig

@@ -10,6 +10,10 @@ ZONE="${2:?zone}"
 CMD="${3:?command to run}"
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
 
+# clear any previous sync first: scp into an EXISTING directory would nest
+# the new copy inside it and silently keep running the stale first sync
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone="$ZONE" --worker=all \
+  --command="rm -rf ~/cylon_tpu_run"
 gcloud compute tpus tpu-vm scp --recurse "$REPO_DIR" \
   "$TPU_NAME":~/cylon_tpu_run --zone="$ZONE" --worker=all
 

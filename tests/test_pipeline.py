@@ -173,3 +173,49 @@ class TestOOMFallback:
                                       .reset_index(drop=True),
                                       check_dtype=False, rtol=1e-12)
         assert calls["n"] > 1
+
+
+class TestPipelinedSetOps:
+    @pytest.mark.parametrize("op", ["union", "intersect", "subtract"])
+    @pytest.mark.parametrize("world", ["env1", "env4"])
+    def test_matches_monolithic(self, op, world, request, rng):
+        import cylon_tpu as ct
+        from cylon_tpu.exec import pipelined_set_op
+        from cylon_tpu.relational import set_operation
+        env = request.getfixturevalue(world)
+        adf = pd.DataFrame({"k": rng.integers(0, 120, 3000).astype(np.int64),
+                            "v": rng.integers(0, 4, 3000).astype(np.int64)})
+        bdf = pd.DataFrame({"k": rng.integers(0, 120, 900).astype(np.int64),
+                            "v": rng.integers(0, 4, 900).astype(np.int64)})
+        at, bt = ct.Table.from_pandas(adf, env), ct.Table.from_pandas(bdf, env)
+        got = pipelined_set_op(at, bt, op, n_chunks=3).to_pandas()
+        exp = set_operation(at, bt, op).to_pandas()
+        key = ["k", "v"]
+        got = got.sort_values(key).reset_index(drop=True)
+        exp = exp.sort_values(key).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_setop_oom_falls_back(self, env4, rng, monkeypatch):
+        import cylon_tpu as ct
+        from cylon_tpu.relational import setops as rs
+        adf = pd.DataFrame({"k": rng.integers(0, 80, 2000).astype(np.int64)})
+        bdf = pd.DataFrame({"k": rng.integers(0, 80, 500).astype(np.int64)})
+        at, bt = ct.Table.from_pandas(adf, env4), ct.Table.from_pandas(bdf, env4)
+        calls = {"n": 0}
+        orig = rs._set_operation_impl
+
+        def flaky(a, b, op, assume_colocated=False):
+            calls["n"] += 1
+            if calls["n"] == 1 and not assume_colocated:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return orig(a, b, op, assume_colocated)
+
+        # pipelined_set_op resolves _set_operation_impl at call time from
+        # the setops module, so this single patch covers both paths
+        monkeypatch.setattr(rs, "_set_operation_impl", flaky)
+        got = rs.set_operation(at, bt, "subtract").to_pandas()
+        A, B = adf.drop_duplicates(), bdf.drop_duplicates()
+        exp = A.merge(B, on="k", how="left", indicator=True)
+        exp = exp[exp._merge == "left_only"][["k"]]
+        assert sorted(got["k"].tolist()) == sorted(exp["k"].tolist())
+        assert calls["n"] > 1
