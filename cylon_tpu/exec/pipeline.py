@@ -251,9 +251,17 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     for chunk in chunk_table(lwork, n_chunks):
         if env.world_size > 1:
             chunk = shuffle_table(chunk, left_on)
-        # chunk and rwork are now co-located: plain local join
+        # chunk and rwork are now co-located: plain local join, EAGER
+        # (allow_defer=False).  Measured at the out-of-HBM scale this
+        # pipeline targets (96M rows/side, v5e 16GB): deferring chunk
+        # joins so the sink's groupby consumes the fused pre-expansion
+        # state OOMs — the fused kernel's temporaries span the full
+        # (chunk + resident build) concat rows and dwarf the expanded
+        # chunk output the eager path holds instead; eager chunks
+        # complete (40.1 s at 96M/side, results/tpu_v5e_pipelined.jsonl).
         res = join_tables(chunk, rwork, left_on, right_on, how=how,
-                          suffixes=suffixes, assume_colocated=True)
+                          suffixes=suffixes, assume_colocated=True,
+                          allow_defer=False)
         outs.append(sink(res) if sink is not None else res)
     if sink is not None:
         return outs

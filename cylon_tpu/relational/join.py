@@ -357,7 +357,8 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
 def join_tables(left: Table, right: Table, left_on, right_on,
                 how: str = "inner", suffixes=("_x", "_y"),
                 coalesce_keys: bool = True,
-                assume_colocated: bool = False) -> Table:
+                assume_colocated: bool = False,
+                allow_defer: bool | None = None) -> Table:
     """Join two tables. Distributed path = hash-shuffle both sides on the
     (promoted) keys, then per-shard local sort-join — the reference's exact
     skeleton (table.cpp:861,219,194).
@@ -379,7 +380,8 @@ def join_tables(left: Table, right: Table, left_on, right_on,
 
     return run_with_oom_fallback(
         lambda: _join_tables_impl(left, right, left_on, right_on, how,
-                                  suffixes, coalesce_keys, assume_colocated),
+                                  suffixes, coalesce_keys, assume_colocated,
+                                  allow_defer),
         can_fallback=(how in ("inner", "left") and not assume_colocated
                       and coalesce_keys),
         fallback=fallback, label="join")
@@ -388,7 +390,8 @@ def join_tables(left: Table, right: Table, left_on, right_on,
 def _join_tables_impl(left: Table, right: Table, left_on, right_on,
                       how: str = "inner", suffixes=("_x", "_y"),
                       coalesce_keys: bool = True,
-                      assume_colocated: bool = False) -> Table:
+                      assume_colocated: bool = False,
+                      allow_defer: bool | None = None) -> Table:
     if how not in HOW:
         raise InvalidError(f"how must be one of {HOW}, got {how!r}")
     env = check_same_env(left, right)
@@ -529,8 +532,15 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     # access materializes transparently (core.table.DeferredTable).  Phase 1
     # runs SLIM (no carry outputs, ~5 N-length HBM buffers freed) — a later
     # materialization re-runs it un-slim against the compiled cache.
+    # allow_defer default: colocated (pipelined chunk) joins only defer
+    # when the caller says a fused consumer will drain each chunk's state
+    # immediately (pipelined_join with a sink).  The sink-less concat path
+    # would retain every chunk's slim state simultaneously alongside the
+    # resident build side — the HBM headroom the pipeline exists to keep.
+    if allow_defer is None:
+        allow_defer = not assume_colocated
     defer = (config.DEFER_JOIN and how == "inner" and carry_emit
-             and carry_match and coalesce and not skew_split)
+             and carry_match and coalesce and not skew_split and allow_defer)
     if defer:
         with timing.region("join.sort_count"):
             res = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
