@@ -171,7 +171,8 @@ class JoinTake(NamedTuple):
 
 def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
               extra: tuple = (), carry_emit: bool = False,
-              carry_match: bool = False) -> JoinTake:
+              carry_match: bool = False, emit_idx: bool = False,
+              match_idx: bool = False) -> JoinTake:
     """Phase-2 materialization over ``out_cap`` static output slots
     (``out_cap`` >= phase 1's total; slots past ``total`` are invalid).
 
@@ -196,6 +197,11 @@ def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
       * ``how == "inner"``: every emitted slot is a real match, so
         ``matched == valid`` and the per-group match count drops out of the
         meta stack entirely.
+      * ``emit_idx``/``match_idx`` (carry-LITE, f64 columns): laneable
+        columns ride the sort but f64 cannot (TPU bitcast/sort-payload
+        SIGSEGV), so the corresponding take array is kept alongside the
+        carried lanes — the caller gathers just the f64 side columns by
+        index.
     """
     offs, eff, cnt, mstart, idx_s, un = carry
     n = offs.shape[0]
@@ -211,7 +217,7 @@ def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
     p_of_k = jax.lax.cummax(p0)
 
     need_cnt = how != "inner"
-    need_own_idx = not carry_emit
+    need_own_idx = (not carry_emit) or emit_idx
     meta_cols = [offs, mstart]
     if need_cnt:
         meta_cols.append(cnt)
@@ -231,7 +237,7 @@ def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
         jax.lax.bitcast_convert_type(meta[:, ci + int(need_own_idx) + j],
                                      jnp.uint32)
         for j in range(len(extra)))
-    m_idx = None if carry_match else idx_s[mpos]
+    m_idx = None if (carry_match and not match_idx) else idx_s[mpos]
 
     l_take = r_take = None
     if how == "right":

@@ -161,6 +161,22 @@ def unpack_lanes(spec: LaneSpec, mat):
     return tuple(datas), tuple(valids)
 
 
+def gather_laneless(spec: LaneSpec, datas, take) -> dict:
+    """{col_index: gathered data} for ONLY the laneless (f64) columns of
+    ``spec`` — one batched (n, K) f64 matrix gather.  Used by the join's
+    carry-LITE path: laneable columns ride the sort, f64 columns gather
+    by take index."""
+    idxs = [i for i, c in enumerate(spec.cols) if not c.lanes]
+    if not idxs:
+        return {}
+    n = datas[idxs[0]].shape[0]
+    sel = jnp.clip(take, 0, max(n - 1, 0))
+    if len(idxs) == 1:
+        return {idxs[0]: datas[idxs[0]][sel]}
+    fmat = jnp.stack([datas[i] for i in idxs], axis=1)[sel]
+    return {i: fmat[:, j] for j, i in enumerate(idxs)}
+
+
 def gather_columns(spec: LaneSpec, datas, valids, take):
     """Move whole rows by index: ONE (n, L) matrix gather for every laneable
     column + validity bits, plus ONE (n, K) f64 matrix gather batching all
@@ -178,11 +194,6 @@ def gather_columns(spec: LaneSpec, datas, valids, take):
     else:
         out_d = [None] * len(spec.cols)
         out_v = [None] * len(spec.cols)
-    laneless = [i for i, col in enumerate(spec.cols) if not col.lanes]
-    if len(laneless) == 1:
-        out_d[laneless[0]] = datas[laneless[0]][sel]
-    elif laneless:
-        fmat = jnp.stack([datas[i] for i in laneless], axis=1)[sel]
-        for j, i in enumerate(laneless):
-            out_d[i] = fmat[:, j]
+    for i, d in gather_laneless(spec, datas, take).items():
+        out_d[i] = d
     return tuple(out_d), tuple(out_v)

@@ -211,11 +211,15 @@ def try_join_groupby_pushdown(table: Table, by: list, specs: list,
         ent = _col_entry(state, col)
         if ent is None:
             return None
+        spec = state.lspec if ent[0] == "l" else state.rspec
+        if not spec.cols[ent[1]].lanes:
+            return None   # carry-lite f64 column: not in the sorted lanes
         vspecs.append((ent[0], ent[1], op))
     key_cols, key_narrow = [], []
     for k in by:
         ent = _col_entry(state, k)
-        if ent is None or ent[0] != "l":
+        if ent is None or ent[0] != "l" \
+                or not state.lspec.cols[ent[1]].lanes:
             return None
         key_cols.append(ent[1])
         key_narrow.append(bool(state.lspec.cols[ent[1]].narrow))

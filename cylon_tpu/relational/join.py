@@ -298,16 +298,26 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
     same way and rides join_take's meta-stack gather — no separate left
     gather at all.  Both only for how in (inner, left)."""
 
+    l_f64 = any(not c.lanes for c in lspec.cols)
+    r_f64 = any(not c.lanes for c in rspec.cols)
+
     def per_shard(carry, pl_s, l_cols, l_valids, r_cols, r_valids):
         n_e = lspec.n_lanes if carry_emit else 0
         pl_e, pl_m = pl_s[:n_e], pl_s[n_e:]
         tk = joink.join_take(joink.JoinCarry(*carry), cap_l, how, out_cap,
                              extra=pl_e, carry_emit=carry_emit,
-                             carry_match=carry_match)
+                             carry_match=carry_match,
+                             emit_idx=carry_emit and l_f64,
+                             match_idx=carry_match and r_f64)
         if carry_emit:
             emat = jnp.stack(tk.extra, axis=1)      # already at out slots
             ldat, lval = lanes.unpack_lanes(lspec, emat)
             l_ok = tk.valid
+            if l_f64:   # carry-lite: f64 columns gather by take index
+                ldat = list(ldat)
+                for i, d in lanes.gather_laneless(lspec, l_cols,
+                                                  tk.l_take).items():
+                    ldat[i] = d
         else:
             ldat, lval = lanes.gather_columns(lspec, l_cols, l_valids,
                                               tk.l_take)
@@ -317,6 +327,11 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
             rrows = smat[jnp.clip(tk.mpos, 0, smat.shape[0] - 1)]
             rdat, rval = lanes.unpack_lanes(rspec, rrows)
             r_ok = tk.matched
+            if r_f64:
+                rdat = list(rdat)
+                for i, d in lanes.gather_laneless(rspec, r_cols,
+                                                  tk.r_take).items():
+                    rdat[i] = d
         else:
             rdat, rval = lanes.gather_columns(rspec, r_cols, r_valids,
                                               tk.r_take)
@@ -501,8 +516,11 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     # right lane-matrix gathers; carry_emit (left side) folds the left
     # values into the meta-stack gather join_take already performs.
     def _can_carry(spec, col_list, budget: int) -> bool:
+        # laneless f64 columns do not disqualify (carry-LITE: laneable
+        # columns ride the sort, f64 columns keep their take-index
+        # gathers); there must be at least one laneable data column
         return bool(how in ("inner", "left") and col_list
-                    and all(c.lanes for c in spec.cols)
+                    and any(c.lanes for c in spec.cols)
                     and spec.n_lanes <= budget)
 
     carry_match = _can_carry(rspec, r_cols_list, 8)

@@ -180,3 +180,39 @@ def test_fused_ddof(env4, rng):
                a_std=("a", lambda x: x.std(ddof=0))))
     eg.columns = ["k", "a_var", "a_std"]
     assert_table_matches(g, eg)
+
+
+def test_f64_columns_carry_lite(env4, rng):
+    """Carry-LITE: f64 output columns no longer disqualify the join's lane
+    carriage — the join defers, laneable columns ride the sort, f64
+    columns gather by take index.  A pushdown over an f64 value column is
+    gated (not in the sorted lanes) and falls back to materialization."""
+    n = 5000
+    ldf = pd.DataFrame({"k": rng.integers(0, 600, n).astype(np.int64),
+                        "a": rng.integers(0, 50, n).astype(np.int64),
+                        "x": rng.random(n)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 600, n).astype(np.int64),
+                        "b": rng.integers(0, 50, n).astype(np.int64),
+                        "y": rng.random(n)})
+    lt = ct.Table.from_pandas(ldf, env4)
+    rt = ct.Table.from_pandas(rdf, env4)
+    j = join_tables(lt, rt, "k", "k", how="inner")
+    assert isinstance(j, DeferredTable) and not j.materialized
+    ej = ldf.merge(rdf, on="k")
+    # pushdown over the laneable column only: stays deferred
+    g1 = groupby_aggregate(j, "k", [("a", "sum")])
+    assert not j.materialized
+    e1 = ej.groupby("k", as_index=False).agg(a_sum=("a", "sum"))
+    assert_table_matches(g1, e1)
+    # f64 value column: gated out of the pushdown, materializes, correct
+    g2 = groupby_aggregate(j, "k", [("x", "sum"), ("y", "mean")])
+    assert j.materialized
+    e2 = ej.groupby("k", as_index=False).agg(x_sum=("x", "sum"),
+                                             y_mean=("y", "mean"))
+    assert_table_matches(g2, e2)
+    # full materialized join equals pandas (f64 columns via carry-lite)
+    keycols = ["k", "a", "x", "b", "y"]
+    got = j.to_pandas().sort_values(keycols).reset_index(drop=True)
+    exp = ej.sort_values(keycols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got[exp.columns], exp, check_dtype=False,
+                                  rtol=1e-12)
