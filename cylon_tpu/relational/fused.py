@@ -39,6 +39,7 @@ from jax.sharding import Mesh
 
 from .. import config
 from ..core.column import Column
+from ..core.dtypes import LogicalType
 from ..core.table import DeferredTable, Table
 from ..ctx.context import ROW_AXIS
 from ..ops import groupby as gbk
@@ -92,7 +93,8 @@ def _col_entry(state: JoinState, name: str):
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
               vspecs: tuple, key_cols: tuple, key_narrow: tuple,
-              seg_cap: int, ddof: int):
+              seg_cap: int, ddof: int, pad_lanes: int = 0,
+              gather_parts: int = 1):
     """Per-shard fused join+groupby kernel.
 
     ``vspecs``: per aggregation (side, lane_col_idx, op); ``key_cols``:
@@ -163,7 +165,8 @@ def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
         key_valids = [lval[ci] for ci in key_cols]
         inters, key_out, kval_out = gbk.grouped_reduce(
             ops_list, vals, masks, starts, jnp.int32(N), key_datas,
-            key_valids, seg_cap, key_narrow=key_narrow)
+            key_valids, seg_cap, key_narrow=key_narrow,
+            pad_lanes=pad_lanes, gather_parts=gather_parts)
         l_cnt = inters[-2]["count"]
         r_cnt = inters[-1]["count"]
 
@@ -211,6 +214,13 @@ def try_join_groupby_pushdown(table: Table, by: list, specs: list,
         ent = _col_entry(state, col)
         if ent is None:
             return None
+        # string value columns carry dictionary CODES in the lanes;
+        # aggregating codes would silently return garbage.  Bail to the
+        # normal path, whose validation raises the same InvalidError the
+        # materialized path does (only count is code-independent).
+        if (state.types[state.names.index(col)] == LogicalType.STRING
+                and op != "count"):
+            return None
         spec = state.lspec if ent[0] == "l" else state.rspec
         if not spec.cols[ent[1]].lanes:
             return None   # carry-lite f64 column: not in the sorted lanes
@@ -244,19 +254,44 @@ def try_join_groupby_pushdown(table: Table, by: list, specs: list,
            int(state.vcl.sum()), int(state.vcr.sum()), ddof)
     pred = _SEG_CACHE.get(sig)
 
+    from .groupby import _FIRST_SEG_CAP, _is_compiler_crash, _pad_ladder
+
     def call(sc):
-        return _fused_fn(env.mesh, state.cap_l, state.all_live, state.lspec,
-                         state.rspec, tuple(vspecs), tuple(key_cols),
-                         tuple(key_narrow), sc, ddof)(*args)
+        # same compiler-crash ladder as every other grouped_reduce dispatch
+        # site: dummy gather lanes shift a SIGSEGV-ing lane width, then a
+        # split gather — a still-crashing spec bails to the materialize path
+        def disp(pad, parts=1):
+            return _fused_fn(env.mesh, state.cap_l, state.all_live,
+                             state.lspec, state.rspec, tuple(vspecs),
+                             tuple(key_cols), tuple(key_narrow), sc,
+                             ddof, pad, parts)(*args)
+
+        attempts = [(f"fused+pad{p}", lambda p=p: disp(p)) for p in (0, 1)]
+        attempts.append(("fused+split2", lambda: disp(0, 2)))
+        return _pad_ladder(("fused", env.serial, tuple(vspecs),
+                            tuple(key_cols), tuple(key_narrow)), attempts)
 
     with timing.region("groupby.fused"):
-        seg_cap = pred if (pred is not None and pred < cap_total) \
-            else config.pow2ceil(cap_total)
-        res = call(seg_cap)
-        n_groups = host_array(res[4]).astype(np.int64)
-        ng_cap = config.pow2ceil(int(n_groups.max()) if n_groups.size else 1)
-        if ng_cap > seg_cap:
-            res = call(ng_cap)
+        # first sight of a large state: dispatch at a modest segment space
+        # (multi-10M-segment programs have pathological XLA:TPU compile
+        # times); the returned n_groups detects a mispredict
+        if pred is not None and pred < cap_total:
+            seg_cap = pred
+        elif pred is None and cap_total > _FIRST_SEG_CAP:
+            seg_cap = _FIRST_SEG_CAP
+        else:
+            seg_cap = config.pow2ceil(cap_total)
+        try:
+            res = call(seg_cap)
+            n_groups = host_array(res[4]).astype(np.int64)
+            ng_cap = config.pow2ceil(int(n_groups.max())
+                                     if n_groups.size else 1)
+            if ng_cap > seg_cap:
+                res = call(ng_cap)
+        except Exception as e:  # noqa: BLE001
+            if _is_compiler_crash(e):
+                return None   # ladder exhausted: materialize path handles it
+            raise
         _SEG_CACHE.put(sig, ng_cap)
         key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
     out = _result_table(env, by, by_cols, key_out, kval_out, res_names,

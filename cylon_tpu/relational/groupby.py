@@ -61,10 +61,12 @@ _PAD_CACHE = BoundedCache()
 def _is_compiler_crash(e: Exception) -> bool:
     """True when the XLA:TPU compiler subprocess died (SIGSEGV landmines:
     f64 sort payloads and specific gather lane widths, v5e libtpu 2026-07)
-    rather than the program being invalid."""
+    rather than the program being invalid.  Matches both the axon
+    remote-compile tunnel's surfacing ("remote_compile ... SIGSEGV") and a
+    directly-attached TPU VM's ("tpu_compile_helper" subprocess death) —
+    the ladder must engage on either."""
     s = str(e)
-    return ("tpu_compile_helper" in s or "SIGSEGV" in s) \
-        and "remote_compile" in s
+    return "tpu_compile_helper" in s or "SIGSEGV" in s
 
 
 def _pad_ladder(sig_key, attempts):

@@ -268,7 +268,8 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
             # deferred-join state: only what the fused consumer needs
             # (relational/fused.py) — dropping the other carry arrays frees
             # ~5 N-length buffers of HBM while the state is held; a later
-            # materialization re-runs this fn un-slim (compiled-cache hit)
+            # materialization rebuilds the carry from (idx_s, bnd) with
+            # scans alone (_carry_fn — the sort never runs twice)
             return (n.reshape(1), idx_s, bnd) + pl_s
         return (n.reshape(1),) + tuple(carry) + pl_s
 
@@ -279,6 +280,24 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW,
                                        ROW, ROW, ROW),
                              out_specs=(ROW,) * n_out))
+
+
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+def _carry_fn(mesh: Mesh, how: str, cap_l: int, cap_r: int,
+              all_live: bool):
+    """Recompute the full phase-1 carry from a held SLIM state (idx_s, bnd)
+    — prefix scans only (~1 ns/row), no re-sort.  Used when a deferred
+    join materializes: the slim outputs are a superset of what join_carry
+    needs as inputs, so the dominant single-sort never runs twice."""
+
+    def per_shard(vcl, vcr, idx_s, bnd):
+        live = None if all_live else _live_cat(vcl, vcr, cap_l, cap_r)
+        _, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
+        return tuple(carry)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, REP, ROW, ROW),
+                             out_specs=(ROW,) * 6))
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
@@ -549,7 +568,8 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     # never runs for join->groupby-on-the-join-keys pipelines.  Any other
     # access materializes transparently (core.table.DeferredTable).  Phase 1
     # runs SLIM (no carry outputs, ~5 N-length HBM buffers freed) — a later
-    # materialization re-runs it un-slim against the compiled cache.
+    # materialization rebuilds the carry from the held (idx_s, bnd) with
+    # prefix scans only (_carry_fn) — the sort never runs twice.
     # allow_defer default: colocated (pipelined chunk) joins only defer
     # when the caller says a fused consumer will drain each chunk's state
     # immediately (pipelined_join with a sink).  The sink-less concat path
@@ -571,13 +591,17 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
 
         def materialize_cols():
             with timing.region("join.materialize"):
-                full = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
-                                 all_live)(*count_args)
+                # the slim state already holds the sorted payloads and
+                # (idx_s, bnd); the carry rebuilds from scans alone — the
+                # dominant single-sort does NOT run a second time
+                carry = _carry_fn(env.mesh, how, lwork.capacity,
+                                  rwork.capacity, all_live)(
+                                      vcl, vcr, idx_s_s, bnd_s)
                 fn = _materialize_fn(env.mesh, how, out_cap, lwork.capacity,
                                      tuple(plan), lspec, rspec, carry_emit,
                                      carry_match)
-                out_d, out_v = fn(full[1:7], tuple(full[7:]),
-                                  *l_gather_args, *r_gather_args)
+                out_d, out_v = fn(carry, pl_s, *l_gather_args,
+                                  *r_gather_args)
             return {nme: Column(d, t, v, dc, bounds=b)
                     for nme, d, v, t, dc, b in
                     zip(names, out_d, out_v, types, dicts, bounds)}

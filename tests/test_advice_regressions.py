@@ -151,3 +151,90 @@ class TestRound2Advice:
         assert isinstance(env1.serial, int)
         e2 = ct.CylonEnv()  # LocalConfig: no mesh cost
         assert e2.serial > env1.serial
+
+
+class TestRound3Advice:
+    """Round-3 advisor findings (ADVICE.md r3)."""
+
+    def test_fused_pushdown_rejects_string_agg(self, env1):
+        # sum over a STRING column of a deferred inner join must raise the
+        # same InvalidError the materialized path does — never silently
+        # aggregate dictionary codes
+        l = _df({"k": [1, 1, 2, 2], "s": ["x", "y", "z", "w"]}, env1)
+        r = _df({"k": [1, 2, 2, 3], "b": [1, 2, 3, 4]}, env1)
+        j = l.merge(r, on="k", how="inner")
+        with pytest.raises(InvalidError):
+            j.groupby("k").agg({"s": "sum"})
+
+    def test_fused_pushdown_missing_column_keyerror(self, env1):
+        # a nonexistent agg column on a deferred join must raise the same
+        # CylonKeyError the materialized path does, not a raw ValueError
+        l = _df({"k": [1, 2], "a": [1, 2]}, env1)
+        r = _df({"k": [1, 2], "b": [3, 4]}, env1)
+        j = l.merge(r, on="k", how="inner")
+        with pytest.raises(CylonKeyError):
+            j.groupby("k").agg({"nonexistent": "sum"})
+
+    def test_fused_pushdown_allows_string_count(self, env1):
+        l = _df({"k": [1, 1, 2, 2], "s": ["x", None, "z", "w"]}, env1)
+        r = _df({"k": [1, 2, 2, 3], "b": [1, 2, 3, 4]}, env1)
+        got = (l.merge(r, on="k", how="inner").groupby("k")
+               .agg({"s": "count"}).to_pandas()
+               .sort_values("k").reset_index(drop=True))
+        exp = (l.to_pandas().merge(r.to_pandas(), on="k")
+               .groupby("k", as_index=False).agg(s_count=("s", "count")))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_compiler_crash_matches_non_tunnel_messages(self):
+        # directly-attached TPU VMs surface compile crashes WITHOUT the
+        # axon tunnel's "remote_compile" marker — the ladder must engage
+        from cylon_tpu.relational.groupby import _is_compiler_crash
+        assert _is_compiler_crash(
+            RuntimeError("tpu_compile_helper exited with status 139"))
+        assert _is_compiler_crash(
+            RuntimeError("Compilation failure: SIGSEGV in subprocess"))
+        assert _is_compiler_crash(RuntimeError(
+            "remote_compile failed: tpu_compile_helper SIGSEGV"))
+        assert not _is_compiler_crash(RuntimeError("shape mismatch"))
+
+    def test_deferred_materialize_does_not_resort(self, env1, monkeypatch):
+        # materializing a deferred join must NOT re-run phase 1 (the sort);
+        # the carry rebuilds from the held slim state via scans
+        from cylon_tpu.relational import join as join_mod
+        calls = []
+        orig = join_mod._count_fn
+
+        def counting(*a, **k):
+            calls.append(k.get("slim", False)
+                         or (len(a) > 6 and a[6]))
+            return orig(*a, **k)
+
+        monkeypatch.setattr(join_mod, "_count_fn", counting)
+        l = _df({"k": [1, 2, 2, 3], "a": [1, 2, 3, 4]}, env1)
+        r = _df({"k": [2, 2, 3, 5], "b": [5, 6, 7, 8]}, env1)
+        j = l.merge(r, on="k", how="inner")
+        from cylon_tpu.core.table import DeferredTable
+        assert isinstance(j.table, DeferredTable)
+        got = (j.to_pandas().sort_values(["k", "a", "b"])
+               .reset_index(drop=True))
+        # exactly ONE phase-1 dispatch, and it was the slim one
+        assert calls == [True]
+        exp = (l.to_pandas().merge(r.to_pandas(), on="k")
+               .sort_values(["k", "a", "b"]).reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_fused_first_sight_mispredict_redetects(self, env1, monkeypatch):
+        # first-sight fused dispatch at a tiny segment space must detect
+        # the mispredict via n_groups and re-dispatch at the true bucket
+        from cylon_tpu.relational import groupby as gb_mod
+        monkeypatch.setattr(gb_mod, "_FIRST_SEG_CAP", 2)
+        n = 64
+        ks = np.arange(n, dtype=np.int64) % 16     # 16 groups > 2
+        l = _df({"k": ks, "a": np.arange(n, dtype=np.int64)}, env1)
+        r = _df({"k": ks, "b": np.arange(n, dtype=np.int64)}, env1)
+        got = (l.merge(r, on="k", how="inner").groupby("k")
+               .agg({"a": "sum"}).to_pandas()
+               .sort_values("k").reset_index(drop=True))
+        exp = (l.to_pandas().merge(r.to_pandas(), on="k")
+               .groupby("k", as_index=False).agg(a_sum=("a", "sum")))
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
