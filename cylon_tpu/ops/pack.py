@@ -247,11 +247,7 @@ def grouped_gids(datas, validities, mask, narrow32=None):
     return jnp.where(mask, gid, n), n_groups.astype(jnp.int32), bnd
 
 
-def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):
-    """(n, S) bool: row i's key tuple strictly greater than splitter j's.
-    Used by sample-sort range partitioning (reference table.cpp:564-609
-    split-point binary search).  ``splitter_ops`` parallel ``keyops.ops``
-    with shape (S,) each."""
+def _rows_cmp_splitters(keyops: KeyOps, splitter_ops: tuple):
     n = keyops.n
     s = splitter_ops[0].shape[0]
     gt = jnp.zeros((n, s), bool)
@@ -261,4 +257,23 @@ def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):
         b = sop[None, :]
         gt = gt | (eq & op_gt(a, b, kind))
         eq = eq & op_eq(a, b, kind)
+    return gt, eq
+
+
+def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):
+    """(n, S) bool: row i's key tuple strictly greater than splitter j's.
+    Used by sample-sort range partitioning (reference table.cpp:564-609
+    split-point binary search).  ``splitter_ops`` parallel ``keyops.ops``
+    with shape (S,) each."""
+    gt, _ = _rows_cmp_splitters(keyops, splitter_ops)
     return gt
+
+
+def rows_ge_splitters(keyops: KeyOps, splitter_ops: tuple):
+    """(n, S) bool: row i's key tuple >= splitter j's under the same total
+    order as :func:`rows_gt_splitters`.  Used by the range-partitioned
+    pipeline (exec/pipeline.py): splitters are key-GROUP STARTS of the
+    sorted build side, so a probe key equal to splitter j belongs to the
+    range j opens — assignment must be >=, not >."""
+    gt, eq = _rows_cmp_splitters(keyops, splitter_ops)
+    return gt | eq

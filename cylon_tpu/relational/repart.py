@@ -358,29 +358,38 @@ def filter_table(table: Table, flag) -> Table:
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
 def _concat_fn(mesh: Mesh, caps: tuple, out_cap: int, with_valid: tuple):
+    """Per-shard append of k tables' live prefixes: each table's FULL padded
+    block is block-copied (``dynamic_update_slice`` — contiguous, ~1 ns/row
+    vs ~15 ns/row for the scatter this replaces) at its shard's running
+    offset, in ascending table order so a block's trailing padding lands in
+    the NEXT table's region and is overwritten by its copy.  The scratch
+    buffer is ``out_cap + max(caps)`` so the last block never clamps; the
+    result is its ``out_cap`` prefix.  Output padding rows are whatever the
+    last block's padding held — callers rely on the valid-prefix contract,
+    never on zeroed padding."""
     k = len(caps)
+    pad_cap = out_cap + max(caps)
 
     def per_shard(vcs, datas_by_t, valids_by_t):
         my = jax.lax.axis_index(shuffle.ROW_AXIS)
         off = jnp.zeros((), jnp.int32)
         ncols = len(datas_by_t[0])
-        outs = [jnp.zeros((out_cap,), datas_by_t[0][c].dtype)
+        outs = [jnp.zeros((pad_cap,), datas_by_t[0][c].dtype)
                 for c in range(ncols)]
-        outv = [jnp.zeros((out_cap,), bool) if with_valid[c] else None
+        outv = [jnp.zeros((pad_cap,), bool) if with_valid[c] else None
                 for c in range(ncols)]
         for t in range(k):
             cap_t = caps[t]
-            mask = jnp.arange(cap_t) < vcs[t][my]
-            pos = jnp.where(mask, off + jnp.arange(cap_t, dtype=jnp.int32),
-                            jnp.int32(out_cap))
             for c in range(ncols):
-                outs[c] = outs[c].at[pos].set(datas_by_t[t][c], mode="drop")
+                outs[c] = jax.lax.dynamic_update_slice(
+                    outs[c], datas_by_t[t][c], (off,))
                 if with_valid[c]:
                     v = valids_by_t[t][c]
                     v = v if v is not None else jnp.ones(cap_t, bool)
-                    outv[c] = outv[c].at[pos].set(v, mode="drop")
-            off = off + vcs[t][my]
-        return tuple(outs), tuple(outv)
+                    outv[c] = jax.lax.dynamic_update_slice(outv[c], v, (off,))
+            off = off + vcs[t][my].astype(jnp.int32)
+        return (tuple(o[:out_cap] for o in outs),
+                tuple(v[:out_cap] if v is not None else None for v in outv))
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
@@ -407,6 +416,18 @@ def concat_tables(tables: list[Table]) -> Table:
         cs = [t.column(n) for t in tables]
         if cs[0].type == LogicalType.STRING:
             cs = unify_dictionaries_many(cs)
+        elif all(c.type == LogicalType.LIST for c in cs):
+            # merge the passthrough value stores; later tables' codes
+            # shift by the cumulative store length
+            from ..core.column import PassthroughValues
+            vals = [c.dictionary.values for c in cs]
+            offs = np.cumsum([0] + [len(v) for v in vals[:-1]])
+            merged = PassthroughValues(np.concatenate(vals)
+                                       if vals else np.zeros(0, object))
+            hi = max(len(merged) - 1, 0)
+            cs = [Column(c.data + int(o), LogicalType.LIST, c.validity,
+                         merged, bounds=(0, hi))
+                  for c, o in zip(cs, offs)]
         else:
             for i in range(1, len(cs)):
                 cs[0], cs[i] = promote_key_pair(cs[0], cs[i])
@@ -427,4 +448,12 @@ def concat_tables(tables: list[Table]) -> Table:
     out_d, out_v = fn(vcs_host, datas_by_t, valids_by_t)
     types = [cs[0].type for cs in col_sets]
     dicts = [cs[0].dictionary for cs in col_sets]
-    return build_table(names, out_d, out_v, types, dicts, new_valid, env)
+    # merged bounds (∪ {0}: output padding may expose any block's padding)
+    bounds = []
+    for cs in col_sets:
+        bs = [c.bounds for c in cs]
+        bounds.append((min(min(b[0] for b in bs), 0),
+                       max(max(b[1] for b in bs), 0))
+                      if all(b is not None for b in bs) else None)
+    return build_table(names, out_d, out_v, types, dicts, new_valid, env,
+                       bounds=bounds)

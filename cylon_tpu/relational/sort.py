@@ -41,8 +41,9 @@ from ..parallel import shuffle
 
 shard_map = jax.shard_map
 
-#: samples per shard for splitter selection (reference SortOptions.num_samples)
-DEFAULT_SAMPLES = 64
+#: samples per shard for splitter selection (reference SortOptions.num_samples;
+#: 0 = scale with the world size, config.sort_samples)
+DEFAULT_SAMPLES = 0
 
 #: max payload lanes ridden through the local sort; wider tables switch to
 #: one lane-matrix gather at the permutation
@@ -182,12 +183,17 @@ def sort_table(table: Table, by, ascending=True,
     npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
     by_cols = [table.column(n) for n in by]
     from ..core.column import HashedStrings
+    from ..core.dtypes import LogicalType
     for n, c in zip(by, by_cols):
         if isinstance(c.dictionary, HashedStrings):
             raise InvalidError(
                 f"sort on high-cardinality hashed string column {n!r} is "
                 "not supported: hashed codes carry no lexical order "
                 "(equality ops — join/groupby/unique/filters — do work)")
+        if c.type == LogicalType.LIST:
+            raise InvalidError(
+                f"sort on list passthrough column {n!r} is not supported "
+                "(codes are row ids, not value-ordered)")
     by_datas, by_valids = col_arrays(by_cols)
     vc = np.asarray(table.valid_counts, np.int32)
     w = env.world_size
@@ -195,6 +201,8 @@ def sort_table(table: Table, by, ascending=True,
     narrow_keys = narrow32_flags(by_cols)
     if w > 1 and table.row_count > 0:
         # ---- range partition by sampled splitters ------------------------
+        if num_samples <= 0:
+            num_samples = config.sort_samples(w)
         m = min(max(table.capacity, 1), num_samples)
         sample_ops, live = _sample_fn(env.mesh, m, descendings, npos,
                                       narrow_keys)(
@@ -204,11 +212,34 @@ def sort_table(table: Table, by, ascending=True,
             vc, by_datas, by_valids, splitters)
         counts = shuffle.count_targets(env.mesh, tgt)
         table = exchange_by_targets(table, tgt, counts)
-        by_cols = [table.column(n) for n in by]
-        by_datas, by_valids = col_arrays(by_cols)
-        vc = np.asarray(table.valid_counts, np.int32)
 
     # ---- local sort per shard -------------------------------------------
+    out = local_sort_table(table, by, ascending, nulls_position)
+    # globally sorted by the keys ⇒ equal keys contiguous per shard and
+    # (range partition) co-located across shards
+    out.grouped_by = tuple(by)
+    return out
+
+
+def local_sort_table(table: Table, by, ascending=True,
+                     nulls_position: str = "last") -> Table:
+    """Per-shard local sort by ``by`` — no exchange: each shard's rows are
+    reordered in place (the reference's local ``Sort``,
+    arrow_kernels.hpp:121).  Used by :func:`sort_table` after its range
+    exchange and by the range-partitioned pipeline (exec/pipeline.py) to
+    sort the resident build side ONCE.  Unlike the public sort, hashed
+    string keys are allowed here: callers that only need a *consistent*
+    total order (range partitioning for equality joins) sort by the codes.
+
+    Column bounds survive (the sort permutes the full padded row set, so
+    each column's value multiset is unchanged)."""
+    env = table.env
+    by = [by] if isinstance(by, str) else list(by)
+    descendings = _norm_dirs(by, ascending)
+    npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
+    by_cols = [table.column(n) for n in by]
+    by_datas, by_valids = col_arrays(by_cols)
+    vc = np.asarray(table.valid_counts, np.int32)
     items = list(table.columns.items())
     datas = tuple(c.data for _, c in items)
     valids = tuple(c.validity for _, c in items)
@@ -219,8 +250,9 @@ def sort_table(table: Table, by, ascending=True,
     out_d, out_v = _local_sort_fn(env.mesh, descendings, npos, narrow,
                                   vspec, f64_idx)(
         vc, by_datas, by_valids, datas, valids)
-    out = rebuild_like(items, out_d, out_v, table.valid_counts, env)
-    # globally sorted by the keys ⇒ equal keys contiguous per shard and
-    # (range partition) co-located across shards
+    cols = {}
+    for (n, c), d, v in zip(items, out_d, out_v):
+        cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
+    out = Table(cols, env, table.valid_counts)
     out.grouped_by = tuple(by)
     return out

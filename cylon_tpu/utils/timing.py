@@ -47,6 +47,15 @@ def region(name: str, block=None):
         acc[1] += 1
 
 
+def maybe_block(x) -> None:
+    """block_until_ready(x) ONLY when bench timings are on — lets a region
+    charge async device work to itself for attribution without serializing
+    dispatch in production runs."""
+    if config.BENCH_TIMINGS:
+        import jax
+        jax.block_until_ready(x)
+
+
 def reset() -> None:
     _ACCUM.clear()
 
