@@ -30,7 +30,8 @@ import numpy as np
 
 #: ops whose intermediates are plain segment reductions (associative —
 #: eligible for local pre-combine before the shuffle, groupby.cpp:76-81)
-ASSOCIATIVE = {"sum", "count", "min", "max", "mean", "var", "std"}
+ASSOCIATIVE = {"sum", "count", "min", "max", "mean", "var", "std",
+               "sumsq"}
 #: ops that must see raw (shuffled) values
 NON_ASSOCIATIVE = {"nunique", "quantile", "median"}
 
@@ -137,6 +138,7 @@ def grouped_starts(gids, first, mask, n_live, seg_cap: int):
 
 
 _GROUPED_NEEDS = {"sum": ("sum",), "count": ("count",),
+                  "sumsq": ("sumsq",),
                   "mean": ("sum", "count"),
                   "var": ("sum", "sumsq", "count"),
                   "std": ("sum", "sumsq", "count")}
@@ -231,7 +233,7 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
     for i, op in enumerate(ops):
         vm = vmasks[i] if vmasks[i] is not None else jnp.ones(n, bool)
         v = values_list[i]
-        f = v.astype(_ftype(v)) if (op in ("mean", "var", "std")
+        f = v.astype(_ftype(v)) if (op in ("mean", "var", "std", "sumsq")
                                     or jnp.issubdtype(v.dtype, jnp.floating)) \
             else v
         for name in _GROUPED_NEEDS[op]:
@@ -322,7 +324,7 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
 
 
 #: ops whose grouped-input fast path avoids scatter reductions entirely
-CUMSUMMABLE = {"sum", "count", "mean", "var", "std"}
+CUMSUMMABLE = {"sum", "count", "mean", "var", "std", "sumsq"}
 
 
 # ---------------------------------------------------------------------------
@@ -352,6 +354,9 @@ def combine_locally(op: str, values, gids, num_segments, mask=None):
         return {"sum": seg_sum(f, gids, num_segments, mask),
                 "sumsq": seg_sum(f * f, gids, num_segments, mask),
                 "count": seg_count(values, gids, num_segments, mask)}
+    if op == "sumsq":
+        f = values.astype(_ftype(values))
+        return {"sumsq": seg_sum(f * f, gids, num_segments, mask)}
     raise ValueError(f"op {op} has no associative decomposition")
 
 
@@ -372,6 +377,8 @@ def finalize(op: str, inter: dict, ddof: int = 1):
     cnt = inter.get("count")
     if op == "sum":
         return inter["sum"], None
+    if op == "sumsq":
+        return inter["sumsq"], None
     if op == "count":
         return inter["count"], None
     if op == "min":
@@ -448,7 +455,7 @@ def group_first_index(gids, num_segments, mask=None):
 def np_result_dtype(op: str, src: np.dtype) -> np.dtype:
     if op in ("count", "nunique"):
         return np.dtype(np.int64)
-    if op in ("mean", "var", "std", "quantile", "median"):
+    if op in ("mean", "var", "std", "sumsq", "quantile", "median"):
         # float32 in -> float32 out (pandas parity); everything else f64.
         # Accumulation happens in _ftype regardless; this is the result cast.
         return (np.dtype(np.float32) if src == np.dtype(np.float32)

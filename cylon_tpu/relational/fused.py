@@ -51,7 +51,7 @@ from .common import REP, ROW, BoundedCache
 shard_map = jax.shard_map
 
 #: ops whose join pushdown is exact multiplicity algebra
-PUSHDOWN_OPS = {"sum", "count", "mean", "var", "std"}
+PUSHDOWN_OPS = {"sum", "count", "mean", "var", "std", "sumsq"}
 
 #: callsite-signature -> last observed kept-group-count bucket
 _SEG_CACHE = BoundedCache()
@@ -176,6 +176,9 @@ def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
             inter = inters[i]
             if op == "sum":
                 s = inter["sum"]
+                d, v = s * mult.astype(s.dtype), None
+            elif op == "sumsq":
+                s = inter["sumsq"]
                 d, v = s * mult.astype(s.dtype), None
             elif op == "count":
                 d, v = inter["count"] * mult, None

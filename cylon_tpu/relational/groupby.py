@@ -99,6 +99,7 @@ def _pad_ladder(sig_key, attempts):
 #: {sum,count}, VAR/STD -> {sum,sumsq,count})
 INTER_NAMES = {
     "sum": ("sum",),
+    "sumsq": ("sumsq",),
     "count": ("count",),
     "min": ("count", "min"),
     "max": ("count", "max"),
@@ -567,12 +568,12 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
 
     Device OOM falls back to chunked streaming aggregation
     (exec/pipeline.GroupBySink) when every op decomposes through public
-    partial aggregations (sum/count/min/max/mean)."""
+    partial aggregations (sum/count/min/max/mean/var/std)."""
     from ..exec.pipeline import GroupBySink, chunk_table
     from .common import run_with_oom_fallback
 
     def fallback(nc):
-        sink = GroupBySink(by, aggs)
+        sink = GroupBySink(by, aggs, ddof=ddof)
         for ch in chunk_table(table, nc):
             sink(ch)
         return sink.finalize()
@@ -598,7 +599,15 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     by_cols = [table.column(n) for n in by]
     val_cols = [table.column(c) for c, _, _, _ in specs]
     from ..core.column import HashedStrings
+    for n, col in zip(by, by_cols):
+        if col.type == LogicalType.LIST:
+            raise InvalidError(
+                f"groupby on list passthrough column {n!r} is not "
+                "supported (codes are row ids, not value-equal)")
     for (c, op, _, _), col in zip(specs, val_cols):
+        if col.type == LogicalType.LIST and op != "count":
+            raise InvalidError(
+                f"agg {op!r} not valid for list passthrough column {c!r}")
         if col.type == LogicalType.STRING and op not in ("count", "nunique",
                                                          "min", "max"):
             raise InvalidError(f"agg {op!r} not valid for string column {c!r}")

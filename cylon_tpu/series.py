@@ -142,11 +142,42 @@ class Series:
     def _arith(self, other, fn, name: str) -> "Series":
         if self._col.type == LogicalType.STRING:
             raise CylonTypeError(f"{name} not supported for string series")
+        if self._col.type == LogicalType.LIST:
+            raise CylonTypeError(f"{name} not supported for list series")
+        if self._col.type == LogicalType.DECIMAL:
+            raise CylonTypeError(
+                f"{name} on decimal series is not supported (scale-exact "
+                "arithmetic is not implemented); cast to float64 first")
         (col, rhs), validity = self._other_operand(other)
         out = fn(col.data, rhs)
         return self._wrap(out, validity)
 
     def _compare(self, other, fn) -> "Series":
+        if self._col.type == LogicalType.LIST or (
+                isinstance(other, Series)
+                and other._col.type == LogicalType.LIST):
+            raise CylonTypeError(
+                "comparisons on list passthrough series are not supported")
+        if self._col.type == LogicalType.DECIMAL:
+            import decimal
+            sc = self._col.dictionary
+            if isinstance(other, Series) \
+                    and other._col.type == LogicalType.DECIMAL:
+                from .relational.common import rescale_decimal_pair
+                a, b = rescale_decimal_pair(self._col, other._col)
+                return self._wrap(fn(a.data, b.data),
+                                  _binop_validity(a, b), LogicalType.BOOL)
+            if isinstance(other, (int, decimal.Decimal)):
+                q = decimal.Decimal(other).scaleb(sc.scale)
+                if q != int(q):
+                    raise CylonTypeError(
+                        f"literal {other!r} has more fractional digits "
+                        f"than the column scale {sc.scale}")
+                return self._wrap(fn(self._col.data, int(q)),
+                                  self._col.validity, LogicalType.BOOL)
+            raise CylonTypeError(
+                "decimal compares need a Decimal/int literal or another "
+                "decimal series (float literals are lossy)")
         if isinstance(other, str):
             if self._col.type != LogicalType.STRING:
                 raise CylonTypeError("string scalar vs numeric series")
@@ -284,6 +315,22 @@ class Series:
 
     def notna(self) -> "Series":
         return ~self.isna()
+
+    def where(self, cond: "Series", other=None) -> "Series":
+        """Rows where ``cond`` holds keep their value; the rest become
+        ``other`` (default: null) — pandas ``Series.where`` (null conds
+        never select, like every filter-on-bool site)."""
+        if not isinstance(cond, Series):
+            raise CylonTypeError("where condition must be a Series")
+        if cond._col.type != LogicalType.BOOL:
+            raise CylonTypeError("where condition must be boolean")
+        from .relational.common import valid_flag
+        keep = valid_flag(cond._col)
+        if other is None:
+            v = keep if self._col.validity is None \
+                else (self._col.validity & keep)
+            return self._wrap(self._col.data, v)
+        return self._fill_where(jnp.logical_not(keep), value=other)
 
     def fillna(self, value) -> "Series":
         # mask covers every invalid slot -> the result is fully valid

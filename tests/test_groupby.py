@@ -161,3 +161,24 @@ def test_sortpath_f64_payload_riding(env4, rng):
     pd.testing.assert_frame_equal(
         got, exp.sort_values("k").reset_index(drop=True),
         check_dtype=False, check_exact=False)
+
+
+def test_sumsq_public_op(env4, rng):
+    """sumsq (the reference VAR intermediate, aggregate_kernels.hpp:43)
+    is a public op so streaming var/std decompositions close
+    (exec/pipeline.GroupBySink)."""
+    import pandas as pd
+    n = 3000
+    df = pd.DataFrame({"k": rng.integers(0, 80, n).astype(np.int64),
+                       "v": rng.random(n),
+                       "w": rng.integers(-30, 30, n).astype(np.int64)})
+    df.loc[df.index % 7 == 0, "v"] = None
+    t = ct.Table.from_pandas(df, env4)
+    g = groupby_aggregate(t, "k", [("v", "sumsq"), ("w", "sumsq")])
+    exp = (df.groupby("k", as_index=False)
+           .agg(v_sumsq=("v", lambda s: (s.dropna() ** 2).sum()),
+                w_sumsq=("w", lambda s: (s ** 2).sum())))
+    got = g.to_pandas().sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        got, exp.sort_values("k").reset_index(drop=True),
+        check_dtype=False, rtol=1e-9)
