@@ -24,9 +24,57 @@ if X64_ENABLED:
 # of distinct programs and remote TPU compiles cost seconds-to-minutes
 # each; the persistent cache makes every rerun warm (verified working over
 # the axon remote-compile tunnel).  Opt out with CYLON_TPU_COMPILE_CACHE=0.
+#
+# CPU-only processes (JAX_PLATFORMS=cpu — the test rig, dryrun, multihost
+# drivers) run UNCACHED: XLA:CPU executable (de)serialization segfaults
+# nondeterministically (observed live across three full-suite runs, ~1%
+# of compiles, crashing in put_executable_and_time /
+# get_executable_and_time / backend_compile_and_load), and CPU compiles
+# are fast enough not to need persistence.  The cache's value is the
+# seconds-to-minutes remote TPU compiles, which stay cached.
+#
+# The directory is additionally suffixed with a host-CPU fingerprint:
+# XLA:CPU AOT results bake in the COMPILE machine's feature set and the
+# cache key does not capture it — loading an entry cached on a host with
+# different features SIGILLs (observed live: `+prefer-no-gather` mismatch
+# after a machine change).  That protects mixed-platform processes that
+# do cache while making a machine change a cold start, not a crash.
+
+
+def _cpu_only() -> bool:
+    # the programmatic config value is authoritative: it folds in the
+    # JAX_PLATFORMS env default AND any jax.config.update('jax_platforms')
+    # a test conftest/driver issued before importing this package (the env
+    # var alone lies under the axon sitecustomize, which exports
+    # JAX_PLATFORMS=axon even for runs that then pin cpu)
+    try:
+        plats = jax.config.jax_platforms or ""
+    except Exception:  # noqa: BLE001
+        plats = os.environ.get("JAX_PLATFORMS") or ""
+    return plats.strip().lower() == "cpu"
+
+
+def _machine_fingerprint() -> str:
+    import hashlib
+    import platform
+    txt = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    txt += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(txt.encode()).hexdigest()[:12]
+
+
 _CACHE_DIR = os.environ.get("CYLON_TPU_COMPILE_CACHE",
                             os.path.expanduser("~/.cache/cylon_tpu/jax"))
+if _cpu_only():
+    _CACHE_DIR = ""
 if _CACHE_DIR not in ("", "0"):
+    _CACHE_DIR = os.path.join(_CACHE_DIR, _machine_fingerprint())
     try:
         os.makedirs(_CACHE_DIR, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
@@ -63,6 +111,42 @@ STRING_HASH_RATIO = float(os.environ.get("CYLON_TPU_STRING_HASH_RATIO",
 #: schemas would otherwise accumulate executables without limit).  LRU:
 #: eviction drops the jit wrapper (and its executables); re-use recompiles.
 PROGRAM_CACHE_SIZE = int(os.environ.get("CYLON_TPU_PROGRAM_CACHE", "256"))
+
+#: Heavy-key (skew) split tuning — reference analog: the sampled partition
+#: machinery of table.cpp:620-689 applied to skew (SURVEY.md §7 hard-part
+#: 4).  Detection runs on the ROW HASH of the (possibly multi-column) key
+#: tuple, so float keys and multi-column keys participate uniformly and
+#: the flag predicate is exactly the shuffle-routing hash.
+#: Rows sampled per shard for the heavy-hitter estimate:
+SKEW_SAMPLE = int(os.environ.get("CYLON_TPU_SKEW_SAMPLE", "4096"))
+#: Minimum per-shard sampled share for a key to enter the estimate:
+SKEW_MIN_SHARE = float(os.environ.get("CYLON_TPU_SKEW_MIN_SHARE", "0.01"))
+#: A key is heavy when its weighted global share exceeds FACTOR / world
+#: (1.0 = one full shard's worth of rows):
+SKEW_GLOBAL_FACTOR = float(os.environ.get("CYLON_TPU_SKEW_FACTOR", "1.0"))
+#: At most this many heavy keys split per join:
+SKEW_MAX_KEYS = int(os.environ.get("CYLON_TPU_SKEW_MAX_KEYS", "8"))
+#: Replication guard: skip the split when the BUILD side's heavy rows,
+#: replicated world-ways, would exceed GUARD_RATIO x the build size AND
+#: GUARD_ROWS rows — W-way replication would recreate the blow-up the
+#: split avoids.
+SKEW_GUARD_RATIO = float(os.environ.get("CYLON_TPU_SKEW_GUARD_RATIO", "2.0"))
+SKEW_GUARD_ROWS = int(os.environ.get("CYLON_TPU_SKEW_GUARD_ROWS", "65536"))
+
+#: Distributed-sort splitter samples per shard: grows with the world size
+#: (more shards need finer splitters for the same balance; the reference's
+#: SortOptions.num_samples is likewise caller-tunable, table.hpp:358).
+SORT_SAMPLES_PER_SHARD = int(os.environ.get("CYLON_TPU_SORT_SAMPLES", "0"))
+
+
+def sort_samples(world: int) -> int:
+    """Splitter samples per shard: explicit override, else 64 minimum
+    scaled linearly with the world (16 x W) so splitter resolution keeps
+    pace with the number of cut points."""
+    if SORT_SAMPLES_PER_SHARD > 0:
+        return SORT_SAMPLES_PER_SHARD
+    return max(64, 16 * world)
+
 
 #: Defer inner-join output materialization so a same-key groupby can consume
 #: the pre-expansion sorted state (relational/fused.py); any other access

@@ -96,44 +96,45 @@ def count_targets(mesh: Mesh, tgt) -> np.ndarray:
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
-def _skew_targets_fn(mesh: Mesh, w: int, k_heavy: int, with_valid: bool):
-    """Targets for a skew-split probe side: heavy-key rows spread evenly
+def _skew_targets_fn(mesh: Mesh, w: int, k_heavy: int, nkeys: int):
+    """Targets for a skew-split probe side: heavy-HASH rows spread evenly
     over all ranks (round-robin by global position) instead of hashing —
-    the build side's heavy rows are replicated, so any rank can join them.
-    Reference analog: sampled heavy-key handling, SURVEY.md §7 hard-part 4."""
+    the build side's rows with the same hashes are replicated, so any rank
+    can join them.  Multi-column and float keys work uniformly (hash_rows
+    canonicalizes).  Reference analog: sampled heavy-key handling,
+    SURVEY.md §7 hard-part 4."""
 
-    def per_shard(vc, heavy_vals, key, valid):
-        cap = key.shape[0]
+    def per_shard(vc, heavy_hashes, *args):
+        datas = list(args[:nkeys])
+        valids = list(args[nkeys:])
+        cap = datas[0].shape[0]
         my = jax.lax.axis_index(ROW_AXIS)
         mask = jnp.arange(cap) < vc[my]
-        h = hashing.hash_rows([key], [valid] if with_valid else None)
+        h = hashing.hash_rows(datas, valids)
         tgt = hashing.partition_targets(h, w)
         is_heavy = jnp.zeros(cap, bool)
         for j in range(k_heavy):
-            is_heavy = is_heavy | (key == heavy_vals[j])
-        if with_valid:
-            is_heavy = is_heavy & valid  # null keys never match a heavy value
+            is_heavy = is_heavy | (h == heavy_hashes[j])
         spread = ((my * cap + jnp.arange(cap, dtype=jnp.int32)) % w).astype(
             jnp.int32)
         tgt = jnp.where(is_heavy, spread, tgt)
         return jnp.where(mask, tgt, jnp.int32(w))
 
-    specs = (P(), P(), P(ROW_AXIS)) + ((P(ROW_AXIS),) if with_valid else (P(),))
+    specs = (P(), P()) + (P(ROW_AXIS),) * (2 * nkeys)
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=P(ROW_AXIS)))
 
 
-def skew_targets(mesh: Mesh, key_data, key_valid, valid_counts: np.ndarray,
-                 heavy_vals: np.ndarray):
-    """Per-row targets with heavy keys spread round-robin."""
+def skew_targets(mesh: Mesh, key_datas, key_valids,
+                 valid_counts: np.ndarray, heavy_hashes: np.ndarray):
+    """Per-row targets with heavy key hashes spread round-robin.
+    ``key_valids`` entries must be real arrays (callers pass all-ones for
+    non-nullable columns so null folding matches the detection pass)."""
     w = valid_counts.shape[0]
     vc = np.asarray(valid_counts, np.int32)
-    with_valid = key_valid is not None
-    fn = _skew_targets_fn(mesh, w, len(heavy_vals), with_valid)
-    hv = np.asarray(heavy_vals)
-    if with_valid:
-        return fn(vc, hv, key_data, key_valid)
-    return fn(vc, hv, key_data, np.zeros(0, bool))
+    fn = _skew_targets_fn(mesh, w, len(heavy_hashes), len(key_datas))
+    hv = np.asarray(heavy_hashes, np.uint32)
+    return fn(vc, hv, *key_datas, *key_valids)
 
 
 # ---------------------------------------------------------------------------
@@ -174,12 +175,18 @@ def _prep_fn(mesh: Mesh, w: int):
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
-def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int):
-    """One exchange round: select this round's position window, all-to-all,
-    scatter received rows into their final output slots."""
+def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int,
+              rounds: int = 1):
+    """The exchange round engine: select a round's position window,
+    all-to-all, scatter received rows into their final output slots.
 
-    def per_shard(r, tgt_s, perm, pos, counts, outs, cols):
-        my = jax.lax.axis_index(ROW_AXIS)
+    ``rounds > 1`` (skewed counts: some (src,dst) stream exceeds the
+    block) runs ALL rounds inside one compiled program via
+    ``lax.fori_loop`` — one dispatch total instead of one per round (the
+    round-3 verdict's multi-round host loop; the collective sits inside
+    the loop body, which XLA supports under shard_map)."""
+
+    def one_round(r, tgt_s, perm, pos, counts, outs, cols, my):
         lo = r * block
         sel = (tgt_s < w) & (pos >= lo) & (pos < lo + block)
         slot = jnp.where(sel, jnp.clip(tgt_s, 0, w - 1) * block + (pos - lo),
@@ -204,15 +211,26 @@ def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int):
             new_outs.append(out.at[fslot].set(recv, mode="drop"))
         return tuple(new_outs)
 
-    def fn(r, tgt_s, perm, pos, counts, outs, cols):
+    def per_shard(tgt_s, perm, pos, counts, outs, cols):
+        my = jax.lax.axis_index(ROW_AXIS)
+        if rounds == 1:
+            return one_round(jnp.int32(0), tgt_s, perm, pos, counts, outs,
+                             cols, my)
+        return jax.lax.fori_loop(
+            0, rounds,
+            lambda r, o: one_round(jnp.int32(r), tgt_s, perm, pos, counts,
+                                   o, cols, my),
+            tuple(outs))
+
+    def fn(tgt_s, perm, pos, counts, outs, cols):
         n = len(cols)
-        specs_in = (P(),) + (P(ROW_AXIS),) * 3 + (P(),) \
+        specs_in = (P(ROW_AXIS),) * 3 + (P(),) \
             + ((P(ROW_AXIS),) * n,) + ((P(ROW_AXIS),) * n,)
         sm = shard_map(per_shard, mesh=mesh, in_specs=specs_in,
                        out_specs=(P(ROW_AXIS),) * n)
-        return sm(r, tgt_s, perm, pos, counts, outs, cols)
+        return sm(tgt_s, perm, pos, counts, outs, cols)
 
-    return jax.jit(fn, donate_argnums=(5,))
+    return jax.jit(fn, donate_argnums=(4,))
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
@@ -257,7 +275,7 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple):
     tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)
     outs = tuple(_alloc_fn(mesh, out_cap, str(c.dtype), c.shape[1:])()
                  for c in cols)
-    fn = _round_fn(mesh, w, block, out_cap)
-    for r in range(max(rounds, 1)):
-        outs = fn(np.int32(r), tgt_s, perm, pos, counts_i, outs, tuple(cols))
+    # all rounds run in ONE compiled program (fori_loop when rounds > 1)
+    fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
+    outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
     return outs, per_dest.astype(np.int64)

@@ -59,51 +59,58 @@ HOW = ("inner", "left", "right", "outer")
 #: hit every time.
 _CAP_CACHE = BoundedCache()
 
-#: heavy-key detection: per-shard sample size and global-share threshold
-SKEW_SAMPLE = 4096
-SKEW_MAX_KEYS = 8
-
-
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
-def _key_sample_fn(mesh: Mesh, m: int, with_valid: bool):
-    """Evenly spaced per-shard sample of a key column's live prefix."""
+def _hash_sample_fn(mesh: Mesh, m: int, nkeys: int):
+    """Evenly spaced per-shard sample of the key tuple's ROW HASH —
+    detection runs in hash space so multi-column and float keys work
+    uniformly and the predicate is exactly the shuffle-routing hash
+    (ops/hashing.hash_rows canonicalizes floats and folds validity)."""
+    from ..ops import hashing
 
-    def per_shard(vc, key, valid):
-        cap = key.shape[0]
+    def per_shard(vc, *args):
+        datas = list(args[:nkeys])
+        valids = list(args[nkeys:])
+        cap = datas[0].shape[0]
         my = jax.lax.axis_index(ROW_AXIS)
         n = vc[my]
+        h = hashing.hash_rows(datas, valids)
         idx = sample_positions(n, m, cap)
         live = jnp.full((m,), n > 0)
-        if with_valid:
-            live = live & valid[idx]
-        return key[idx], live
+        return h[idx], live
 
-    specs = (REP, ROW) + ((ROW,) if with_valid else (REP,))
+    specs = (REP,) + (ROW,) * (2 * nkeys)
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW, ROW)))
 
 
-def _heavy_keys(table: Table, key_name: str, env):
+def _hash_args(cols):
+    cap = cols[0].data.shape[0]
+    datas = tuple(c.data for c in cols)
+    valids = tuple(c.validity if c.validity is not None
+                   else np.ones(cap, bool) for c in cols)
+    return datas, valids
+
+
+def _heavy_keys(table: Table, key_names: list, env):
     """Host-side heavy-hitter estimate from a small device sample: key
-    values whose sampled global share exceeds 1/world (a single key owning
-    a full shard's worth of rows).  Returns a small np array or None.
-    Reference analog: the sampled partition machinery (table.cpp:620-689)
-    applied to skew (SURVEY.md §7 hard-part 4)."""
-    col = table.column(key_name)
-    if col.data.dtype.kind not in ("i", "u"):
-        return None  # float keys: skip (NaN equality pitfalls)
+    HASHES whose weighted global share exceeds SKEW_GLOBAL_FACTOR/world
+    (a single key owning a full shard's worth of rows).  Returns a small
+    np uint32 array or None.  Reference analog: the sampled partition
+    machinery (table.cpp:620-689) applied to skew (SURVEY.md §7 hard-part
+    4).  A hash collision only widens the split to an extra (light) key —
+    both sides flag with the same predicate, so joins stay exact."""
     w = env.world_size
     total = int(table.valid_counts.sum())
     if total < w * 64:  # too small to skew-split — skip the device sample
         return None
-    with_valid = col.validity is not None
-    fn = _key_sample_fn(env.mesh, SKEW_SAMPLE, with_valid)
+    cols = [table.column(n) for n in key_names]
+    datas, valids = _hash_args(cols)
+    m = config.SKEW_SAMPLE
+    fn = _hash_sample_fn(env.mesh, m, len(cols))
     vc = np.asarray(table.valid_counts, np.int32)
-    args = (vc, col.data, col.validity) if with_valid \
-        else (vc, col.data, np.zeros(0, bool))
-    vals_d, live_d = fn(*args)
-    vals = host_array(vals_d).reshape(w, SKEW_SAMPLE)
-    live = host_array(live_d).reshape(w, SKEW_SAMPLE)
+    vals_d, live_d = fn(vc, *datas, *valids)
+    vals = host_array(vals_d).reshape(w, m)
+    live = host_array(live_d).reshape(w, m)
     # weight each shard's sample by its true row share — unweighted pooling
     # would let a tiny shard's keys dominate the global estimate
     shares: dict = {}
@@ -113,26 +120,32 @@ def _heavy_keys(table: Table, key_name: str, env):
             continue
         weight = float(table.valid_counts[s]) / total / lv.size
         uniq, cnt = np.unique(lv, return_counts=True)
-        for u, c in zip(uniq[cnt / lv.size > 0.01], cnt[cnt / lv.size > 0.01]):
+        keep = cnt / lv.size > config.SKEW_MIN_SHARE
+        for u, c in zip(uniq[keep], cnt[keep]):
             shares[u] = shares.get(u, 0.0) + c * weight
-    heavy = [(u, sh) for u, sh in shares.items() if sh > 1.0 / w]
+    thresh = config.SKEW_GLOBAL_FACTOR / w
+    heavy = [(u, sh) for u, sh in shares.items() if sh > thresh]
     if not heavy:
         return None
     heavy.sort(key=lambda x: -x[1])
-    return np.asarray([u for u, _ in heavy[:SKEW_MAX_KEYS]])
+    return np.asarray([u for u, _ in heavy[:config.SKEW_MAX_KEYS]],
+                      np.uint32)
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
-def _heavy_flag_fn(mesh: Mesh, k: int, with_valid: bool):
-    def per_shard(heavy_vals, key, valid):
-        flag = jnp.zeros(key.shape[0], bool)
+def _heavy_flag_fn(mesh: Mesh, k: int, nkeys: int):
+    from ..ops import hashing
+
+    def per_shard(heavy_hashes, *args):
+        datas = list(args[:nkeys])
+        valids = list(args[nkeys:])
+        h = hashing.hash_rows(datas, valids)
+        flag = jnp.zeros(h.shape[0], bool)
         for j in range(k):
-            flag = flag | (key == heavy_vals[j])
-        if with_valid:
-            flag = flag & valid
+            flag = flag | (h == heavy_hashes[j])
         return flag
 
-    specs = (REP, ROW) + ((ROW,) if with_valid else (REP,))
+    specs = (REP,) + (ROW,) * (2 * nkeys)
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=ROW))
 
@@ -142,55 +155,55 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
     """Distributed co-location with heavy-key skew splitting.
 
     Default: hash-shuffle both sides (reference table.cpp:219).  When the
-    probe side's sampled key distribution has heavy hitters (single-column
-    integer/string-code keys, inner/left/right joins), the probe side's
-    heavy rows are SPREAD round-robin instead of hashed and the build
-    side's heavy rows are replicated to every shard (duplicate-broadcast,
-    via AllGather(Table)) — peak per-shard memory stays ~input-sized
-    instead of one shard receiving the whole heavy key.
+    probe side's sampled key-hash distribution has heavy hitters
+    (inner/left/right joins; single- AND multi-column keys, float keys
+    included — detection and flagging run on the canonicalizing row hash,
+    ops/hashing.hash_rows), the probe side's heavy rows are SPREAD
+    round-robin instead of hashed and the build side's heavy rows are
+    replicated to every shard (duplicate-broadcast, via AllGather(Table))
+    — peak per-shard memory stays ~input-sized instead of one shard
+    receiving the whole heavy key.  Thresholds: config.SKEW_*.
 
     Returns (lwork, rwork, split_used)."""
     from ..parallel import shuffle as shf
     from ..parallel.collectives import allgather_table
     from .repart import concat_tables, exchange_by_targets, filter_table
 
-    if how in ("inner", "left", "right") and len(left_on) == 1:
+    if how in ("inner", "left", "right"):
         if how == "right":
-            probe, probe_key = rwork, right_on[0]
-            build, build_key = lwork, left_on[0]
+            probe, probe_on = rwork, right_on
+            build, build_on = lwork, left_on
         else:
-            probe, probe_key = lwork, left_on[0]
-            build, build_key = rwork, right_on[0]
-        heavy = _heavy_keys(probe, probe_key, env)
+            probe, probe_on = lwork, left_on
+            build, build_on = rwork, right_on
+        heavy = _heavy_keys(probe, probe_on, env)
         if heavy is not None:
-            bcol = build.column(build_key)
-            if bcol.data.dtype.kind in ("i", "u"):
-                hv = np.asarray(heavy).astype(bcol.data.dtype)
-                with_valid = bcol.validity is not None
-                flag = _heavy_flag_fn(env.mesh, len(hv), with_valid)(
-                    hv, bcol.data,
-                    bcol.validity if with_valid else np.zeros(0, bool))
-                build_heavy = filter_table(build, flag)
-                # replication guard: if the BUILD side is itself heavy on
-                # these keys, W-way replication would recreate the blow-up
-                # the split exists to avoid — fall back to plain hashing
-                if (build_heavy.row_count * env.world_size
-                        > 2 * max(build.row_count, 1)
-                        and build_heavy.row_count > 65536):
-                    return (shuffle_table(lwork, left_on),
-                            shuffle_table(rwork, right_on), False)
-                build_light = filter_table(build, ~flag)
-                build_out = concat_tables(
-                    [shuffle_table(build_light, [build_key]),
-                     allgather_table(build_heavy)])
-                pcol = probe.column(probe_key)
-                tgt = shf.skew_targets(env.mesh, pcol.data, pcol.validity,
-                                       probe.valid_counts, hv)
-                counts = shf.count_targets(env.mesh, tgt)
-                probe_out = exchange_by_targets(probe, tgt, counts)
-                if how == "right":
-                    return build_out, probe_out, True
-                return probe_out, build_out, True
+            bcols = [build.column(n) for n in build_on]
+            bdatas, bvalids = _hash_args(bcols)
+            flag = _heavy_flag_fn(env.mesh, len(heavy), len(bcols))(
+                heavy, *bdatas, *bvalids)
+            build_heavy = filter_table(build, flag)
+            # replication guard: if the BUILD side is itself heavy on
+            # these keys, W-way replication would recreate the blow-up
+            # the split exists to avoid — fall back to plain hashing
+            if (build_heavy.row_count * env.world_size
+                    > config.SKEW_GUARD_RATIO * max(build.row_count, 1)
+                    and build_heavy.row_count > config.SKEW_GUARD_ROWS):
+                return (shuffle_table(lwork, left_on),
+                        shuffle_table(rwork, right_on), False)
+            build_light = filter_table(build, ~flag)
+            build_out = concat_tables(
+                [shuffle_table(build_light, build_on),
+                 allgather_table(build_heavy)])
+            pcols = [probe.column(n) for n in probe_on]
+            pdatas, pvalids = _hash_args(pcols)
+            tgt = shf.skew_targets(env.mesh, pdatas, pvalids,
+                                   probe.valid_counts, heavy)
+            counts = shf.count_targets(env.mesh, tgt)
+            probe_out = exchange_by_targets(probe, tgt, counts)
+            if how == "right":
+                return build_out, probe_out, True
+            return probe_out, build_out, True
     return (shuffle_table(lwork, left_on), shuffle_table(rwork, right_on),
             False)
 
@@ -401,10 +414,11 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     keys already share a shard on both sides (pipelined execution shuffles
     the build side once and streams pre-shuffled probe chunks).
 
-    Device OOM falls back to the streaming chunked pipeline
-    (exec/pipeline.py — the reference's operator-DAG slot) for inner/left
-    joins: the probe side streams through in chunks so sort scratch and
-    per-chunk output each fit; retried at growing chunk counts."""
+    Device OOM falls back to the range-partitioned pipeline
+    (exec/pipeline.py — the reference's operator-DAG slot): the work tiles
+    over key ranges so sort scratch and per-piece output each fit; retried
+    at growing range counts.  Range disjointness makes the fallback valid
+    for all four join types."""
     from .common import run_with_oom_fallback
 
     def fallback(nc):
@@ -416,8 +430,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         lambda: _join_tables_impl(left, right, left_on, right_on, how,
                                   suffixes, coalesce_keys, assume_colocated,
                                   allow_defer),
-        can_fallback=(how in ("inner", "left") and not assume_colocated
-                      and coalesce_keys),
+        can_fallback=(not assume_colocated and coalesce_keys),
         fallback=fallback, label="join")
 
 
