@@ -1,18 +1,22 @@
-"""TPC-H subset: data generator + Q1/Q3/Q5/Q6 on the DataFrame API.
+"""TPC-H subset: data generator + a 10-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q10 Q12 Q14 Q18 Q19).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
 SF10 Q3/Q5 on 8 ranks).  This module provides:
 
-* :func:`generate_tables` — a numpy dbgen-alike for the six tables Q3/Q5
-  touch (customer, orders, lineitem, supplier, nation, region) with the
-  standard cardinalities (150K/1.5M/~6M/10K/25/5 rows x SF) and the value
-  distributions the two queries are sensitive to (mktsegment 5-way uniform,
-  order dates uniform over 1992-1998, discount 0-0.10, one region in 5);
-* :func:`q3` / :func:`q5` — the queries written against the public
-  DataFrame API (filter -> merge -> arithmetic -> groupby -> sort -> head),
-  exactly how a user would port them;
-* :func:`q3_pandas` / :func:`q5_pandas` — the pandas oracle;
+* :func:`generate_tables` — a numpy dbgen-alike for the seven tables the
+  suite touches (customer, orders, lineitem, supplier, nation, region,
+  part) with the standard cardinalities (150K/1.5M/~6M/10K/25/5/200K rows
+  x SF) and the value distributions the queries are sensitive to
+  (mktsegment 5-way uniform, order dates uniform over 1992-1998, discount
+  0-0.10, one region in 5, closed p_type/brand/container vocabularies);
+* ``q1``..``q19`` — the queries written against the public DataFrame API
+  (filter -> merge -> arithmetic -> groupby -> sort -> head), exactly how
+  a user would port them — together they cover join+conditional-agg
+  (Q14), groupby-HAVING semi-join (Q18) and disjunctive multi-attribute
+  filters (Q19) beyond the round-3 seven;
+* ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
 Dates are datetime64[ns] columns; scalar date predicates compare against
@@ -41,6 +45,18 @@ PRIORITIES = np.asarray(["1-URGENT", "2-HIGH", "3-MEDIUM",
                          "4-NOT SPECIFIED", "5-LOW"])
 SHIPMODES = np.asarray(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
                         "TRUCK"])
+SHIPINSTRUCT = np.asarray(["COLLECT COD", "DELIVER IN PERSON", "NONE",
+                           "TAKE BACK RETURN"])
+PTYPES = np.asarray(["PROMO ANODIZED", "PROMO BURNISHED", "PROMO PLATED",
+                     "STANDARD PLATED", "ECONOMY BRUSHED",
+                     "MEDIUM POLISHED"])
+PROMO_TYPES = tuple(t for t in PTYPES if t.startswith("PROMO"))
+BRANDS = np.asarray([f"Brand#{i}{j}" for i in range(1, 6)
+                     for j in range(1, 6)])
+CONTAINERS = np.asarray([f"{s} {c}" for s in ("SM", "MED", "LG", "JUMBO",
+                                              "WRAP")
+                         for c in ("CASE", "BOX", "BAG", "JAR", "PKG",
+                                   "PACK", "CAN", "DRUM")])
 
 
 def _ts(date: str) -> int:
@@ -112,6 +128,23 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         "s_suppkey": np.arange(n_supp, dtype=np.int64),
         "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
     })
+    # part + the Q14/Q18/Q19 columns draw from an INDEPENDENT stream so the
+    # original six tables stay byte-identical across versions (recorded
+    # results / regression baselines do not shift)
+    rng2 = np.random.default_rng(seed + 104729)
+    n_part = max(int(200_000 * scale), 8)
+    part = pd.DataFrame({
+        "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_type": PTYPES[rng2.integers(0, len(PTYPES), n_part)],
+        "p_brand": BRANDS[rng2.integers(0, len(BRANDS), n_part)],
+        "p_container": CONTAINERS[rng2.integers(0, len(CONTAINERS), n_part)],
+        "p_size": rng2.integers(1, 51, n_part).astype(np.int64),
+    })
+    lineitem["l_partkey"] = rng2.integers(0, n_part, n_line).astype(np.int64)
+    lineitem["l_shipinstruct"] = SHIPINSTRUCT[
+        rng2.integers(0, len(SHIPINSTRUCT), n_line)]
+    orders["o_totalprice"] = np.round(rng2.uniform(1_000.0, 500_000.0,
+                                                   n_ord), 2)
     nation = pd.DataFrame({
         "n_nationkey": np.arange(25, dtype=np.int64),
         "n_name": NATIONS,
@@ -122,7 +155,8 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         "r_name": REGIONS,
     })
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
-            "supplier": supplier, "nation": nation, "region": region}
+            "supplier": supplier, "nation": nation, "region": region,
+            "part": part}
 
 
 def generate_tables(scale: float = 0.01, env=None, seed: int = 0) -> dict:
@@ -415,7 +449,7 @@ def q12(dfs: dict, env=None, mode1: str = "MAIL", mode2: str = "SHIP",
     l_commitdate AND l_receiptdate >= :lo AND l_receiptdate < :hi GROUP BY
     l_shipmode ORDER BY l_shipmode; high = priority in (1-URGENT, 2-HIGH)."""
     l = dfs["lineitem"]
-    sel = (((l["l_shipmode"] == mode1) | (l["l_shipmode"] == mode2))
+    sel = (_isin(l["l_shipmode"], [mode1, mode2])
            & (l["l_commitdate"] < l["l_receiptdate"])
            & (l["l_shipdate"] < l["l_commitdate"])
            & (l["l_receiptdate"] >= _ts(date_lo))
@@ -452,6 +486,153 @@ def q12_pandas(pdfs: dict, mode1: str = "MAIL", mode2: str = "SHIP",
          .agg(high_line_count=("high_line", "sum"),
               low_line_count=("low_line", "sum")))
     return g.sort_values("l_shipmode").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q14 — promotion effect (join + conditional aggregate)
+# ---------------------------------------------------------------------------
+
+def q14(dfs: dict, env=None, date_lo: str = "1995-09-01",
+        date_hi: str = "1995-10-01") -> float:
+    """SELECT 100 * sum(case when p_type like 'PROMO%' then
+    l_extendedprice*(1-l_discount) else 0 end) / sum(l_extendedprice*
+    (1-l_discount)) FROM lineitem, part WHERE l_partkey = p_partkey AND
+    l_shipdate >= :lo AND l_shipdate < :hi.  The LIKE prefix match is an
+    isin over the generator's closed p_type vocabulary (PROMO_TYPES)."""
+    l = dfs["lineitem"]
+    l = l[(l["l_shipdate"] >= _ts(date_lo)) & (l["l_shipdate"] < _ts(date_hi))]
+    j = l.merge(dfs["part"], left_on="l_partkey", right_on="p_partkey",
+                env=env)
+    rev = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    promo = _isin(j["p_type"], list(PROMO_TYPES))
+    promo_rev = (promo.astype("float64") * rev).sum()
+    total = rev.sum()
+    return float(100.0 * promo_rev / total) if total else 0.0
+
+
+def q14_pandas(pdfs: dict, date_lo: str = "1995-09-01",
+               date_hi: str = "1995-10-01") -> float:
+    l = pdfs["lineitem"]
+    l = l[(l.l_shipdate >= pd.Timestamp(date_lo))
+          & (l.l_shipdate < pd.Timestamp(date_hi))]
+    j = l.merge(pdfs["part"], left_on="l_partkey", right_on="p_partkey")
+    rev = j.l_extendedprice * (1.0 - j.l_discount)
+    promo = j.p_type.str.startswith("PROMO")
+    total = float(rev.sum())
+    return float(100.0 * (rev * promo).sum() / total) if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Q18 — large volume customer (groupby-HAVING semi-join)
+# ---------------------------------------------------------------------------
+
+def q18(dfs: dict, env=None, quantity: int = 300, limit: int = 100):
+    """SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+    sum(l_quantity) FROM customer, orders, lineitem WHERE o_orderkey IN
+    (SELECT l_orderkey FROM lineitem GROUP BY l_orderkey HAVING
+    sum(l_quantity) > :q) AND c_custkey = o_custkey AND o_orderkey =
+    l_orderkey GROUP BY c_name, c_custkey, o_orderkey, o_orderdate,
+    o_totalprice ORDER BY o_totalprice DESC, o_orderdate LIMIT 100.
+    The HAVING subquery is a groupby + filter + semi-join (reference
+    pattern: DistributedHashGroupBy then DistributedJoin)."""
+    l = dfs["lineitem"]
+    big = l.groupby(["l_orderkey"], env=env).agg([("l_quantity", "sum")])
+    big = big[big["l_quantity_sum"] > float(quantity)][["l_orderkey"]]
+    o = dfs["orders"].merge(big, left_on="o_orderkey", right_on="l_orderkey",
+                            env=env)
+    co = dfs["customer"].merge(o, left_on="c_custkey", right_on="o_custkey",
+                               env=env)
+    j = co.merge(l, left_on="o_orderkey", right_on="l_orderkey", env=env)
+    g = (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                    "o_totalprice"], env=env)
+         .agg([("l_quantity", "sum")]))
+    out = g.sort_values(["o_totalprice", "o_orderdate"],
+                        ascending=[False, True], env=env).head(limit)
+    return out[["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                "o_totalprice", "l_quantity_sum"]]
+
+
+def q18_pandas(pdfs: dict, quantity: int = 300,
+               limit: int = 100) -> pd.DataFrame:
+    l = pdfs["lineitem"]
+    big = l.groupby("l_orderkey", as_index=False)["l_quantity"].sum()
+    big = big[big.l_quantity > quantity][["l_orderkey"]]
+    o = pdfs["orders"].merge(big, left_on="o_orderkey",
+                             right_on="l_orderkey")
+    j = (pdfs["customer"].merge(o, left_on="c_custkey", right_on="o_custkey")
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey"))
+    g = (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                    "o_totalprice"], as_index=False)
+         .agg(l_quantity_sum=("l_quantity", "sum")))
+    g = g.sort_values(["o_totalprice", "o_orderdate"],
+                      ascending=[False, True]).head(limit)
+    return g[["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+              "o_totalprice", "l_quantity_sum"]].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q19 — discounted revenue (disjunctive multi-attribute filters)
+# ---------------------------------------------------------------------------
+
+def _isin(series, values):
+    out = series == values[0]
+    for v in values[1:]:
+        out = out | (series == v)
+    return out
+
+
+def q19(dfs: dict, env=None, brand1: str = "Brand#12",
+        brand2: str = "Brand#23", brand3: str = "Brand#34",
+        q1_: int = 1, q2_: int = 10, q3_: int = 20) -> float:
+    """SELECT sum(l_extendedprice*(1-l_discount)) FROM lineitem, part WHERE
+    three disjunctive (brand, container-set, quantity-range, size-range)
+    branches AND l_shipmode IN (AIR, REG AIR) AND l_shipinstruct =
+    'DELIVER IN PERSON' — the classic disjunctive-predicate stressor: one
+    join, then one boolean tree over five columns."""
+    l = dfs["lineitem"]
+    l = l[_isin(l["l_shipmode"], ["AIR", "REG AIR"])
+          & (l["l_shipinstruct"] == "DELIVER IN PERSON")]
+    j = l.merge(dfs["part"], left_on="l_partkey", right_on="p_partkey",
+                env=env)
+    qty, size = j["l_quantity"], j["p_size"]
+    b1 = ((j["p_brand"] == brand1)
+          & _isin(j["p_container"], ["SM CASE", "SM BOX", "SM PACK",
+                                     "SM PKG"])
+          & (qty >= q1_) & (qty <= q1_ + 10) & (size >= 1) & (size <= 5))
+    b2 = ((j["p_brand"] == brand2)
+          & _isin(j["p_container"], ["MED BAG", "MED BOX", "MED PKG",
+                                     "MED PACK"])
+          & (qty >= q2_) & (qty <= q2_ + 10) & (size >= 1) & (size <= 10))
+    b3 = ((j["p_brand"] == brand3)
+          & _isin(j["p_container"], ["LG CASE", "LG BOX", "LG PACK",
+                                     "LG PKG"])
+          & (qty >= q3_) & (qty <= q3_ + 10) & (size >= 1) & (size <= 15))
+    f = j[b1 | b2 | b3]
+    rev = f["l_extendedprice"] * (1.0 - f["l_discount"])
+    return float(rev.sum())
+
+
+def q19_pandas(pdfs: dict, brand1: str = "Brand#12", brand2: str = "Brand#23",
+               brand3: str = "Brand#34", q1_: int = 1, q2_: int = 10,
+               q3_: int = 20) -> float:
+    l = pdfs["lineitem"]
+    l = l[l.l_shipmode.isin(["AIR", "REG AIR"])
+          & (l.l_shipinstruct == "DELIVER IN PERSON")]
+    j = l.merge(pdfs["part"], left_on="l_partkey", right_on="p_partkey")
+    b1 = ((j.p_brand == brand1)
+          & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"])
+          & j.l_quantity.between(q1_, q1_ + 10)
+          & j.p_size.between(1, 5))
+    b2 = ((j.p_brand == brand2)
+          & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"])
+          & j.l_quantity.between(q2_, q2_ + 10)
+          & j.p_size.between(1, 10))
+    b3 = ((j.p_brand == brand3)
+          & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+          & j.l_quantity.between(q3_, q3_ + 10)
+          & j.p_size.between(1, 15))
+    f = j[b1 | b2 | b3]
+    return float((f.l_extendedprice * (1.0 - f.l_discount)).sum())
 
 
 # ---------------------------------------------------------------------------
@@ -500,7 +681,7 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q10": q10, "q12": q12}
+               "q10": q10, "q12": q12, "q14": q14, "q18": q18, "q19": q19}
     times = {name: run_query(fn) for name, fn in queries.items()}
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"

@@ -95,3 +95,37 @@ def test_q12_matches_pandas(env):
     got = tpch.q12(dfs, env=env).to_pandas().reset_index(drop=True)
     exp = tpch.q12_pandas(pdfs)
     pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_q14_matches_pandas(env):
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.004, seed=14)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q14(dfs, env=env)
+    exp = tpch.q14_pandas(pdfs)
+    assert got == pytest.approx(exp, rel=1e-9)
+
+
+def test_q18_matches_pandas(env):
+    import cylon_tpu as ct
+    # lower HAVING threshold so the tiny scale keeps qualifying orders
+    pdfs = tpch.generate_pandas(scale=0.004, seed=18)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q18(dfs, env=env, quantity=150).to_pandas() \
+        .reset_index(drop=True)
+    exp = tpch.q18_pandas(pdfs, quantity=150)
+    assert len(got) == len(exp) > 0
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_q19_matches_pandas(env):
+    import cylon_tpu as ct
+    # Q19's conjunctions select ~1e-5 of lineitem; this scale keeps a
+    # handful of qualifying rows so the assertion is non-vacuous
+    pdfs = tpch.generate_pandas(scale=0.05, seed=19)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q19(dfs, env=env)
+    exp = tpch.q19_pandas(pdfs)
+    assert exp != 0.0
+    assert got == pytest.approx(exp, rel=1e-9)
