@@ -107,9 +107,19 @@ class DataFrame:
             return self
         return DataFrame(self.to_pandas(), env=env)
 
+    def _index_cols(self) -> list:
+        """Index column names as a list: [] (range index), one name, or
+        several (multi-index, reference index.hpp:36 over indexer.hpp:76)."""
+        if self._index is None:
+            return []
+        if isinstance(self._index, tuple):
+            return list(self._index)
+        return [self._index]
+
     def _wrap(self, table: Table, keep_index: bool = False) -> "DataFrame":
         out = DataFrame(_table=table)
-        if keep_index and self._index in table.column_names:
+        idx = self._index_cols()
+        if keep_index and idx and all(c in table.column_names for c in idx):
             out._index = self._index
             out._index_drop = self._index_drop
         return out
@@ -118,7 +128,7 @@ class DataFrame:
         """Columns present in the physical table but not user-visible (a
         dropped-into-index column)."""
         if self._index is not None and self._index_drop:
-            return {self._index}
+            return set(self._index_cols())
         return set()
 
     def _visible_table(self) -> Table:
@@ -168,21 +178,31 @@ class DataFrame:
         return ILocIndexer(self)
 
     @property
-    def index(self) -> np.ndarray:
+    def index(self):
         if self._index is None:
             return np.arange(len(self))
-        return self[self._index].to_numpy()
+        idx = self._index_cols()
+        if len(idx) == 1:
+            return self._col_series(idx[0]).to_numpy()
+        import pandas as pd
+        return pd.MultiIndex.from_arrays(
+            [self._col_series(c).to_numpy() for c in idx], names=idx)
 
-    def set_index(self, name: str, drop: bool = True) -> "DataFrame":
-        """Use column ``name`` as the row-label index (reference
-        Table::SetArrowIndex, table.hpp:164).  ``drop`` follows pandas:
-        drop=True (default) removes the column from the visible columns —
-        it lives on as the index (physically retained for loc) — while
-        drop=False keeps it addressable as a data column too."""
-        if name not in self._table:
-            raise CylonKeyError(f"no column {name!r}")
+    def set_index(self, name, drop: bool = True) -> "DataFrame":
+        """Use column ``name`` (or a LIST of columns — multi-index,
+        reference index.hpp:36 / indexer.hpp:76) as the row-label index
+        (reference Table::SetArrowIndex, table.hpp:164).  ``drop`` follows
+        pandas: drop=True (default) removes the column(s) from the visible
+        columns — they live on as the index (physically retained for loc)
+        — while drop=False keeps them addressable as data columns too."""
+        names = [name] if isinstance(name, str) else list(name)
+        if not names:
+            raise CylonKeyError("set_index needs at least one column")
+        for n in names:
+            if n not in self._table:
+                raise CylonKeyError(f"no column {n!r}")
         out = DataFrame(_table=self._table)
-        out._index = name
+        out._index = names[0] if len(names) == 1 else tuple(names)
         out._index_drop = bool(drop)
         return out
 
@@ -195,11 +215,13 @@ class DataFrame:
     # -- materialization ---------------------------------------------------
     def to_pandas(self):
         df = self._table.to_pandas()
-        if self._index is not None:
-            df = df.set_index(self._index, drop=self._index_drop)
-            if not self._index_drop:
+        idx = self._index_cols()
+        if idx:
+            df = df.set_index(idx if len(idx) > 1 else idx[0],
+                              drop=self._index_drop)
+            if not self._index_drop and len(idx) == 1:
                 # pandas keeps the column AND names the index after it
-                df.index.name = self._index
+                df.index.name = idx[0]
         return df
 
     def to_arrow(self):
@@ -551,8 +573,9 @@ class DataFrame:
         mapped = pdf.map(func)
         if self._index is None:
             return DataFrame(mapped, env=self.env)
-        out = DataFrame(mapped.reset_index(names=self._index), env=self.env)
-        return out.set_index(self._index, drop=self._index_drop)
+        idx = self._index_cols()
+        out = DataFrame(mapped.reset_index(names=idx), env=self.env)
+        return out.set_index(idx, drop=self._index_drop)
 
     def iterrows(self):
         """Host-side row iteration, pandas-compatible (reference Row

@@ -59,24 +59,45 @@ class LocIndexer:
         self._df = df
 
     def __getitem__(self, key):
-        cols = None
-        if isinstance(key, tuple) and len(key) == 2:
-            key, cols = key
         df = self._df
+        multi = isinstance(df._index, tuple)
+        cols = None
+        if isinstance(key, tuple) and len(key) == 2 and not multi:
+            key, cols = key
+        if multi and isinstance(key, tuple) and len(key) == 2 \
+                and not self._is_label_tuple(key):
+            # (row_key, cols) disambiguation: a 2-tuple whose parts are not
+            # plausible level values is the pandas (rows, columns) form
+            key, cols = key
         name = df._index
         if name is None or name == RANGE_INDEX:
             out = self._range_loc(key)
+        elif multi:
+            out = self._label_loc_multi(key, list(name))
         else:
             out = self._label_loc(key, name)
         if cols is not None:
             cols = [cols] if isinstance(cols, str) else list(cols)
-            keep = ([df._index] if df._index not in (None, RANGE_INDEX) else []
-                    ) + cols
+            keep = df._index_cols() + cols
             out = out._wrap(out._table.project(
                 [c for c in out._table.column_names if c in set(keep)]))
             out._index = df._index
             out._index_drop = df._index_drop
         return out
+
+    def _is_label_tuple(self, key) -> bool:
+        """Heuristic for multi-index ``loc[(a, b)]`` vs ``loc[rows, cols]``:
+        a label tuple has only level-value parts (scalars, strings,
+        timestamps, any non-container object) — the (rows, cols) form has
+        a container/slice/Series part."""
+        if not isinstance(key, tuple):
+            return False
+        nlev = len(self._df._index_cols())
+        if len(key) > nlev:
+            return False
+        from ..series import Series
+        return not any(isinstance(p, (list, tuple, slice, np.ndarray,
+                                      Series)) for p in key)
 
     def _range_loc(self, key):
         df = self._df
@@ -126,6 +147,90 @@ class LocIndexer:
         out._index = df._index
         out._index_drop = df._index_drop
         return out
+
+
+    # -- multi-index (reference index.hpp:36 types over indexer.hpp:76) ----
+
+    def _multi_eq_mask(self, labels: tuple, names: list):
+        """Conjunction of level equalities for a (possibly partial) label
+        tuple — leading levels only, like pandas partial indexing."""
+        df = self._df
+        mask = None
+        for lv, lb in zip(names, labels):
+            m = df._col_series(lv) == lb
+            mask = m if mask is None else (mask & m)
+        return mask
+
+    def _lex_bound_mask(self, bound: tuple, names: list, is_start: bool):
+        """Lexicographic >= start / <= stop over the index levels (loc
+        slice endpoints inclusive, reference contract).  ``bound`` may
+        cover a prefix of the levels; rows equal on the prefix count as
+        inside the bound."""
+        df = self._df
+        mask = None          # built innermost-out
+        for lv, b in reversed(list(zip(names, bound))):
+            s = df._col_series(lv)
+            strict = (s > b) if is_start else (s < b)
+            if mask is None:
+                mask = strict | (s == b)
+            else:
+                mask = strict | ((s == b) & mask)
+        return mask
+
+    def _label_loc_multi(self, key, names: list):
+        df = self._df
+        if isinstance(key, slice):
+            def as_tuple(x):
+                if x is None:
+                    return None
+                return x if isinstance(x, tuple) else (x,)
+            lo, hi = as_tuple(key.start), as_tuple(key.stop)
+            mask = None
+            if lo is not None:
+                mask = self._lex_bound_mask(lo, names, True)
+            if hi is not None:
+                m2 = self._lex_bound_mask(hi, names, False)
+                mask = m2 if mask is None else (mask & m2)
+            if mask is None:
+                return df
+            out = df._wrap(filter_table(df._table, _series_flag(mask)))
+        elif isinstance(key, list):
+            labels = [k if isinstance(k, tuple) else (k,) for k in key]
+            masks = [self._multi_eq_mask(lb, names) for lb in labels]
+            # presence checks must ignore PADDING rows (their contents are
+            # unspecified — post-concat padding can hold stale values that
+            # fake a hit); ONE host sync covers every label
+            vc = df._table.valid_counts
+            cap = max(df._table.capacity, 1)
+            live = np.concatenate(
+                [np.arange(cap) < int(vc[s]) for s in range(len(vc))])
+            hits = np.asarray(jnp.stack(
+                [jnp.sum(_series_flag(m) & live) for m in masks]))
+            for lb, h in zip(labels, hits):
+                if int(h) == 0:
+                    raise CylonKeyError(f"label {lb!r} not found in index")
+            mask = masks[0]
+            for m in masks[1:]:
+                mask = mask | m
+            out = df._wrap(filter_table(df._table, _series_flag(mask)))
+        else:
+            labels = key if isinstance(key, tuple) else (key,)
+            if len(labels) > len(names):
+                raise CylonKeyError(
+                    f"label tuple {labels!r} longer than the "
+                    f"{len(names)}-level index")
+            mask = self._multi_eq_mask(labels, names)
+            out = df._wrap(filter_table(df._table, _series_flag(mask)))
+            if len(out) == 0:
+                raise CylonKeyError(f"label {key!r} not found in index")
+        out._index = df._index
+        out._index_drop = df._index_drop
+        return out
+
+
+def _series_flag(mask):
+    from ..relational.common import valid_flag
+    return valid_flag(mask.column)
 
 
 class ILocIndexer:

@@ -92,3 +92,106 @@ def test_range_loc(df):
     got = d.loc[2:4].to_pandas()  # inclusive on range index
     pd.testing.assert_frame_equal(got, data.iloc[2:5].reset_index(drop=True),
                                   check_dtype=False)
+
+
+# -- multi-column index (C24, reference indexer.hpp:76 / index.hpp:36) ------
+
+@pytest.fixture()
+def mdf(env4):
+    data = pd.DataFrame({
+        "a": ["x", "x", "x", "y", "y", "z", "z", "z"],
+        "b": [1, 2, 3, 1, 2, 1, 2, 3],
+        "v": np.arange(8) * 2.0,
+        "w": np.arange(8, dtype=np.int64),
+    })
+    return ct.DataFrame(data, env=env4), data
+
+
+def test_multi_set_index_roundtrip(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    assert m.columns == ["v", "w"]
+    got = m.to_pandas()
+    exp = data.set_index(["a", "b"])
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    back = m.reset_index().to_pandas()
+    pd.testing.assert_frame_equal(back, data, check_dtype=False)
+
+
+def test_multi_loc_full_tuple(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    got = m.loc[("y", 2)].to_pandas()
+    exp = data[(data.a == "y") & (data.b == 2)].set_index(["a", "b"])
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_multi_loc_partial(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    got = m.loc["z"].to_pandas()
+    exp = data[data.a == "z"].set_index(["a", "b"])
+    # level retention differs from pandas partial loc (which drops the
+    # matched level, like the reference's table-out loc keeps all keys);
+    # compare data content
+    assert got["v"].tolist() == exp["v"].tolist()
+    assert got["w"].tolist() == exp["w"].tolist()
+
+
+def test_multi_loc_list_of_tuples(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    got = m.loc[[("x", 1), ("z", 3)]].to_pandas()
+    sel = data[((data.a == "x") & (data.b == 1))
+               | ((data.a == "z") & (data.b == 3))]
+    assert sorted(got["w"].tolist()) == sorted(sel["w"].tolist())
+
+
+def test_multi_loc_slice_lexicographic(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    got = m.loc[("x", 2):("z", 1)].to_pandas()
+    exp = (data.set_index(["a", "b"]).sort_index()
+           .loc[("x", 2):("z", 1)])
+    assert sorted(got["w"].tolist()) == sorted(exp["w"].tolist())
+
+
+def test_multi_loc_missing_raises(mdf):
+    d, _ = mdf
+    m = d.set_index(["a", "b"])
+    with pytest.raises(CylonKeyError):
+        m.loc[("q", 9)]
+    with pytest.raises(CylonKeyError):
+        m.loc[[("x", 1), ("q", 9)]]
+    with pytest.raises(CylonKeyError):
+        m.loc[("x", 1, 5)]
+
+
+def test_multi_loc_rows_cols_form(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    got = m.loc[[("y", 1)], "v"].to_pandas()
+    assert got.columns.tolist() == ["v"] or got["v"].notna().all()
+    sel = data[(data.a == "y") & (data.b == 1)]
+    assert got["v"].tolist() == sel["v"].tolist()
+
+
+def test_multi_index_survives_filter_sort(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"])
+    f = m[m["w"] >= 3].sort_values("v", ascending=False)
+    got = f.to_pandas()
+    exp = (data[data.w >= 3].sort_values("v", ascending=False)
+           .set_index(["a", "b"]))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+def test_multi_set_index_keep_columns(mdf):
+    d, data = mdf
+    m = d.set_index(["a", "b"], drop=False)
+    assert "a" in m.columns and "b" in m.columns
+    got = m.to_pandas()
+    exp = data.set_index(["a", "b"], drop=False)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
