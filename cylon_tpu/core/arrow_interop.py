@@ -88,8 +88,37 @@ def column_from_arrow(arr) -> Column:
                       np.zeros(n, bool))
 
     if pa.types.is_decimal(t):
+        if pa.types.is_decimal128(t) and t.precision <= 18:
+            # exact scaled-int64 (TPC-H money semantics; reference:
+            # decimal128 comparators, arrow_comparator.cpp).  The unscaled
+            # integer IS decimal128's two's-complement storage; for p<=18
+            # it lives in the low 64-bit limb (hi limb = sign extension),
+            # so the buffer view is exact and vectorized.
+            from .column import DecimalScale
+            raw = np.frombuffer(arr.buffers()[1], np.int64)
+            data = raw.reshape(-1, 2)[arr.offset:arr.offset + len(arr),
+                                      0].copy()
+            if validity is not None:
+                data[~validity] = 0   # null slots hold undefined storage
+            bounds = ((int(data.min()), int(data.max()))
+                      if data.size else None)
+            return Column(data, LogicalType.DECIMAL, validity,
+                          DecimalScale(t.precision, t.scale), bounds=bounds)
+        # p > 18 or decimal256: documented lossy float64 fallback
         arr = arr.cast(pa.float64())
         t = arr.type
+
+    if pa.types.is_list(t) or pa.types.is_large_list(t) \
+            or pa.types.is_fixed_size_list(t):
+        # host passthrough (no device layout for variable-length payloads;
+        # reference joins list<float32> locally, join_test.cpp:124 — here
+        # the values ride host-side and the CODES ride the device)
+        from .column import PassthroughValues
+        vals = np.asarray(arr.to_pylist(), dtype=object)
+        codes = np.arange(len(vals), dtype=np.int32)
+        return Column(codes, LogicalType.LIST, validity,
+                      PassthroughValues(vals),
+                      bounds=(0, max(len(vals) - 1, 0)))
 
     if pa.types.is_integer(t) or pa.types.is_floating(t):
         filled = arr.fill_null(0) if arr.null_count else arr
@@ -138,6 +167,13 @@ def table_to_arrow(table):
             arr = pa.array(data, type=pa.timestamp("ns"), mask=mask)
         elif c.type == LogicalType.TIMEDELTA:
             arr = pa.array(data, type=pa.duration("ns"), mask=mask)
+        elif c.type == LogicalType.DECIMAL:
+            sc = c.dictionary
+            arr = pa.array(sc.to_decimal(data),
+                           type=pa.decimal128(max(sc.precision, 1),
+                                              sc.scale), mask=mask)
+        elif c.type == LogicalType.LIST:
+            arr = pa.array(list(c.dictionary.take(data)), mask=mask)
         else:
             arr = pa.array(data, mask=mask)
         arrays.append(arr)

@@ -93,6 +93,64 @@ def hashed_codes(values: np.ndarray):
     return hashes.view(np.int64), HashedStrings(hashes, values)
 
 
+class DecimalScale:
+    """DECIMAL column metadata (rides the ``Column.dictionary`` slot like
+    HashedStrings, so every column rebuild site propagates it untouched):
+    device data is the UNSCALED int64 (value · 10^scale) — exact TPC-H
+    money semantics for precision <= 18 (reference: Arrow decimal128
+    comparators, arrow_comparator.cpp).  Equality/order on the scaled ints
+    equals decimal equality/order at a COMMON scale, so joins, groupbys,
+    sorts and filters all work on the physical column."""
+
+    __slots__ = ("precision", "scale")
+
+    def __init__(self, precision: int, scale: int):
+        if precision > 18:
+            raise CylonTypeError(
+                f"decimal precision {precision} > 18 does not fit int64")
+        self.precision = int(precision)
+        self.scale = int(scale)
+
+    def __eq__(self, other):
+        return (isinstance(other, DecimalScale)
+                and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self):
+        return hash((DecimalScale, self.precision, self.scale))
+
+    def __repr__(self):  # pragma: no cover
+        return f"DecimalScale({self.precision}, {self.scale})"
+
+    def to_decimal(self, data: np.ndarray) -> np.ndarray:
+        import decimal
+        return np.asarray(
+            [decimal.Decimal(int(v)).scaleb(-self.scale) for v in data],
+            dtype=object)
+
+
+class PassthroughValues:
+    """Host-side passthrough 'dictionary' for values with no TPU device
+    layout (variable-length lists): device data is int32 row codes into a
+    host object array.  Carried through joins/filters/exchanges by the
+    same code gathers strings use; NOT usable as a key (codes are row
+    ids, not value-equal — key sites raise CylonTypeError)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: np.ndarray):
+        self.values = np.asarray(values, dtype=object)
+
+    def take(self, codes: np.ndarray) -> np.ndarray:
+        n = len(self.values)
+        if n == 0:
+            return np.asarray([None] * len(codes), dtype=object)
+        return self.values[np.clip(codes, 0, n - 1)]
+
+    def __len__(self):
+        return len(self.values)
+
+
 class Column:
     __slots__ = ("data", "validity", "type", "dictionary", "bounds")
 
@@ -111,6 +169,12 @@ class Column:
         self.bounds = bounds
         if type == LogicalType.STRING and dictionary is None:
             raise InvalidError("STRING column requires a dictionary")
+        if type == LogicalType.DECIMAL and not isinstance(dictionary,
+                                                          DecimalScale):
+            raise InvalidError("DECIMAL column requires a DecimalScale")
+        if type == LogicalType.LIST and not isinstance(dictionary,
+                                                       PassthroughValues):
+            raise InvalidError("LIST column requires PassthroughValues")
 
     # -- construction ------------------------------------------------------
     @staticmethod
@@ -137,15 +201,62 @@ class Column:
         return Column(arr, lt, bounds=bounds)
 
     @staticmethod
+    def _decimal_from_objects(arr: np.ndarray, mask: np.ndarray) -> "Column":
+        """Object array of decimal.Decimal -> scaled-int64 DECIMAL column
+        (exact for precision <= 18; reference: decimal128 comparators)."""
+        import decimal
+        vals = [v for v, m in zip(arr, mask) if not m]
+        scale = max((-v.as_tuple().exponent for v in vals), default=0)
+        scale = max(scale, 0)
+        data = np.zeros(len(arr), np.int64)
+        for i, (v, m) in enumerate(zip(arr, mask)):
+            if not m:
+                data[i] = int(decimal.Decimal(v).scaleb(scale))
+        validity = ~mask if mask.any() else None
+        bounds = ((int(data.min()), int(data.max())) if len(data) else None)
+        # tight precision (actual digit count): leaves headroom for later
+        # 10^Δ rescales against finer-scaled partners (the 18 cap is the
+        # int64 representation's, not each column's)
+        max_abs = int(np.abs(data).max()) if len(data) else 0
+        prec = max(len(str(max_abs)), 1)
+        return Column(data, LogicalType.DECIMAL, validity,
+                      DecimalScale(prec, scale), bounds=bounds)
+
+    @staticmethod
+    def _list_passthrough(arr: np.ndarray, mask: np.ndarray) -> "Column":
+        """Object array of lists -> host passthrough column (carried
+        through joins by code gathers; not usable as a key)."""
+        codes = np.arange(len(arr), dtype=np.int32)
+        validity = ~mask if mask.any() else None
+        return Column(codes, LogicalType.LIST, validity,
+                      PassthroughValues(arr),
+                      bounds=(0, max(len(arr) - 1, 0)))
+
+    @staticmethod
     def _encode_strings(arr: np.ndarray) -> "Column":
         if arr.dtype.kind == "S":  # binary: decode, don't repr-mangle
             arr = np.char.decode(arr, "utf-8")
         if arr.dtype == object:
             # pd.isna covers None, float NaN, pd.NA and NaT — a hand-rolled
             # None/NaN check silently stringifies pd.NA (pandas StringDtype
-            # nulls) into the literal "<NA>"
+            # nulls) into the literal "<NA>".  pd.isna on a cell holding a
+            # LIST returns an array — probe for nested values first.
             import pandas as pd
+            import decimal
+
+            def null_scalar(v):
+                # list cells make pd.isna return an ARRAY — guard them
+                if isinstance(v, (list, np.ndarray)):
+                    return False
+                return bool(pd.isna(v))   # None, NaN, pd.NA, NaT
+
+            probe = next((v for v in arr if not null_scalar(v)), None)
+            if isinstance(probe, (list, np.ndarray)):
+                mask = np.asarray([null_scalar(v) for v in arr], bool)
+                return Column._list_passthrough(arr, mask)
             mask = np.asarray(pd.isna(arr), bool)
+            if isinstance(probe, decimal.Decimal):
+                return Column._decimal_from_objects(arr, mask)
         else:
             mask = np.zeros(len(arr), bool)
         safe = np.where(mask, "", arr.astype(object)) if mask.any() else arr
@@ -154,20 +265,20 @@ class Column:
 
         def as_str(v):
             # documented rejection (SURVEY C6: the reference's comparators
-            # span every Arrow type incl. lists, join_test.cpp:124): nested
-            # and decimal values have no TPU device layout here — refuse
-            # loudly instead of silently stringifying a wrong answer.
-            # Enforced on EVERY converted value (the str fast paths below
-            # cannot hold nested values).
+            # span every Arrow type incl. lists, join_test.cpp:124): struct
+            # values have no TPU device layout OR passthrough mode here —
+            # refuse loudly instead of silently stringifying a wrong
+            # answer.  (Lists take the passthrough path above; decimals
+            # the scaled-int64 path.)
             if isinstance(v, (list, tuple, dict, np.ndarray)):
                 raise CylonTypeError(
-                    "list/struct columns are not supported on the TPU "
-                    "device layout; explode or serialize them before "
+                    "struct/mixed nested columns are not supported on the "
+                    "TPU device layout; explode or serialize them before "
                     "ingest")
             if isinstance(v, decimal.Decimal):
                 raise CylonTypeError(
-                    "decimal columns are not supported; cast to float64 "
-                    "(or scaled int64) before ingest")
+                    "mixed decimal/str column; cast uniformly before "
+                    "ingest")
             if isinstance(v, (bytes, np.bytes_)):
                 return v.decode("utf-8", "replace")
             return str(v)
@@ -226,6 +337,17 @@ class Column:
         data = np.asarray(self.data)[: n if n is not None else len(self)]
         valid = (np.asarray(self.validity)[: len(data)]
                  if self.validity is not None else None)
+        if self.type == LogicalType.DECIMAL:
+            out = self.dictionary.to_decimal(data)
+            if valid is not None:
+                out[~valid] = None
+            return out
+        if self.type == LogicalType.LIST:
+            out = np.asarray(self.dictionary.take(data), dtype=object)
+            if valid is not None:
+                out = out.copy()
+                out[~valid] = None
+            return out
         if self.type == LogicalType.STRING:
             if isinstance(self.dictionary, HashedStrings):
                 out = self.dictionary.take(data)

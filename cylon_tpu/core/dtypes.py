@@ -35,6 +35,8 @@ class LogicalType(enum.Enum):
     STRING = "string"          # dictionary-encoded, codes int32
     DATE64 = "datetime64[ns]"  # physical int64 nanoseconds
     TIMEDELTA = "timedelta64[ns]"
+    DECIMAL = "decimal"        # physical int64, scaled by DecimalScale
+    LIST = "list"              # host passthrough: int32 codes into values
 
 
 _NUMERIC_NP = {
@@ -56,9 +58,10 @@ _FLOATS = (LogicalType.FLOAT32, LogicalType.FLOAT64)
 
 def physical_np_dtype(lt: LogicalType) -> np.dtype:
     """The numpy dtype of the device representation of ``lt``."""
-    if lt == LogicalType.STRING:
+    if lt in (LogicalType.STRING, LogicalType.LIST):
         return np.dtype(np.int32)
-    if lt in (LogicalType.DATE64, LogicalType.TIMEDELTA):
+    if lt in (LogicalType.DATE64, LogicalType.TIMEDELTA,
+              LogicalType.DECIMAL):
         return np.dtype(np.int64)
     d = np.dtype(_NUMERIC_NP[lt])
     if not config.X64_ENABLED and d.itemsize == 8:

@@ -137,13 +137,23 @@ def col_arrays(cols: list[Column]):
 
 
 def promote_key_pair(a: Column, b: Column) -> tuple[Column, Column]:
-    """Make a cross-table key pair comparable: unify string dictionaries or
-    promote numerics to a common logical type (the reference requires
-    type-equal join keys; we additionally auto-promote numerics)."""
+    """Make a cross-table key pair comparable: unify string dictionaries,
+    rescale decimals to a common scale, or promote numerics to a common
+    logical type (the reference requires type-equal join keys; we
+    additionally auto-promote numerics)."""
+    if LogicalType.LIST in (a.type, b.type):
+        raise CylonTypeError(
+            "list passthrough columns cannot be keys (codes are row ids, "
+            "not value-equal); they carry through joins as payload only")
     if (a.type == LogicalType.STRING) != (b.type == LogicalType.STRING):
         raise CylonTypeError(f"cannot join {a.type} with {b.type}")
     if a.type == LogicalType.STRING:
         return unify_dictionaries(a, b)
+    if (a.type == LogicalType.DECIMAL) != (b.type == LogicalType.DECIMAL):
+        raise CylonTypeError(
+            f"cannot join {a.type} with {b.type}; rescale explicitly")
+    if a.type == LogicalType.DECIMAL:
+        return rescale_decimal_pair(a, b)
     if a.type == b.type:
         return a, b
     common = np.promote_types(physical_np_dtype(a.type), physical_np_dtype(b.type))
@@ -152,6 +162,34 @@ def promote_key_pair(a: Column, b: Column) -> tuple[Column, Column]:
     if lt is None:
         raise CylonTypeError(f"no common key type for {a.type}/{b.type}")
     return a.cast(lt), b.cast(lt)
+
+
+def rescale_decimal_pair(a: Column, b: Column) -> tuple[Column, Column]:
+    """Bring two DECIMAL columns to one scale (the larger): the scaled
+    int64s then compare/join exactly.  10^Δ rescale is exact while the
+    values stay within precision 18 (the ingest bound)."""
+    from ..core.column import DecimalScale
+    sa, sb = a.dictionary, b.dictionary
+    if sa == sb:
+        return a, b
+    # shared target: the larger scale, with precision covering BOTH sides'
+    # 10^Δ-scaled digits (a coalesced outer-join key may hold either
+    # side's values under one declared type).  Past 18 digits the int64
+    # representation genuinely cannot hold it — DecimalScale raises the
+    # clear error.
+    scale = max(sa.scale, sb.scale)
+    target = DecimalScale(max(sa.precision + scale - sa.scale,
+                              sb.precision + scale - sb.scale), scale)
+
+    def up(c: Column, own: DecimalScale) -> Column:
+        f = 10 ** (scale - own.scale)
+        bounds = ((c.bounds[0] * f, c.bounds[1] * f)
+                  if c.bounds is not None else None)
+        # python-int multiplier: jax weak typing keeps the data's dtype
+        return Column(c.data * f if f != 1 else c.data, LogicalType.DECIMAL,
+                      c.validity, target, bounds=bounds)
+
+    return up(a, sa), up(b, sb)
 
 
 def to_hashed_strings(c: Column) -> Column:

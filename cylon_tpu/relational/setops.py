@@ -84,6 +84,12 @@ def unique_table(table: Table, subset=None, keep: str = "first") -> Table:
     subset = list(subset) if subset is not None else table.column_names
     if keep not in ("first", "last"):
         raise InvalidError("keep must be 'first' or 'last'")
+    from ..core.dtypes import LogicalType
+    for n in subset:
+        if table.column(n).type == LogicalType.LIST:
+            raise InvalidError(
+                f"unique on list passthrough column {n!r} is not supported "
+                "(codes are row ids, not value-equal)")
     if env.world_size > 1:
         table = shuffle_table(table, subset)
     key_datas, key_valids = col_arrays([table.column(n) for n in subset])
@@ -185,7 +191,14 @@ def set_operation(a: Table, b: Table, op: str,
     resident side once, exec/pipeline.pipelined_set_op).
 
     Device OOM falls back to the streaming chunked pipeline."""
+    from ..core.dtypes import LogicalType
     from .common import run_with_oom_fallback
+    for t in (a, b):
+        for n in t.column_names:
+            if t.column(n).type == LogicalType.LIST:
+                raise InvalidError(
+                    f"set op on a table with list passthrough column {n!r} "
+                    "is not supported (rows are compared by value)")
 
     def fb(nc):
         from ..exec.pipeline import pipelined_set_op

@@ -123,25 +123,32 @@ def test_exact_capacity_all_live_ops(env8, rng):
         s, ge.sort_values("k").reset_index(drop=True), check_dtype=False)
 
 
-def test_nested_and_decimal_columns_rejected(env1):
-    """Documented rejection (round-2 VERDICT missing #2): nested/decimal
-    values must raise a clear error, never silently stringify."""
+def test_nested_and_decimal_columns_ingest(env1):
+    """Round-4 (VERDICT r03 item 7): decimals ingest as exact scaled-int64,
+    lists as host passthrough columns (tests/test_decimal_list.py covers
+    the op surface); struct values still raise a clear error, never a
+    silent stringify."""
     import decimal
+    from cylon_tpu.core.dtypes import LogicalType
     from cylon_tpu.status import CylonTypeError
-    with pytest.raises(CylonTypeError, match="list/struct"):
-        ct.Table.from_pandas(pd.DataFrame({"x": pd.Series([[1, 2], [3]])}),
+    t = ct.Table.from_pandas(pd.DataFrame({"x": pd.Series([[1, 2], [3]])}),
                              env1)
-    with pytest.raises(CylonTypeError, match="decimal"):
-        ct.Table.from_pandas(
-            pd.DataFrame({"x": [decimal.Decimal("1.5")]}), env1)
+    assert t.column("x").type == LogicalType.LIST
+    t = ct.Table.from_pandas(
+        pd.DataFrame({"x": [decimal.Decimal("1.5")]}), env1)
+    assert t.column("x").type == LogicalType.DECIMAL
+    with pytest.raises(CylonTypeError, match="struct"):
+        ct.Table.from_pandas(pd.DataFrame({"x": [{"a": 1}, {"a": 2}]}),
+                             env1)
     # bytes stay supported: utf-8 decode into the string layout
     t = ct.Table.from_pandas(pd.DataFrame({"x": [b"ab", b"cd"]}), env1)
     assert t.to_pandas()["x"].tolist() == ["ab", "cd"]
 
 
 def test_nested_value_rejected_anywhere_in_column(env1):
-    """The rejection must cover EVERY value, not a prefix sample."""
+    """Mixed str+list columns must still raise (the type probe sees a str
+    prefix, so the per-value guard must cover EVERY value)."""
     from cylon_tpu.status import CylonTypeError
     vals = ["s"] * 500 + [[1, 2]] + ["t"] * 10
-    with pytest.raises(CylonTypeError, match="list/struct"):
+    with pytest.raises(CylonTypeError, match="struct|nested"):
         ct.Table.from_pandas(pd.DataFrame({"x": pd.Series(vals)}), env1)
