@@ -2,4 +2,4 @@
 
 from .io import (read_csv, read_csv_dist, read_json, read_parquet,  # noqa: F401
                  read_parquet_dist, write_csv, write_csv_dist, write_json,
-                 write_parquet, write_parquet_dist)
+                 write_json_dist, write_parquet, write_parquet_dist)

@@ -223,6 +223,19 @@ def write_parquet_dist(table: Table, path, **kwargs) -> list[str]:
     return out
 
 
+def write_json_dist(table: Table, path, **kwargs) -> list[str]:
+    """One JSON file per shard (reference distributed_io.py:275-383 writes
+    csv/json/parquet per rank)."""
+    kwargs.setdefault("orient", "records")
+    kwargs.setdefault("lines", True)
+    out = []
+    for rank, df in _shard_frames(table):
+        p = _dist_path(path, rank)
+        df.to_json(p, **kwargs)
+        out.append(p)
+    return out
+
+
 # -- distributed readers (file-division semantics) --------------------------
 
 def read_csv_dist(paths, env: CylonEnv, **kwargs) -> Table:

@@ -91,6 +91,12 @@ def test_write_dist(tmp_path, env4, data):
     back2 = pd.concat([pd.read_parquet(f) for f in pfiles],
                       ignore_index=True)
     pd.testing.assert_frame_equal(back2, data, check_dtype=False)
+    from cylon_tpu.io import write_json_dist
+    jfiles = write_json_dist(t, str(tmp_path / "out.json"))
+    assert len(jfiles) == 4
+    back3 = pd.concat([pd.read_json(f, orient="records", lines=True)
+                       for f in jfiles], ignore_index=True)
+    pd.testing.assert_frame_equal(back3, data, check_dtype=False)
 
 
 def test_dist_writers_stream_per_shard(tmp_path, env8, rng):

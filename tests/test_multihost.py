@@ -19,7 +19,11 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_join_groupby_sort():
+@pytest.mark.parametrize("nproc", [2, 4])
+def test_multi_process_join_groupby_sort(nproc):
+    """2- and 4-process worlds (reference test_all.py runs mpirun -n {2,4});
+    the 4-process case exercises the multi-controller paths in
+    _shard_frames/host pulls beyond W=2."""
     driver = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -27,10 +31,10 @@ def test_two_process_join_groupby_sort():
     env.pop("XLA_FLAGS", None)
     env.pop("JAX_PLATFORMS", None)
     procs = [subprocess.Popen(
-        [sys.executable, driver, str(i), "2", coord],
+        [sys.executable, driver, str(i), str(nproc), coord],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(driver))))
-        for i in range(2)]
+        for i in range(nproc)]
     outs = []
     try:
         for p in procs:
@@ -42,4 +46,4 @@ def test_two_process_join_groupby_sort():
                 p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
-        assert f"MULTIHOST_OK pid={i} world=8" in out, out[-2000:]
+        assert f"MULTIHOST_OK pid={i} world={4 * nproc}" in out, out[-2000:]
