@@ -648,11 +648,12 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
                           lambda p=p: _combine_fn(env.mesh, ops_t, sc,
                                                   False, narrow, cspec,
                                                   val_map, p)(*cargs))
-                         for p in (0, 1)]
-                        + [("sort+split2",
-                            lambda: _combine_fn(env.mesh, ops_t, sc, False,
-                                                narrow, cspec, val_map, 0,
-                                                2)(*cargs))]) \
+                         for p in (0, 1, 2)]
+                        + [(f"sort+pad{pads}split{parts}",
+                            lambda pads=pads, parts=parts: _combine_fn(
+                                env.mesh, ops_t, sc, False, narrow, cspec,
+                                val_map, pads, parts)(*cargs))
+                           for pads, parts in ((0, 2), (1, 2), (0, 4))]) \
                 if cspec is not None else []
             attempts.append(
                 ("scatter",
@@ -706,11 +707,13 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
         fattempts = [(f"sort+pad{p}",
                       lambda p=p: _final_fn(env.mesh, ops_t, fin_cap, ddof,
                                             narrow, p)(*fargs))
-                     for p in (0, 1)]
-        fattempts.append(
-            ("sort+split2", lambda: _final_fn(env.mesh, ops_t, fin_cap,
-                                              ddof, narrow, 0, True,
-                                              2)(*fargs)))
+                     for p in (0, 1, 2)]
+        for pads, parts in ((0, 2), (1, 2), (0, 4)):
+            fattempts.append(
+                (f"sort+pad{pads}split{parts}",
+                 lambda pads=pads, parts=parts: _final_fn(
+                     env.mesh, ops_t, fin_cap, ddof, narrow, pads, True,
+                     parts)(*fargs)))
         fattempts.append(
             ("scatter", lambda: _final_fn(env.mesh, ops_t, fin_cap, ddof,
                                           narrow, 0, False)(*fargs)))
@@ -767,15 +770,21 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     args = (vc, by_datas, by_valids, uval_datas, uval_valids)
 
     def raw_call(sc):
+        # widened ladder (round 4): the scatter terminal compiles
+        # pathologically at multi-M segment spaces (observed live: >55 min
+        # at TPC-H SF5 Q18), so give the sort path more width-shifting
+        # chances (pad2, pad1+split2, split4) before surrendering to it
         attempts = [(f"sort+pad{p}",
                      lambda p=p: _raw_fn(env.mesh, spec_t, sc, ddof, grouped,
                                          narrow, vnarrow, vspec, val_map,
                                          p)(*args))
-                    for p in (0, 1)]
-        attempts.append(
-            ("sort+split2",
-             lambda: _raw_fn(env.mesh, spec_t, sc, ddof, grouped, narrow,
-                             vnarrow, vspec, val_map, 0, True, 2)(*args)))
+                    for p in (0, 1, 2)]
+        for pads, parts in ((0, 2), (1, 2), (0, 4)):
+            attempts.append(
+                (f"sort+pad{pads}split{parts}",
+                 lambda pads=pads, parts=parts: _raw_fn(
+                     env.mesh, spec_t, sc, ddof, grouped, narrow,
+                     vnarrow, vspec, val_map, pads, True, parts)(*args)))
         attempts.append(
             ("scatter", lambda: _raw_fn(env.mesh, spec_t, sc, ddof, grouped,
                                         narrow, vnarrow, None, val_map, 0,
