@@ -350,11 +350,16 @@ class DataFrame:
         return self._wrap(t)
 
     def sort_values(self, by, ascending=True, nulls_position: str = "last",
-                    env: CylonEnv | None = None) -> "DataFrame":
+                    env: CylonEnv | None = None,
+                    method: str = "initial") -> "DataFrame":
+        """``method``: "initial" (sample-first) or "regular" (local-sort
+        first, quantile-exact splitters) — the reference's two distributed
+        sort strategies (SortOptions, table.cpp:761)."""
         env = _resolve_env(self.env, env)
         return self._wrap(sort_table(self._to_env(env)._table, by,
                                      ascending=ascending,
-                                     nulls_position=nulls_position),
+                                     nulls_position=nulls_position,
+                                     method=method),
                           keep_index=True)
 
     def groupby(self, by, env: CylonEnv | None = None) -> "GroupByDataFrame":

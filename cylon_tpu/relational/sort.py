@@ -173,8 +173,23 @@ def _pick_splitters(sample_ops, live, w: int):
 
 def sort_table(table: Table, by, ascending=True,
                nulls_position: str = "last",
-               num_samples: int = DEFAULT_SAMPLES) -> Table:
-    """Sort ``table`` globally by key columns ``by``."""
+               num_samples: int = DEFAULT_SAMPLES,
+               method: str = "initial") -> Table:
+    """Sort ``table`` globally by key columns ``by``.
+
+    ``method`` selects the reference's two sample-sort strategies
+    (table.cpp:761 dispatch):
+
+    * ``"initial"`` (default) — ``DistributedSortInitialSampling``
+      (table.cpp:692): sample the UNSORTED shards, range-partition, one
+      local sort.  One sort pass; splitter quality rests on uniform
+      position sampling.
+    * ``"regular"`` — ``DistributedSortRegularSampling`` (table.cpp:620):
+      LOCAL SORT first, then sample the sorted runs — evenly spaced
+      positions of a sorted shard are its exact per-shard quantiles, so
+      splitters are distribution-robust; costs a second local sort after
+      the exchange (the reference pays a k-way merge there instead,
+      :436 — on TPU a re-sort IS the merge, see module docstring)."""
     env = table.env
     by = [by] if isinstance(by, str) else list(by)
     if not by:
@@ -194,9 +209,15 @@ def sort_table(table: Table, by, ascending=True,
             raise InvalidError(
                 f"sort on list passthrough column {n!r} is not supported "
                 "(codes are row ids, not value-ordered)")
+    if method not in ("initial", "regular"):
+        raise InvalidError("sort method must be 'initial' or 'regular'")
+    w = env.world_size
+    if method == "regular" and w > 1 and table.row_count > 0:
+        # quantile-exact splitter samples come from the SORTED shards
+        table = local_sort_table(table, by, ascending, nulls_position)
+        by_cols = [table.column(n) for n in by]
     by_datas, by_valids = col_arrays(by_cols)
     vc = np.asarray(table.valid_counts, np.int32)
-    w = env.world_size
 
     narrow_keys = narrow32_flags(by_cols)
     if w > 1 and table.row_count > 0:

@@ -95,3 +95,30 @@ def test_sort_skewed(env8, rng):
     got = sort_table(t, "k").to_pandas()
     assert got["k"].is_monotonic_increasing
     assert sorted(got["v"].tolist()) == list(range(400))
+
+
+@pytest.mark.parametrize("method", ["initial", "regular"])
+def test_sort_strategies_match(env8, rng, method):
+    """Both reference sort strategies (DistributedSortRegularSampling
+    table.cpp:620 / InitialSampling :692) produce the same globally
+    sorted result; regular's quantile-exact splitters must also keep
+    shards balanced under a skewed distribution."""
+    n = 40_000
+    keys = np.minimum(rng.zipf(1.4, n), 500).astype(np.int64)
+    df = pd.DataFrame({"k": keys, "v": rng.random(n)})
+    t = ct.Table.from_pandas(df, env8)
+    out = sort_table(t, "k", method=method)
+    got = out.to_pandas()
+    assert got["k"].is_monotonic_increasing
+    assert sorted(got["v"].tolist()) == sorted(df["v"].tolist())
+    if method == "regular":
+        top_run = int(pd.Series(keys).value_counts().iloc[0])
+        assert int(out.valid_counts.max()) <= max(2 * (n // 8),
+                                                  top_run + n // 8)
+
+
+def test_sort_method_validation(env4, rng):
+    t = ct.Table.from_pandas(pd.DataFrame({"k": [3, 1, 2]}), env4)
+    from cylon_tpu.status import InvalidError
+    with pytest.raises(InvalidError):
+        sort_table(t, "k", method="bogus")
