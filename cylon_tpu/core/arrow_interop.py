@@ -169,9 +169,14 @@ def table_to_arrow(table):
             arr = pa.array(data, type=pa.duration("ns"), mask=mask)
         elif c.type == LogicalType.DECIMAL:
             sc = c.dictionary
+            # precision must cover the scale: a tight ingested precision
+            # (digit count of the max unscaled int) can be smaller than
+            # the scale — e.g. [0.01, 0.02] -> (1, 2) — and Arrow rejects
+            # decimal128(1, 2)
             arr = pa.array(sc.to_decimal(data),
-                           type=pa.decimal128(max(sc.precision, 1),
-                                              sc.scale), mask=mask)
+                           type=pa.decimal128(
+                               max(sc.precision, sc.scale, 1),
+                               sc.scale), mask=mask)
         elif c.type == LogicalType.LIST:
             arr = pa.array(list(c.dictionary.take(data)), mask=mask)
         else:

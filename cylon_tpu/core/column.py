@@ -206,12 +206,25 @@ class Column:
         (exact for precision <= 18; reference: decimal128 comparators)."""
         import decimal
         vals = [v for v, m in zip(arr, mask) if not m]
-        scale = max((-v.as_tuple().exponent for v in vals), default=0)
+        try:
+            # TypeError also covers non-finite Decimals (NaN/Infinity),
+            # whose as_tuple().exponent is a str
+            scale = max((-v.as_tuple().exponent for v in vals), default=0)
+        except (AttributeError, TypeError) as e:
+            raise CylonTypeError(
+                "mixed or non-finite decimal column; cast uniformly "
+                "before ingest") from e
         scale = max(scale, 0)
         data = np.zeros(len(arr), np.int64)
         for i, (v, m) in enumerate(zip(arr, mask)):
             if not m:
-                data[i] = int(decimal.Decimal(v).scaleb(scale))
+                try:
+                    data[i] = int(decimal.Decimal(v).scaleb(scale))
+                except (decimal.InvalidOperation, TypeError,
+                        ValueError) as e:
+                    raise CylonTypeError(
+                        "mixed decimal column; cast uniformly before "
+                        "ingest") from e
         validity = ~mask if mask.any() else None
         bounds = ((int(data.min()), int(data.max())) if len(data) else None)
         # tight precision (actual digit count): leaves headroom for later

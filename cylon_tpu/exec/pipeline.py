@@ -501,6 +501,9 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     from ..utils import timing
     with timing.region("pipe.build_sort"):
         rsorted = local_sort_table(rwork, right_on)
+        # hash shuffle above co-located equal keys; the per-shard sort
+        # makes them contiguous — together that is grouped_by's contract
+        rsorted.grouped_by = tuple(right_on)
         timing.maybe_block(next(iter(rsorted.columns.values())).data)
     del rwork
     w = env.world_size

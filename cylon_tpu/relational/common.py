@@ -166,20 +166,30 @@ def promote_key_pair(a: Column, b: Column) -> tuple[Column, Column]:
 
 def rescale_decimal_pair(a: Column, b: Column) -> tuple[Column, Column]:
     """Bring two DECIMAL columns to one scale (the larger): the scaled
-    int64s then compare/join exactly.  10^Δ rescale is exact while the
-    values stay within precision 18 (the ingest bound)."""
+    ints then compare/join exactly.  10^Δ rescale is exact while the
+    values stay within the representation's precision bound."""
+    a, b = rescale_decimals_many([a, b])
+    return a, b
+
+
+def rescale_decimals_many(cs: list[Column]) -> list[Column]:
+    """Bring N DECIMAL columns to ONE common scale in a single pass.
+    The shared target is the largest scale, with precision covering EVERY
+    column's 10^Δ-scaled digits (a coalesced outer-join key may hold any
+    side's values under one declared type).  Past the representation's
+    digit bound DecimalScale raises the clear error.
+
+    One pass matters: pairwise promotion of [s=1, s=1, s=4] rescales only
+    the columns it touches last, leaving earlier middles at a stale scale
+    while the batch takes the final dictionary — a silent value corruption
+    because decimals share int64 storage."""
     from ..core.column import DecimalScale
-    sa, sb = a.dictionary, b.dictionary
-    if sa == sb:
-        return a, b
-    # shared target: the larger scale, with precision covering BOTH sides'
-    # 10^Δ-scaled digits (a coalesced outer-join key may hold either
-    # side's values under one declared type).  Past 18 digits the int64
-    # representation genuinely cannot hold it — DecimalScale raises the
-    # clear error.
-    scale = max(sa.scale, sb.scale)
-    target = DecimalScale(max(sa.precision + scale - sa.scale,
-                              sb.precision + scale - sb.scale), scale)
+    scales = [c.dictionary for c in cs]
+    if all(s == scales[0] for s in scales[1:]):
+        return list(cs)
+    scale = max(s.scale for s in scales)
+    target = DecimalScale(max(s.precision + scale - s.scale for s in scales),
+                          scale)
 
     def up(c: Column, own: DecimalScale) -> Column:
         f = 10 ** (scale - own.scale)
@@ -189,7 +199,7 @@ def rescale_decimal_pair(a: Column, b: Column) -> tuple[Column, Column]:
         return Column(c.data * f if f != 1 else c.data, LogicalType.DECIMAL,
                       c.validity, target, bounds=bounds)
 
-    return up(a, sa), up(b, sb)
+    return [up(c, s) for c, s in zip(cs, scales)]
 
 
 def to_hashed_strings(c: Column) -> Column:

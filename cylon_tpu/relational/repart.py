@@ -428,9 +428,24 @@ def concat_tables(tables: list[Table]) -> Table:
             cs = [Column(c.data + int(o), LogicalType.LIST, c.validity,
                          merged, bounds=(0, hi))
                   for c, o in zip(cs, offs)]
+        elif all(c.type == LogicalType.DECIMAL for c in cs):
+            # ONE pass to the common scale: pairwise promotion would leave
+            # middle columns at a stale scale while the output dictionary
+            # takes the final (largest) one — silent corruption, since
+            # decimals share int64 storage
+            from .common import rescale_decimals_many
+            cs = rescale_decimals_many(cs)
+        elif len({c.type for c in cs}) == 1:
+            pass
         else:
             for i in range(1, len(cs)):
                 cs[0], cs[i] = promote_key_pair(cs[0], cs[i])
+            # pairwise promotion converges on cs[0]'s final type; bring
+            # every middle column to it in a second sweep (mixed numeric
+            # middles otherwise keep a stale dtype)
+            final = cs[0].type
+            cs = [c if c.type == final else promote_key_pair(cs[0], c)[1]
+                  for c in cs]
         col_sets.append(cs)
     w = env.world_size
     vcs = [t.valid_counts for t in tables]

@@ -274,6 +274,10 @@ def local_sort_table(table: Table, by, ascending=True,
     cols = {}
     for (n, c), d, v in zip(items, out_d, out_v):
         cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
-    out = Table(cols, env, table.valid_counts)
-    out.grouped_by = tuple(by)
-    return out
+    # NOTE: deliberately does NOT set ``grouped_by`` — a per-shard sort
+    # only guarantees per-shard contiguity, while grouped_by also asserts
+    # cross-shard key co-location (it gates groupby's no-shuffle fast
+    # path).  Call sites that additionally guarantee co-location (the
+    # range exchange in sort_table, the hash shuffle in pipelined_join)
+    # set it themselves.
+    return Table(cols, env, table.valid_counts)

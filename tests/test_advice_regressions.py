@@ -238,3 +238,131 @@ class TestRound3Advice:
         exp = (l.to_pandas().merge(r.to_pandas(), on="k")
                .groupby("k", as_index=False).agg(a_sum=("a", "sum")))
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+class TestConcatDecimalScales:
+    """Round-4 advisor (high): concat of >=3 decimal tables with mixed
+    scales must rescale EVERY block to the common scale — the old pairwise
+    promotion left middle blocks at a stale scale under the final (largest)
+    dictionary, silently corrupting values."""
+
+    def test_three_way_mixed_scales(self, env1):
+        import decimal
+        from cylon_tpu.frame import concat
+        mk = lambda vals, sc: _df(
+            {"m": np.asarray([decimal.Decimal(v).quantize(
+                decimal.Decimal(1).scaleb(-sc)) for v in vals], object)},
+            env1)
+        a = mk(["1.5"], 1)
+        b = mk(["2.5"], 1)     # the middle block the pairwise loop missed
+        c = mk(["3.1234"], 4)
+        out = concat([a, b, c]).to_pandas()
+        assert sorted(map(float, out["m"])) == [1.5, 2.5, 3.1234]
+
+    def test_three_way_mixed_scales_dist(self, env4):
+        import decimal
+        from cylon_tpu.frame import concat
+        mk = lambda vals, sc: _df(
+            {"m": np.asarray([decimal.Decimal(str(v)).quantize(
+                decimal.Decimal(1).scaleb(-sc)) for v in vals], object)},
+            env4)
+        a = mk([1.5, 7.5, 0.5, 2.5], 1)
+        b = mk([2.5, 8.5, 1.5, 3.5], 1)
+        c = mk([3.1234, 4.5678, 0.0001, 9.9999], 4)
+        out = concat([a, b, c]).to_pandas()
+        exp = sorted([1.5, 7.5, 0.5, 2.5, 2.5, 8.5, 1.5, 3.5,
+                      3.1234, 4.5678, 0.0001, 9.9999])
+        assert sorted(map(float, out["m"])) == exp
+
+    def test_concat_mixed_numeric_middle(self, env1):
+        # same stale-middle pattern for plain numerics: [i64, i64, f64]
+        from cylon_tpu.frame import concat
+        a = _df({"x": np.asarray([1, 2], np.int64)}, env1)
+        b = _df({"x": np.asarray([3, 4], np.int64)}, env1)
+        c = _df({"x": np.asarray([0.5], np.float64)}, env1)
+        out = concat([a, b, c]).to_pandas()
+        assert sorted(out["x"].tolist()) == [0.5, 1.0, 2.0, 3.0, 4.0]
+
+
+class TestDecimalPrecisionVsScale:
+    """Round-4 advisor (medium): ingested tight precision can undercut the
+    scale ([0.01, 0.02] -> precision 1, scale 2); to_arrow must still emit
+    a valid decimal128."""
+
+    def test_to_arrow_small_fractions(self, env1):
+        import decimal
+        df = _df({"m": np.asarray([decimal.Decimal("0.01"),
+                                   decimal.Decimal("0.02")], object)}, env1)
+        at = df.table.to_arrow()
+        assert at.column("m").to_pylist() == [decimal.Decimal("0.01"),
+                                              decimal.Decimal("0.02")]
+
+    def test_parquet_roundtrip_small_fractions(self, env1, tmp_path):
+        import decimal
+        df = _df({"m": np.asarray([decimal.Decimal("0.01"),
+                                   decimal.Decimal("0.02")], object)}, env1)
+        p = str(tmp_path / "d.parquet")
+        df.to_parquet(p)
+        back = pd.read_parquet(p)
+        assert sorted(map(float, back["m"])) == [0.01, 0.02]
+
+
+class TestLocalSortGroupedBy:
+    """Round-4 advisor (low): a per-shard sort alone must NOT claim
+    grouped_by (it gates groupby's no-shuffle fast path, which also needs
+    cross-shard co-location)."""
+
+    def test_local_sort_does_not_set_grouped_by(self, env4, rng):
+        from cylon_tpu.relational.sort import local_sort_table
+        t = ct.Table.from_pandas(
+            pd.DataFrame({"k": rng.integers(0, 4, 64),
+                          "x": rng.random(64)}), env4)
+        out = local_sort_table(t, ["k"])
+        assert out.grouped_by is None
+
+    def test_groupby_after_local_sort_still_correct(self, env4, rng):
+        # the bug scenario: non-colocated but per-shard-sorted table must
+        # still take the shuffling groupby path and produce global groups
+        from cylon_tpu.relational.sort import local_sort_table
+        from cylon_tpu.relational import groupby_aggregate
+        pdf = pd.DataFrame({"k": rng.integers(0, 4, 64).astype(np.int64),
+                            "x": rng.random(64)})
+        t = ct.Table.from_pandas(pdf, env4)
+        out = groupby_aggregate(local_sort_table(t, ["k"]), ["k"],
+                                [("x", "sum")]).to_pandas()
+        exp = pdf.groupby("k", as_index=False).agg(x_sum=("x", "sum"))
+        got = out.sort_values("k").reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got["x_sum"], exp["x_sum"])
+
+
+class TestMixedDecimalIngest:
+    """Round-4 advisor (low): a column mixing Decimal with other types must
+    raise the framework's CylonTypeError, not a raw decimal error."""
+
+    def test_decimal_then_str(self, env1):
+        import decimal
+        from cylon_tpu.status import CylonTypeError
+        with pytest.raises(CylonTypeError):
+            _df({"m": np.asarray([decimal.Decimal("1.5"), "oops"], object)},
+                env1)
+
+    def test_decimal_then_list(self, env1):
+        import decimal
+        from cylon_tpu.status import CylonTypeError
+        with pytest.raises(CylonTypeError):
+            _df({"m": np.asarray([decimal.Decimal("1.5"), [1, 2]], object)},
+                env1)
+
+    def test_nonfinite_decimal(self, env1):
+        import decimal
+        from cylon_tpu.status import CylonTypeError
+        # Decimal('NaN') is a null under pd.isna -> ingests as None
+        df = _df({"m": np.asarray([decimal.Decimal("1.5"),
+                                   decimal.Decimal("NaN")], object)}, env1)
+        assert df.to_pandas()["m"].tolist() == [decimal.Decimal("1.5"), None]
+        # Decimal('Infinity') is NOT null: framework error, not raw TypeError
+        with pytest.raises(CylonTypeError):
+            _df({"m": np.asarray([decimal.Decimal("1.5"),
+                                  decimal.Decimal("Infinity")], object)},
+                env1)
