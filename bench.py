@@ -17,8 +17,10 @@ vs_baseline anchors to the reference's published weak-scaling join number
 ~1.67M rows/sec/rank for join alone; we use the same per-worker rows/sec
 denominator for the join+groupby pipeline).
 
-Flags: --rows=N (per chip; default 32M on TPU, 1M on CPU), --unique=F,
---iters=K, --cpu-mesh, --tpch (TPC-H Q3/Q5 instead, see cylon_tpu.tpch).
+Flags: --rows=N (per chip; default 125M on TPU — the BASELINE.json
+north-star per-chip share, auto-routed through the range-partitioned
+pipeline — 1M on CPU), --unique=F, --iters=K, --cpu-mesh, --tpch (TPC-H
+instead, see cylon_tpu.tpch).
 """
 
 from __future__ import annotations
@@ -90,22 +92,46 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
         {"k": rng.integers(0, max_val, n).astype(np.int64),
          "b": rng.integers(0, max_val, n).astype(np.int64)}, env)
 
-    def step():
-        j = join_tables(lt, rt, "k", "k", how="inner")
-        g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
-        _sync(next(iter(g.columns.values())).data)
-        return g
+    # Route by size: the monolithic fused join+groupby OOMs past ~48M
+    # rows/chip in 16 GB HBM; the north-star config (125M rows/chip = 1B
+    # rows on v5e-8, BASELINE.json) runs through the range-partitioned
+    # pipeline (exec/pipeline.py), whose per-piece working set is 1/R.
+    pipelined = rows_per_chip > 48_000_000
+    n_chunks = max(2, -(-rows_per_chip // 21_000_000)) if pipelined else 1
 
+    if pipelined:
+        from cylon_tpu.exec import GroupBySink, pipelined_join
+
+        def step():
+            sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
+            pipelined_join(lt, rt, "k", "k", how="inner",
+                           n_chunks=n_chunks, sink=sink)
+            g = sink.finalize()
+            _sync(next(iter(g.columns.values())).data)
+            return g
+    else:
+        def step():
+            j = join_tables(lt, rt, "k", "k", how="inner")
+            g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+            _sync(next(iter(g.columns.values())).data)
+            return g
+
+    # timed iterations run with region timings OFF: timing.maybe_block
+    # inserts per-phase device syncs that serialize the pipelined sink's
+    # dispatch/pull overlap — the phase profile comes from ONE extra
+    # profiled iteration afterwards
     prev_flag = config.BENCH_TIMINGS
-    config.BENCH_TIMINGS = True
+    config.BENCH_TIMINGS = False
     try:
         step()  # warmup + compile
-        timing.reset()
         times = []
         for _ in range(iters):
             t0 = time.perf_counter()
             step()
             times.append(time.perf_counter() - t0)
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        step()  # profiled (slower: per-phase syncs)
     finally:
         config.BENCH_TIMINGS = prev_flag
     best = min(times)
@@ -121,6 +147,8 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             "world": w,
             "platform": devs[0].platform,
             "rows_per_chip": rows_per_chip,
+            "pipelined": pipelined,
+            "n_chunks": n_chunks,
             "unique": unique,
             "skew": skew,
             "best_iter_s": round(best, 4),
@@ -154,12 +182,12 @@ def main() -> dict:
                           iters=iters)
 
     if rows is None:
-        # 32M/chip: the largest bucket where the fused join+groupby runs
-        # monolithically in 16 GB HBM with headroom AND the best measured
-        # throughput (36.4M rows/s vs 34.6M at 48M rows/chip; 64M OOMs the
-        # fused path and auto-halves).  Larger-than-HBM runs take the
-        # pipelined path (scripts/bench_pipelined.py).
-        rows = 32_000_000 if jax.devices()[0].platform != "cpu" else 1_000_000
+        # 125M/chip: the north-star per-chip share (BASELINE.json: 1B rows
+        # on v5e-8).  Out-of-HBM scale routes through the range-partitioned
+        # pipeline automatically (see run()); --rows=32000000 measures the
+        # monolithic in-HBM regime (36.5M rows/s/chip r5).
+        rows = 125_000_000 if jax.devices()[0].platform != "cpu" \
+            else 1_000_000
     # halve on device OOM so the driver always gets a number
     while True:
         try:

@@ -154,6 +154,11 @@ def sort_samples(world: int) -> int:
 #: (cpp/src/cylon/ops/, SURVEY §2 C9).
 DEFER_JOIN = _env_flag("CYLON_TPU_DEFER_JOIN", True)
 
+#: route large dense grouped-reduce gathers through the Pallas windowed
+#: kernel (ops/pallas_gather) on TPU — ~6x the XLA matrix gather at bench
+#: density; span overflows auto-redispatch the plain program
+WINDOWED_GATHER = _env_flag("CYLON_TPU_WINDOWED_GATHER", True)
+
 
 def pow2ceil(n: int) -> int:
     """Bucket a dynamic capacity to the next 2^(b-5) step for n in

@@ -175,6 +175,7 @@ class GroupBySink:
         self._chunk_aggs = sorted({(c, i) for c, op, *_ in self.aggs
                                    for i in self._DECOMP[op]})
         self._parts: list[Table] = []
+        self._pending = None   # one in-flight fused dispatch (see __call__)
         self._disjoint = False
 
     def mark_key_disjoint(self) -> None:
@@ -185,13 +186,41 @@ class GroupBySink:
         self._disjoint = True
 
     def __call__(self, chunk: Table) -> None:
-        from ..relational.groupby import groupby_aggregate
-        self._parts.append(
-            groupby_aggregate(chunk, self.by, list(self._chunk_aggs)))
+        """Consume one chunk.  Deferred inner-join chunks take the fused
+        pushdown via begin/resolve: the NEXT chunk's program is enqueued
+        before the previous chunk's meta is pulled, so the device never
+        idles on the host round trip (one-deep software pipeline; the
+        reference's ops-DAG keeps pieces in flight the same way,
+        execution.hpp:43)."""
+        from ..relational.fused import try_begin_join_groupby
+        from ..relational.groupby import _normalize_aggs, groupby_aggregate
+        specs = _normalize_aggs(list(self._chunk_aggs))
+        h = try_begin_join_groupby(chunk, self.by, specs, 1)
+        prev, self._pending = self._pending, ((h, chunk) if h is not None
+                                              else None)
+        if prev is not None:
+            self._settle(prev)
+        if h is None:
+            self._parts.append(
+                groupby_aggregate(chunk, self.by, list(self._chunk_aggs)))
         return None
+
+    def _settle(self, pending) -> None:
+        from ..relational.groupby import groupby_aggregate
+        h, chunk = pending
+        out = h.resolve()
+        if out is None:   # compile ladder exhausted mid-resolve
+            # materialize FIRST: groupby_aggregate would otherwise retry
+            # the identical (crash-exhausted, uncached) pushdown ladder
+            chunk.columns  # noqa: B018 — triggers DeferredTable thunk
+            out = groupby_aggregate(chunk, self.by, list(self._chunk_aggs))
+        self._parts.append(out)
 
     def finalize(self) -> Table:
         from ..relational.groupby import groupby_aggregate
+        if self._pending is not None:
+            self._settle(self._pending)
+            self._pending = None
         if not self._parts:
             raise InvalidError("GroupBySink saw no chunks")
         partial = concat_tables(self._parts) if len(self._parts) > 1 \

@@ -61,6 +61,13 @@ def _build_and_load():
     lib.cylon_hash_strings.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
     lib.cylon_hash_strings.restype = None
+    lib.cylon_prefix_lanes.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p]
+    lib.cylon_prefix_lanes.restype = None
+    lib.cylon_max_adjacent_lcp.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.cylon_max_adjacent_lcp.restype = ctypes.c_int64
     return lib
 
 
@@ -98,3 +105,73 @@ def hash_strings(values: np.ndarray) -> np.ndarray:
         return out
     import pandas as pd
     return pd.util.hash_array(np.asarray(values, dtype=object))
+
+
+def _arrow_bufs(values: np.ndarray):
+    """(data uint8 np, offsets int64 np, n) for an object/str array in
+    Arrow large_string layout (nulls become empty strings — callers mask
+    them separately)."""
+    import pyarrow as pa
+    arr = pa.array(values, type=pa.large_string())
+    if arr.null_count:
+        arr = arr.fill_null("")
+    bufs = arr.buffers()
+    offsets = np.frombuffer(bufs[1], dtype=np.int64,
+                            count=len(arr) + 1, offset=8 * arr.offset)
+    data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+        else np.zeros(1, np.uint8)
+    return data, offsets, len(arr)
+
+
+def prefix_lanes(values: np.ndarray, n_lanes: int) -> np.ndarray:
+    """Big-endian u32 order lanes of each value's first ``4*n_lanes``
+    UTF-8 bytes — (n, n_lanes) uint32; lane order == bytewise (Arrow
+    binary) order.  Value-stable across processes."""
+    if native_available():
+        data, offsets, n = _arrow_bufs(values)
+        out = np.empty((n, n_lanes), np.uint32)
+        _LIB.cylon_prefix_lanes(
+            data.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(n), ctypes.c_int64(n_lanes),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    out = np.zeros((len(values), n_lanes), np.uint32)
+    for i, v in enumerate(values):
+        b = ("" if v is None else str(v)).encode("utf-8")[:4 * n_lanes]
+        b = b + b"\0" * (-len(b) % 4)
+        if b:
+            lanes = np.frombuffer(b, dtype=">u4")
+            out[i, :len(lanes)] = lanes
+    return out
+
+
+def max_adjacent_lcp(values_in_order: np.ndarray) -> int:
+    """Longest common prefix in BYTES over adjacent pairs (callers pass
+    sorted unique values, making this the global distinct-pair max)."""
+    if native_available():
+        data, offsets, n = _arrow_bufs(values_in_order)
+        order = np.arange(n, dtype=np.int64)
+        return int(_LIB.cylon_max_adjacent_lcp(
+            data.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            order.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(n)))
+    best = 0
+    enc = [("" if v is None else str(v)).encode("utf-8")
+           for v in values_in_order]
+    for a, b in zip(enc, enc[1:]):
+        lim = min(len(a), len(b))
+        k = 0
+        while k < lim and a[k] == b[k]:
+            k += 1
+        if k == lim and len(a) == len(b):
+            continue
+        best = max(best, k)
+    return best
+
+
+def utf8_lengths(values: np.ndarray) -> np.ndarray:
+    """Byte length of each value's UTF-8 encoding (int64)."""
+    _, offsets, _n = _arrow_bufs(values)
+    return np.diff(offsets)

@@ -76,4 +76,53 @@ void cylon_hash_strings(const uint8_t* data, const int64_t* offsets,
   }
 }
 
+// Order lanes for lexical string sort (the type-dispatched string sort
+// slot, reference arrow_kernels.hpp:53 IndexSortKernel<StringArray>):
+// value i's first 4*n_lanes bytes packed BIG-ENDIAN into n_lanes uint32
+// (missing bytes = 0, which sorts short strings before their
+// extensions — bytewise UTF-8 order, matching Arrow's binary compare).
+// out is row-major (n, n_lanes).  The lanes are VALUE-STABLE: any process
+// holding the same value computes the same lanes, so multi-controller
+// range partitioning agrees without exchanging dictionaries.
+void cylon_prefix_lanes(const uint8_t* data, const int64_t* offsets,
+                        int64_t n, int64_t n_lanes, uint32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t lo = offsets[i];
+    const int64_t len = offsets[i + 1] - lo;
+    const uint8_t* p = data + lo;
+    for (int64_t l = 0; l < n_lanes; ++l) {
+      uint32_t v = 0;
+      const int64_t base = 4 * l;
+      for (int64_t b = 0; b < 4; ++b) {
+        v <<= 8;
+        if (base + b < len) v |= p[base + b];
+      }
+      out[i * n_lanes + l] = v;
+    }
+  }
+}
+
+// Longest common prefix (bytes) over ADJACENT pairs of n values taken in
+// ``order`` — for values in sorted order this equals the global max LCP
+// over all DISTINCT pairs, i.e. how many prefix bytes separate every
+// distinct value.  Returns max LCP; identical adjacent values are skipped
+// (callers pass unique values).
+int64_t cylon_max_adjacent_lcp(const uint8_t* data, const int64_t* offsets,
+                               const int64_t* order, int64_t n) {
+  int64_t best = 0;
+  for (int64_t i = 0; i + 1 < n; ++i) {
+    const int64_t a = order[i], b = order[i + 1];
+    const uint8_t* pa = data + offsets[a];
+    const uint8_t* pb = data + offsets[b];
+    const int64_t la = offsets[a + 1] - offsets[a];
+    const int64_t lb = offsets[b + 1] - offsets[b];
+    const int64_t lim = la < lb ? la : lb;
+    int64_t k = 0;
+    while (k < lim && pa[k] == pb[k]) ++k;
+    if (k == lim && la == lb) continue;  // equal values: no separation need
+    if (k > best) best = k;
+  }
+  return best;
+}
+
 }  // extern "C"

@@ -151,7 +151,7 @@ def _u32(x):
 def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
                    key_valids, seg_cap: int, key_narrow=None,
                    value_narrow=None, pad_lanes: int = 0,
-                   gather_parts: int = 1):
+                   gather_parts: int = 1, use_window: int = 0):
     """Grouped-input fast path, fully batched: per-group sums for the
     cumsum-able ops (sum/count/mean/var/std) AND the representative-key
     gather share ONE u32 lane-matrix gather (plus one f64 side gather when
@@ -169,7 +169,12 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
     n·max|v| fits int32 — a BOOLEAN so compiled-fn caches key on it, not on
     raw data bounds) narrows the i-th op's integer SUM prefix to one lane.
 
-    Returns (inter dicts per op, key_out tuple, kval_out tuple)."""
+    ``use_window`` (a window size, 0 = off) routes the u32 matrix gather
+    through the Pallas windowed kernel (ops/pallas_gather) — ~6x the XLA
+    gather at bench density.  Returns (inter dicts per op, key_out tuple,
+    kval_out tuple, win_ok) — win_ok is a scalar bool that is False when
+    a windowed tile's index span overflowed (results are then garbage and
+    the DISPATCH layer must re-run with use_window=0)."""
     from . import lanes as lanes_mod
     n = key_datas[0].shape[0]
 
@@ -275,28 +280,46 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
         return (jnp.concatenate(gs, axis=1),
                 jnp.concatenate(gns, axis=1))
 
-    if pad_lanes:
+    win_ok = jnp.ones((), bool)
+    windowed = False
+    if use_window and u32_cols:
+        from . import pallas_gather as pg
+        windowed = pg.supported(n + 1, seg_cap, len(u32_cols), use_window)
+    if pad_lanes and not windowed:
         # XLA:TPU compiler landmine: specific (u32, f64) gather-lane width
         # combinations SIGSEGV tpu_compile_helper (v5e libtpu 2026-07; e.g.
         # 7xu32+6xf64 crashes while 8xu32+6xf64 compiles).  Callers retry a
         # crashed compile with pad_lanes>0 dummy lanes to shift the width.
         u32_cols = u32_cols + [jnp.zeros(n + 1, jnp.uint32)] * pad_lanes
     g_u = gn_u = g_f = gn_f = None
-    if u32_cols:
+    if windowed:
+        # lane-major stack (a post-hoc transpose would cost ~700 ms; the
+        # axis-0 stack is a plain concat); f64 side columns keep the XLA
+        # gather below
+        mat_t = jnp.stack(u32_cols, axis=0)
+        g_u, win_ok = pg.windowed_take_t(mat_t, starts, use_window)
+        tail = jax.lax.dynamic_slice(
+            mat_t, (jnp.int32(0), jnp.minimum(n_live, jnp.int32(n))),
+            (len(u32_cols), 1))
+        gn_u = jnp.concatenate([g_u[:, 1:], tail], axis=1)
+    elif u32_cols:
         g_u, gn_u = gather_pair_multi(u32_cols)
     if f64_cols:
         g_f, gn_f = gather_pair_multi(f64_cols)
 
+    def ucol(li, at_next: bool):
+        src = gn_u if at_next else g_u
+        return src[li] if windowed else src[:, li]
+
     def prefix_recon(lane_ids, meta, at_next: bool):
         """Gathered prefix lanes -> accumulator value (i32/i64/f32/f64)."""
-        src = gn_u if at_next else g_u
         if meta is None:  # f64 side channel
             return (gn_f if at_next else g_f)[:, lane_ids[0]]
         if meta == "f32":
-            return jax.lax.bitcast_convert_type(src[:, lane_ids[0]],
+            return jax.lax.bitcast_convert_type(ucol(lane_ids[0], at_next),
                                                 jnp.float32)
         dt_name, nrw = meta
-        return lanes_mod._from_lanes([src[:, li] for li in lane_ids],
+        return lanes_mod._from_lanes([ucol(li, at_next) for li in lane_ids],
                                      dt_name, nrw)
 
     inters = [dict() for _ in ops]
@@ -314,13 +337,14 @@ def grouped_reduce(ops, values_list, vmasks, starts, n_live, key_datas,
             if space == "f64":
                 v = g_f[:, lane_ids[0]]
             else:
-                v = lanes_mod._from_lanes([g_u[:, li] for li in lane_ids],
+                v = lanes_mod._from_lanes([ucol(li, False)
+                                           for li in lane_ids],
                                           dt_name, nrw)
             if kind == "key":
                 key_out[slot] = v
             else:  # validity lanes are always planned as bool
                 kval_out[slot] = v
-    return inters, tuple(key_out), tuple(kval_out)
+    return inters, tuple(key_out), tuple(kval_out), win_ok
 
 
 #: ops whose grouped-input fast path avoids scatter reductions entirely

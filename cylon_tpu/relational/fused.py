@@ -94,7 +94,7 @@ def _col_entry(state: JoinState, name: str):
 def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
               vspecs: tuple, key_cols: tuple, key_narrow: tuple,
               seg_cap: int, ddof: int, pad_lanes: int = 0,
-              gather_parts: int = 1):
+              gather_parts: int = 1, use_window: int = 0):
     """Per-shard fused join+groupby kernel.
 
     ``vspecs``: per aggregation (side, lane_col_idx, op); ``key_cols``:
@@ -163,10 +163,11 @@ def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
 
         key_datas = [ldat[ci] for ci in key_cols]
         key_valids = [lval[ci] for ci in key_cols]
-        inters, key_out, kval_out = gbk.grouped_reduce(
+        inters, key_out, kval_out, wok = gbk.grouped_reduce(
             ops_list, vals, masks, starts, jnp.int32(N), key_datas,
             key_valids, seg_cap, key_narrow=key_narrow,
-            pad_lanes=pad_lanes, gather_parts=gather_parts)
+            pad_lanes=pad_lanes, gather_parts=gather_parts,
+            use_window=use_window)
         l_cnt = inters[-2]["count"]
         r_cnt = inters[-1]["count"]
 
@@ -190,12 +191,40 @@ def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
                 d, v = gbk.finalize(op, scaled, ddof)
             res_d.append(d)
             res_v.append(v)
+        # n_groups and the windowed-gather span flag ride ONE output so
+        # the dispatch layer pays a single host pull (a second transfer
+        # costs a full tunnel round trip per dispatch)
+        meta = jnp.stack([n_groups, wok.astype(jnp.int32)]).reshape(2)
         return (tuple(key_out), tuple(kval_out), tuple(res_d), tuple(res_v),
-                n_groups.reshape(1))
+                meta)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW),
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
+
+
+class _PendingFused:
+    """A DISPATCHED (not yet pulled) fused join+groupby.  The first device
+    program is already enqueued; :meth:`resolve` pulls its meta sidecar,
+    handles seg-cap/window mispredicts (redispatching as needed) and
+    builds the result Table — or returns None when the compile ladder is
+    exhausted mid-resolve (caller falls back to the materialize path).
+
+    Purpose: a range-partitioned pipeline consumes one fused groupby per
+    piece, and each meta pull is a full host round trip (device idle, 8
+    pieces x RTT adds ~0.5 s/iteration over the axon tunnel).  Begin/
+    resolve lets the consumer enqueue piece i+1's program BEFORE pulling
+    piece i's meta — one-deep software pipelining of dispatch vs pull
+    (the reference's ops-DAG keeps pieces in flight the same way,
+    cpp/src/cylon/ops/execution/execution.hpp:43 RoundRobin)."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def resolve(self):
+        return self._fn()
 
 
 def try_join_groupby_pushdown(table: Table, by: list, specs: list,
@@ -203,6 +232,15 @@ def try_join_groupby_pushdown(table: Table, by: list, specs: list,
     """Fused path when ``table`` is an unmaterialized inner-join result and
     the groupby reduces to multiplicity algebra over its sorted state.
     Returns the result Table, or None to take the normal path."""
+    h = try_begin_join_groupby(table, by, specs, ddof)
+    return h.resolve() if h is not None else None
+
+
+def try_begin_join_groupby(table: Table, by: list, specs: list,
+                           ddof: int):
+    """Dispatch the fused join+groupby WITHOUT waiting for its meta pull.
+    Returns a :class:`_PendingFused` (resolve() -> Table | None), or None
+    when the fused path does not apply or its first compile crashed."""
     if not isinstance(table, DeferredTable) or table.materialized:
         return None
     state = table.op_state
@@ -255,50 +293,101 @@ def try_join_groupby_pushdown(table: Table, by: list, specs: list,
     args = (state.vcl, state.vcr, state.idx_s, state.bnd, state.pl_s)
     sig = (env.serial, tuple(by), tuple(vspecs), state.cap_l, state.cap_r,
            int(state.vcl.sum()), int(state.vcr.sum()), ddof)
-    pred = _SEG_CACHE.get(sig)
 
     from .groupby import _FIRST_SEG_CAP, _is_compiler_crash, _pad_ladder
+    from ..ops import pallas_gather as pg
 
-    def call(sc):
+    on_tpu = next(iter(env.mesh.devices.flat)).platform == "tpu"
+
+    def _win_size(sc: int, dens: float) -> int:
+        """Windowed-gather request for a dispatch at segment space ``sc``
+        (0 = plain): TPU only, measured group density above the coverage
+        floor, segment space big enough for the plain gather to hurt."""
+        if not (on_tpu and config.WINDOWED_GATHER):
+            return 0
+        if dens < pg.MIN_DENSITY or sc < (1 << 20):
+            return 0
+        return pg.pick_window(dens)
+
+    def call(sc, win):
         # same compiler-crash ladder as every other grouped_reduce dispatch
-        # site: dummy gather lanes shift a SIGSEGV-ing lane width, then a
+        # site: the windowed Pallas gather first (when eligible), then
+        # dummy gather lanes to shift a SIGSEGV-ing lane width, then a
         # split gather — a still-crashing spec bails to the materialize path
-        def disp(pad, parts=1):
+        def disp(pad, parts=1, w=0):
             return _fused_fn(env.mesh, state.cap_l, state.all_live,
                              state.lspec, state.rspec, tuple(vspecs),
                              tuple(key_cols), tuple(key_narrow), sc,
-                             ddof, pad, parts)(*args)
+                             ddof, pad, parts, w)(*args)
 
-        attempts = [(f"fused+pad{p}", lambda p=p: disp(p)) for p in (0, 1)]
+        attempts = []
+        if win:
+            attempts.append(("fused+win", lambda: disp(0, 1, win)))
+        attempts += [(f"fused+pad{p}", lambda p=p: disp(p)) for p in (0, 1)]
         attempts.append(("fused+split2", lambda: disp(0, 2)))
         return _pad_ladder(("fused", env.serial, tuple(vspecs),
-                            tuple(key_cols), tuple(key_narrow)), attempts)
+                            tuple(key_cols), tuple(key_narrow), bool(win)),
+                           attempts)
 
+    # first sight of a large state: dispatch at a modest segment space
+    # (multi-10M-segment programs have pathological XLA:TPU compile
+    # times); the returned n_groups detects a mispredict.  Cache value:
+    # (seg bucket, windowed allowed, window size) — the window is
+    # picked from the MEASURED per-shard group density (min across
+    # shards) and a span overflow (win_ok False) permanently disables
+    # the windowed gather for this callsite.
     with timing.region("groupby.fused"):
-        # first sight of a large state: dispatch at a modest segment space
-        # (multi-10M-segment programs have pathological XLA:TPU compile
-        # times); the returned n_groups detects a mispredict
-        if pred is not None and pred < cap_total:
-            seg_cap = pred
-        elif pred is None and cap_total > _FIRST_SEG_CAP:
+        pred = _SEG_CACHE.get(sig)
+        if isinstance(pred, tuple):
+            pred_seg, win_allowed, win = pred
+        else:
+            pred_seg, win_allowed, win = pred, True, 0
+        if pred_seg is not None and pred_seg < cap_total:
+            seg_cap = pred_seg
+        elif pred_seg is None and cap_total > _FIRST_SEG_CAP:
             seg_cap = _FIRST_SEG_CAP
         else:
             seg_cap = config.pow2ceil(cap_total)
+        if not win_allowed:
+            win = 0
         try:
-            res = call(seg_cap)
-            n_groups = host_array(res[4]).astype(np.int64)
-            ng_cap = config.pow2ceil(int(n_groups.max())
-                                     if n_groups.size else 1)
-            if ng_cap > seg_cap:
-                res = call(ng_cap)
+            res = call(seg_cap, win)     # ENQUEUED; meta not pulled yet
         except Exception as e:  # noqa: BLE001
             if _is_compiler_crash(e):
                 return None   # ladder exhausted: materialize path handles it
             raise
-        _SEG_CACHE.put(sig, ng_cap)
-        key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
-    out = _result_table(env, by, by_cols, key_out, kval_out, res_names,
-                        res_d, res_v, res_types, res_dicts, n_groups)
-    out = _shrink(out, n_groups)
-    out.grouped_by = tuple(by)
-    return out
+
+    def _resolve():
+        nonlocal res, seg_cap, win, win_allowed
+        live = np.asarray(state.vcl, np.int64) + np.asarray(state.vcr,
+                                                            np.int64)
+        with timing.region("groupby.fused"):
+            try:
+                for _ in range(3):
+                    meta = host_array(res[4]).astype(np.int64).reshape(-1, 2)
+                    n_groups = meta[:, 0]
+                    ng_cap = config.pow2ceil(int(n_groups.max())
+                                             if n_groups.size else 1)
+                    wok = (not win) or bool(np.all(meta[:, 1]))
+                    if ng_cap <= seg_cap and wok:
+                        break
+                    if not wok:
+                        win_allowed = False
+                    seg_cap = max(seg_cap, ng_cap)
+                    dens = float((n_groups / np.maximum(live, 1)).min()) \
+                        if n_groups.size else 0.0
+                    win = _win_size(seg_cap, dens) if win_allowed else 0
+                    res = call(seg_cap, win)
+            except Exception as e:  # noqa: BLE001
+                if _is_compiler_crash(e):
+                    return None   # caller falls back to materialize path
+                raise
+            _SEG_CACHE.put(sig, (ng_cap, win_allowed, win))
+            key_out, kval_out, res_d, res_v = res[0], res[1], res[2], res[3]
+        out = _result_table(env, by, by_cols, key_out, kval_out, res_names,
+                            res_d, res_v, res_types, res_dicts, n_groups)
+        out = _shrink(out, n_groups)
+        out.grouped_by = tuple(by)
+        return out
+
+    return _PendingFused(_resolve)

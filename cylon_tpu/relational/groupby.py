@@ -66,7 +66,8 @@ def _is_compiler_crash(e: Exception) -> bool:
     directly-attached TPU VM's ("tpu_compile_helper" subprocess death) —
     the ladder must engage on either."""
     s = str(e)
-    return "tpu_compile_helper" in s or "SIGSEGV" in s
+    return ("tpu_compile_helper" in s or "SIGSEGV" in s
+            or "Mosaic failed to compile" in s)
 
 
 def _pad_ladder(sig_key, attempts):
@@ -89,7 +90,8 @@ def _pad_ladder(sig_key, attempts):
                 from ..utils.logging import log
                 log.warning(
                     "TPU compiler crash on groupby variant %r; retrying "
-                    "with %r", attempts[idx][0], attempts[idx + 1][0])
+                    "with %r: %.300s", attempts[idx][0],
+                    attempts[idx + 1][0], e)
                 last = e
                 continue
             raise
@@ -277,7 +279,7 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
             batch.append((op, i))
         elif op in ("min", "max"):
             batch.append(("count", i))
-    inters_b, key_out, kval_out = gbk.grouped_reduce(
+    inters_b, key_out, kval_out, _wok = gbk.grouped_reduce(
         [b[0] for b in batch], [val_datas[b[1]] for b in batch],
         [vmasks[b[1]] for b in batch], starts, n_live,
         list(by_datas), list(by_valids), seg_cap, key_narrow=narrow,
@@ -396,7 +398,7 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
         n_live = vc[my].astype(jnp.int32)
         starts = gbk.grouped_starts(gids, first, mask, n_live, seg_cap)
         sum_idx = [j for j, k in enumerate(flat_kinds) if k == "sum"]
-        inters_b, key_out, kval_out = gbk.grouped_reduce(
+        inters_b, key_out, kval_out, _wok = gbk.grouped_reduce(
             ["sum"] * len(sum_idx), [s_arrs[j] for j in sum_idx],
             [mask] * len(sum_idx), starts, n_live, list(s_by), list(s_byv),
             seg_cap, key_narrow=narrow, pad_lanes=pad_lanes,

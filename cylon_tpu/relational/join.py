@@ -49,7 +49,7 @@ from .repart import shuffle_table
 
 shard_map = jax.shard_map
 
-HOW = ("inner", "left", "right", "outer")
+HOW = ("inner", "left", "right", "outer", "semi", "anti")
 
 #: capacity hysteresis: callsite-signature -> last exact output bucket.
 #: Lets join_tables dispatch the materialize phase at the PREDICTED capacity
@@ -169,7 +169,9 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
     from ..parallel.collectives import allgather_table
     from .repart import concat_tables, exchange_by_targets, filter_table
 
-    if how in ("inner", "left", "right"):
+    if how in ("inner", "left", "right", "semi", "anti"):
+        # semi/anti behave like 'left' here: output ⊆ left rows, and a
+        # replicated heavy build row lets ANY shard detect the match
         if how == "right":
             probe, probe_on = rwork, right_on
             build, build_on = lwork, left_on
@@ -208,6 +210,42 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
             False)
 
 
+def _null_extend_right(runm: Table, lj: Table, left: Table, right: Table,
+                       left_on, right_on, suffixes, coalesce: bool) -> Table:
+    """Unmatched right rows reshaped into the left join's output schema:
+    key columns carry the right keys, right payload columns carry through,
+    left-only columns become all-null (the outer join's right-unmatched
+    emission, ops/join.py join_take ``how == 'outer'`` analog — built
+    table-level for the skew decomposition)."""
+    from ..core.dtypes import physical_np_dtype
+    from ..core.table import _put
+    env = runm.env
+    w, cap = env.world_size, runm.capacity
+    sharding = env.sharding()
+    overlap = (set(left.column_names) & set(right.column_names)) - (
+        set(left_on) if coalesce else set())
+    right_out = {(rn + suffixes[1] if rn in overlap else rn): rn
+                 for rn in right.column_names
+                 if not (coalesce and rn in right_on)}
+    all_false = _put(np.zeros(w * cap, bool), sharding)
+    cols = {}
+    for n in lj.column_names:
+        ljc = lj.columns[n]
+        if coalesce and n in left_on:
+            rn = right_on[left_on.index(n)]
+            _, src = promote_key_pair(ljc, runm.column(rn))
+            cols[n] = src
+        elif n in right_out:
+            cols[n] = runm.column(right_out[n])
+        else:
+            # left-only column: all null, lj's type/dictionary
+            phys = physical_np_dtype(ljc.type)
+            data = _put(np.zeros(w * cap, phys), sharding)
+            cols[n] = Column(data, ljc.type, all_false, ljc.dictionary,
+                             bounds=(0, 0))
+    return Table(cols, env, runm.valid_counts)
+
+
 def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
     """Concat-row liveness for (left ++ right) per shard."""
     return jnp.concatenate([live_mask(vcl, cap_l), live_mask(vcr, cap_r)])
@@ -241,6 +279,48 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
     live_cat = None if all_live \
         else jnp.concatenate([mask_l, mask_r])
     return bnd, idx_s, live_cat, pl_s
+
+
+@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+def _semi_flag_fn(mesh: Mesh, narrow: tuple, all_live: bool, anti: bool):
+    """Per-left-row matched flag for SEMI/ANTI joins over the single-sort
+    state: one run of the boundary algebra (right-count per key run), no
+    output expansion at all — the output is a filter of the left table.
+    Null keys match null keys (pandas merge semantics, same as the other
+    join types here).  Reference: the LEFT_SEMI/LEFT_ANTI shapes the C++
+    core reaches via unmatched-count bookkeeping in its sort join
+    (sort_join.cpp:66 ``advance()`` run extraction)."""
+
+    def per_shard(vcl, vcr, l_datas, l_valids, r_datas, r_valids):
+        cap_l = l_datas[0].shape[0]
+        bnd, idx_s, live_cat, _pl = _sorted_state(
+            vcl, vcr, l_datas, l_valids, r_datas, r_valids, narrow, (),
+            all_live)
+        n = bnd.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        side_r = idx_s >= cap_l
+        if live_cat is None:
+            lefts_b = ~side_r
+            rights = side_r.astype(jnp.int32)
+        else:
+            live = live_cat[idx_s]
+            lefts_b = (~side_r) & live
+            rights = (side_r & live).astype(jnp.int32)
+        first = bnd.astype(bool) | (pos == 0)
+        s_r = jnp.cumsum(rights).astype(jnp.int32)
+        ebnd = jnp.concatenate([first[1:], jnp.ones(1, bool)])
+        imax = jnp.int32(2**31 - 1)
+        e_r = jax.lax.cummin(jnp.where(ebnd, s_r, imax), reverse=True)
+        b_r = jax.lax.cummax(jnp.where(first, s_r - rights, jnp.int32(0)))
+        matched = (e_r - b_r) > 0
+        keep = (matched ^ anti) & lefts_b
+        tgt = jnp.where(lefts_b, idx_s, jnp.int32(cap_l))
+        return jnp.zeros(cap_l + 1, bool).at[tgt].set(
+            keep, mode="drop")[:cap_l]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, REP, ROW, ROW, ROW, ROW),
+                             out_specs=ROW))
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
@@ -430,7 +510,8 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         lambda: _join_tables_impl(left, right, left_on, right_on, how,
                                   suffixes, coalesce_keys, assume_colocated,
                                   allow_defer),
-        can_fallback=(not assume_colocated and coalesce_keys),
+        can_fallback=(not assume_colocated and coalesce_keys
+                      and how not in ("semi", "anti")),
         fallback=fallback, label="join")
 
 
@@ -456,6 +537,30 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     lwork = left.with_columns(dict(zip(left_on, lkey_cols)))
     rwork = right.with_columns(dict(zip(right_on, rkey_cols)))
 
+    if (how == "outer" and env.world_size > 1 and not assume_colocated
+            and _heavy_keys(lwork, left_on, env) is not None):
+        # Skew-safe FULL OUTER decomposition: outer = skew-split LEFT join
+        # ∪ unmatched-right.  The left join spreads the heavy probe rows
+        # and replicates heavy build rows (bounded per-shard memory); the
+        # unmatched-right complement is an ANTI join against the LEFT
+        # KEYS' DISTINCT rows — a heavy key collapses to one row there, so
+        # its exchange cannot blow a shard either.  Reference slot:
+        # table.cpp:861 DistributedJoin + SURVEY §7 hard-part 4.
+        from .repart import concat_tables
+        from .setops import unique_table
+        lj = join_tables(left, right, left_on, right_on, how="left",
+                         suffixes=suffixes, coalesce_keys=coalesce_keys)
+        lkeys = unique_table(
+            Table({n: left.column(n) for n in left_on}, env,
+                  left.valid_counts))
+        runm = join_tables(right, lkeys, right_on, left_on, how="anti")
+        ext = _null_extend_right(runm, lj, left, right, left_on, right_on,
+                                 suffixes,
+                                 coalesce_keys and left_on == right_on)
+        out = concat_tables([lj, ext])
+        out.grouped_by = None
+        return out
+
     skew_split = False
     if env.world_size > 1 and not assume_colocated:
         with timing.region("join.shuffle"):
@@ -469,6 +574,18 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     narrow = narrow32_flags(l_key_cols, r_key_cols)
     vcl = np.asarray(lwork.valid_counts, np.int32)
     vcr = np.asarray(rwork.valid_counts, np.int32)
+
+    if how in ("semi", "anti"):
+        # output ⊆ left rows: one matched-flag pass + filter, no plan and
+        # no expansion (reference: JoinTables' semi/anti shapes)
+        all_live_sa = bool((vcl == lwork.capacity).all()
+                           and (vcr == rwork.capacity).all())
+        with timing.region("join.semi"):
+            flag = _semi_flag_fn(env.mesh, narrow, all_live_sa,
+                                 how == "anti")(
+                vcl, vcr, l_datas, l_valids, r_datas, r_valids)
+        from .repart import filter_table
+        return filter_table(lwork, flag)
 
     cache_key = (env.serial, how, narrow, lwork.capacity, rwork.capacity,
                  int(lwork.valid_counts.sum()), int(rwork.valid_counts.sum()),

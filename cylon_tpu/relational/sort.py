@@ -171,6 +171,117 @@ def _pick_splitters(sample_ops, live, w: int):
     return tuple(o[take] for o in ops_np)
 
 
+#: max u32 order lanes per string key (64 prefix bytes).  Past this the
+#: single-process path falls back to exact dense ranks; multi-controller
+#: raises (ranks are store-local, not value-stable).
+MAX_ORDER_LANES = 16
+
+
+def _expand_hashed_string_keys(table: Table, by: list, ascending):
+    """Rewrite hashed-string sort keys into VALUE-STABLE big-endian byte
+    lanes so the numeric sort machinery delivers lexical order.
+
+    Per key: the store's unique values are Arrow-sorted on host, the max
+    adjacent common prefix fixes the byte depth D that separates every
+    distinct value, and each row's first-D bytes become ceil(D/4) int32
+    lane columns (u32 big-endian, sign-flipped).  Lane tuples are equal
+    iff the values are equal (D exceeds every distinct-pair common
+    prefix), so the output's grouped_by contract still holds for the
+    ORIGINAL key names.  Lanes are value-stable — every process computes
+    identical lanes from its own store, so multi-controller range
+    partitioning agrees without dictionary exchange (beyond one scalar
+    max-depth agreement).
+
+    Returns (table2, by2, ascending2, original_by) or None when no key is
+    hashed.  Reference: the type-dispatched string sort kernels,
+    arrow_kernels.hpp:53 IndexSortKernel<StringArray>."""
+    from ..core.column import HashedStrings
+    from ..core.dtypes import LogicalType
+    from ..core.table import _put
+    from .. import native
+    env = table.env
+    by_cols = [table.column(n) for n in by]
+    if not any(isinstance(c.dictionary, HashedStrings) for c in by_cols):
+        return None
+    import jax
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    descend = _norm_dirs(by, ascending)
+    if jax.process_count() > 1:
+        # The lane DEPTH must cover the longest common prefix over every
+        # DISTINCT value pair; with per-process value stores that bound is
+        # not computable locally (process A's 'aaaa1' vs process B's
+        # 'aaaa2' share 4 bytes that neither store sees as a pair).  A
+        # wrong depth silently mis-sorts, so refuse rather than guess.
+        raise InvalidError(
+            "multi-controller sort on high-cardinality (hashed) string "
+            "keys is not supported: per-process value stores cannot bound "
+            "the cross-process common-prefix depth; dictionary-encode the "
+            "column (low cardinality) or sort single-controller")
+    w, cap = env.world_size, table.capacity
+    vc = np.asarray(table.valid_counts, np.int64)
+    live = np.zeros(w * cap, bool)
+    for i in range(w):
+        live[i * cap: i * cap + int(vc[i])] = True
+    new_by, new_asc, add_cols = [], [], {}
+    for n, c, desc in zip(by, by_cols, descend):
+        if not isinstance(c.dictionary, HashedStrings):
+            new_by.append(n)
+            new_asc.append(not desc)
+            continue
+        hs, vs = c.dictionary._lookup()
+        vs = np.asarray(vs, dtype=object)
+        order = np.asarray(pc.sort_indices(
+            pa.array(vs, type=pa.large_string())), np.int64)
+        depth = native.max_adjacent_lcp(vs[order]) + 1
+        n_lanes = -(-depth // 4)
+        if n_lanes > MAX_ORDER_LANES:
+            # exact dense-rank fallback (store-local, single process)
+            ranks = np.empty(len(vs), np.uint32)
+            ranks[order] = np.arange(len(vs), dtype=np.uint32)
+            lanes = ranks[:, None]
+            n_lanes = 1
+        else:
+            lanes = native.prefix_lanes(vs, n_lanes)        # (U, L) u32
+            # +1 LENGTH lane: zero-padding is indistinguishable from a
+            # real NUL byte, so values differing only by trailing NULs
+            # ('ab' vs 'ab\0') encode identically at any depth — byte
+            # length breaks exactly that tie (a strict prefix sorts
+            # before its extensions, matching bytewise order)
+            lens = native.utf8_lengths(vs).astype(np.uint32)
+            lanes = np.concatenate([lanes, lens[:, None]], axis=1)
+            n_lanes += 1
+        codes = host_array(c.data)
+        cu = codes.view(np.uint64) if codes.dtype == np.int64 \
+            else codes.astype(np.uint64)
+        if len(hs):
+            idx = np.clip(np.searchsorted(hs, cu), 0, len(hs) - 1)
+            ok = live if c.validity is None \
+                else live & host_array(c.validity)
+            if bool((hs[idx][ok] != cu[ok]).any()):
+                raise InvalidError(
+                    f"sort on string column {n!r}: some rows' codes are "
+                    "missing from this process's value store (shuffled-in "
+                    "rows from another controller); materialize first")
+            row_lanes = lanes[idx]
+        else:
+            row_lanes = np.zeros((len(cu), n_lanes), np.uint32)
+        sharding = env.sharding()
+        for li in range(n_lanes):
+            lane = (row_lanes[:, li] ^ np.uint32(0x80000000)) \
+                .view(np.int32).copy()
+            name = f"__strord_{n}_{li}"
+            while name in table:
+                name += "_"
+            bounds = ((int(lane.min()), int(lane.max())) if lane.size
+                      else None)
+            add_cols[name] = Column(_put(lane, sharding), LogicalType.INT32,
+                                    c.validity, bounds=bounds)
+            new_by.append(name)
+            new_asc.append(not desc)
+    return table.with_columns(add_cols), new_by, new_asc, list(by)
+
+
 def sort_table(table: Table, by, ascending=True,
                nulls_position: str = "last",
                num_samples: int = DEFAULT_SAMPLES,
@@ -194,17 +305,26 @@ def sort_table(table: Table, by, ascending=True,
     by = [by] if isinstance(by, str) else list(by)
     if not by:
         raise InvalidError("sort needs at least one key column")
+    # hashed-string keys: rewrite to value-stable byte lanes, sort on the
+    # lanes, drop them — lexical order on arbitrary-cardinality strings
+    expanded = _expand_hashed_string_keys(table, by, ascending)
+    if expanded is not None:
+        table2, by2, asc2, orig_by = expanded
+        out = sort_table(table2, by2, asc2, nulls_position, num_samples,
+                         method)
+        synth = set(by2) - set(orig_by)
+        cols = {n: c for n, c in out.columns.items() if n not in synth}
+        res = Table(cols, env, out.valid_counts)
+        # lane-tuple equality == value equality (the depth covers every
+        # distinct pair's common prefix), so the grouped contract holds
+        # for the original keys
+        res.grouped_by = tuple(orig_by)
+        return res
     descendings = _norm_dirs(by, ascending)
     npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
     by_cols = [table.column(n) for n in by]
-    from ..core.column import HashedStrings
     from ..core.dtypes import LogicalType
     for n, c in zip(by, by_cols):
-        if isinstance(c.dictionary, HashedStrings):
-            raise InvalidError(
-                f"sort on high-cardinality hashed string column {n!r} is "
-                "not supported: hashed codes carry no lexical order "
-                "(equality ops — join/groupby/unique/filters — do work)")
         if c.type == LogicalType.LIST:
             raise InvalidError(
                 f"sort on list passthrough column {n!r} is not supported "

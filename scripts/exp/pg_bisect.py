@@ -1,0 +1,104 @@
+"""Bisect Mosaic legalization failure in the windowed gather kernel."""
+import sys
+from functools import partial
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu  # x64 on
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 256
+STAGE = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+FULL = {5, 6}
+
+def kern(ws_ref, idx_ref, mat_ref, out_ref, win_ref, sem_ref, *, window, L):
+    j = pl.program_id(0)
+    nt = pl.num_programs(0)
+    def dma(slot, t):
+        slot = jnp.asarray(slot, jnp.int32)
+        start = pl.multiple_of(ws_ref[t], 128)
+        return pltpu.make_async_copy(
+            mat_ref.at[:, pl.ds(start, window)],
+            win_ref.at[slot], sem_ref.at[slot])
+    if STAGE == 0:
+        pass
+    elif STAGE >= 2 and STAGE != 6:
+        @pl.when(j == 0)
+        def _():
+            dma(0, jnp.int32(0)).start()
+        @pl.when(j + 1 < nt)
+        def _():
+            dma(jax.lax.rem(j + 1, jnp.int32(2)), j + 1).start()
+        slot = jax.lax.rem(j, jnp.int32(2))
+        dma(slot, j).wait()
+    else:
+        slot = jnp.int32(0)
+        d = dma(slot, j)
+        d.start(); d.wait()
+    if STAGE == 0:
+        slot = jnp.int32(0)
+    if STAGE >= 3 or STAGE in FULL:
+        lidx = idx_ref[0] - ws_ref[j]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, window), 2)
+        oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+        oh = oh.reshape(TILE, window)
+    if STAGE >= 4 or STAGE in FULL:
+        w32 = win_ref[slot]
+        parts = [((w32 >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+                 .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+                 for k in range(4)]
+        wb = jnp.concatenate(parts, axis=0)
+    if STAGE in FULL:
+        acc = jax.lax.dot_general(oh, wb, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        u = acc.astype(jnp.int32).astype(jnp.uint32)
+        out_ref[...] = (u[:, :L] | (u[:, L:2*L] << jnp.uint32(8))
+                        | (u[:, 2*L:3*L] << jnp.uint32(16))
+                        | (u[:, 3*L:4*L] << jnp.uint32(24)))
+    else:
+        out_ref[...] = jnp.zeros((TILE, L), jnp.uint32)
+
+def run():
+    N = 1_048_576; SEG = 262_144; L = 8; window = 1024
+    rng = np.random.default_rng(0)
+    sn = np.sort(rng.choice(N, SEG // 2, replace=False)).astype(np.int32)
+    idx = np.full(SEG, N, np.int32); idx[:len(sn)] = sn
+    idx = jnp.asarray(idx)
+    mat_t = jnp.asarray(rng.integers(0, 1 << 32, (L, N + 1), dtype=np.uint32))
+    G = SEG // TILE
+    heads = idx[::TILE]
+    wsb = jnp.minimum((heads // 128) * 128, jnp.int32(((N + 1 - window) // 128) * 128))
+    idx2 = idx.reshape(G, 8, TILE // 8)
+    out = pl.pallas_call(
+        partial(kern, window=window, L=L),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(G,),
+            in_specs=[pl.BlockSpec((1, 8, TILE // 8), lambda j, ws: (j, jnp.int32(0), jnp.int32(0))),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((TILE, L), lambda j, ws: (j, jnp.int32(0))),
+            scratch_shapes=[pltpu.VMEM((2, L, window), jnp.uint32),
+                            pltpu.SemaphoreType.DMA((2,))]),
+        out_shape=jax.ShapeDtypeStruct((SEG, L), jnp.uint32),
+    )(wsb, idx2, mat_t)
+    r = np.asarray(out)
+    if STAGE in FULL:
+        exp = np.asarray(mat_t).T[np.asarray(idx)]
+        eq = (r == exp).all(axis=1)
+        k = (~eq).sum()
+        first_bad = int(np.argmin(eq)) if k else -1
+        print("STAGE", STAGE, "equal:", bool(eq.all()), "bad rows:", int(k),
+              "first bad:", first_bad, "n_real:", len(sn))
+        if k:
+            i = first_bad
+            print("idx[i]:", int(np.asarray(idx)[i]))
+            print("got:", [hex(v) for v in r[i]])
+            print("exp:", [hex(v) for v in exp[i]])
+            # which source row does 'got' correspond to?
+            mt = np.asarray(mat_t)
+            for cand in range(max(0, int(np.asarray(idx)[i])-3), int(np.asarray(idx)[i])+4):
+                if (mt[:, cand] == r[i]).all():
+                    print("got == mat row", cand)
+    else:
+        print("STAGE", STAGE, "compiled+ran")
+
+run()

@@ -1,0 +1,232 @@
+"""Isolate: (a) u32 byte split/recombine in Mosaic; (b) one-hot matmul."""
+import sys
+sys.path.insert(0, "/root/repo")
+from functools import partial
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "bytes"
+L, W, TILE = 8, 1024, 256
+
+def kern_bytes(x_ref, o_ref):
+    w32 = x_ref[...]                       # (L, W) u32
+    parts = [((w32 >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+             .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+             for k in range(4)]
+    back = [p.astype(jnp.float32).astype(jnp.int32).astype(jnp.uint32)
+            for p in parts]
+    o_ref[...] = (back[0] | back[1] << jnp.uint32(8)
+                  | back[2] << jnp.uint32(16) | back[3] << jnp.uint32(24))
+
+def kern_mm(x_ref, idx_ref, o_ref):
+    w32 = x_ref[...]
+    parts = [((w32 >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+             .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+             for k in range(4)]
+    wb = jnp.concatenate(parts, axis=0)    # (4L, W)
+    lidx = idx_ref[0]                      # (8, 32)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+    oh = oh.reshape(TILE, W)
+    acc = jax.lax.dot_general(oh, wb, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    u = acc.astype(jnp.int32).astype(jnp.uint32)
+    o_ref[...] = (u[:, :L] | (u[:, L:2*L] << jnp.uint32(8))
+                  | (u[:, 2*L:3*L] << jnp.uint32(16))
+                  | (u[:, 3*L:4*L] << jnp.uint32(24)))
+
+rng = np.random.default_rng(1)
+x = jnp.asarray(rng.integers(0, 1 << 32, (L, W), dtype=np.uint32))
+if MODE == "bytes":
+    out = pl.pallas_call(kern_bytes,
+                         out_shape=jax.ShapeDtypeStruct((L, W), jnp.uint32),
+                         )(x)
+    print("bytes exact:", bool((np.asarray(out) == np.asarray(x)).all()))
+else:
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((TILE, L), lambda j: (j, jnp.int32(0))),
+                         out_shape=jax.ShapeDtypeStruct((TILE, L), jnp.uint32),
+                         )(x, idx2)
+    exp = np.asarray(x).T[idxn]
+    got = np.asarray(out)
+    eq = (got == exp)
+    print("mm exact:", bool(eq.all()), "bad:", int((~eq.all(axis=1)).sum()))
+    if not eq.all():
+        i = int(np.argmin(eq.all(axis=1)))
+        print("got:", [hex(v) for v in got[i]]); print("exp:", [hex(v) for v in exp[i]])
+
+# mode mm16: u16 split in f32 matmul
+def kern_mm16(x_ref, idx_ref, o_ref):
+    w32 = x_ref[...]
+    hi = (w32 >> jnp.uint32(16)).astype(jnp.int32).astype(jnp.float32)
+    lo = (w32 & jnp.uint32(0xFFFF)).astype(jnp.int32).astype(jnp.float32)
+    wb = jnp.concatenate([hi, lo], axis=0)   # (2L, W) f32
+    lidx = idx_ref[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.float32)
+    oh = oh.reshape(TILE, W)
+    acc = jax.lax.dot_general(oh, wb, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    u = acc.astype(jnp.int32).astype(jnp.uint32)
+    o_ref[...] = (u[:, :L] << jnp.uint32(16)) | u[:, L:2*L]
+
+if MODE == "mm16":
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm16,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((TILE, L), lambda j: (j, jnp.int32(0))),
+                         out_shape=jax.ShapeDtypeStruct((TILE, L), jnp.uint32),
+                         )(x, idx2)
+    exp = np.asarray(x).T[idxn]
+    got = np.asarray(out)
+    eq = got == exp
+    print("mm16 exact:", bool(eq.all()), "bad rows:", int((~eq.all(axis=1)).sum()))
+    if not eq.all():
+        i = int(np.argmin(eq.all(axis=1)))
+        print("got:", [hex(v) for v in got[i]])
+        print("exp:", [hex(v) for v in exp[i]])
+
+# mode mm4: four per-plane dots, no concat
+def kern_mm4(x_ref, idx_ref, o_ref):
+    w32 = x_ref[...]
+    lidx = idx_ref[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+    oh = oh.reshape(TILE, W)
+    accs = []
+    for k in range(4):
+        pk = ((w32 >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)) \
+            .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+        a = jax.lax.dot_general(oh, pk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        accs.append(a.astype(jnp.int32).astype(jnp.uint32))
+    o_ref[...] = (accs[0] | accs[1] << jnp.uint32(8)
+                  | accs[2] << jnp.uint32(16) | accs[3] << jnp.uint32(24))
+
+if MODE == "mm4":
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm4,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((TILE, L), lambda j: (j, jnp.int32(0))),
+                         out_shape=jax.ShapeDtypeStruct((TILE, L), jnp.uint32),
+                         )(x, idx2)
+    exp = np.asarray(x).T[idxn]
+    got = np.asarray(out)
+    eq = got == exp
+    print("mm4 exact:", bool(eq.all()), "bad:", int((~eq.all(axis=1)).sum()))
+
+# mode mm5: wb assembled in VMEM scratch via slice writes, one 32-row dot
+from jax.experimental.pallas import tpu as pltpu
+def kern_mm5(x_ref, idx_ref, o_ref, wb_ref):
+    w32 = x_ref[...]
+    for k in range(4):
+        wb_ref[pl.ds(k * L, L), :] = ((w32 >> jnp.uint32(8 * k))
+                                      & jnp.uint32(0xFF)) \
+            .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+    lidx = idx_ref[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+    oh = oh.reshape(TILE, W)
+    acc = jax.lax.dot_general(oh, wb_ref[...], (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    u = acc.astype(jnp.int32).astype(jnp.uint32)
+    o_ref[...] = (u[:, :L] | (u[:, L:2*L] << jnp.uint32(8))
+                  | (u[:, 2*L:3*L] << jnp.uint32(16))
+                  | (u[:, 3*L:4*L] << jnp.uint32(24)))
+
+if MODE == "mm5":
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm5,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((TILE, L), lambda j: (j, jnp.int32(0))),
+                         out_shape=jax.ShapeDtypeStruct((TILE, L), jnp.uint32),
+                         scratch_shapes=[pltpu.VMEM((4 * L, W), jnp.bfloat16)],
+                         )(x, idx2)
+    exp = np.asarray(x).T[idxn]
+    got = np.asarray(out)
+    eq = got == exp
+    print("mm5 exact:", bool(eq.all()), "bad:", int((~eq.all(axis=1)).sum()))
+
+# mode mm6: all-f32 operands (internal demotion exact for u8 values)
+def kern_mm6(x_ref, idx_ref, o_ref, wb_ref):
+    w32 = x_ref[...]
+    for k in range(4):
+        wb_ref[pl.ds(k * L, L), :] = ((w32 >> jnp.uint32(8 * k))
+                                      & jnp.uint32(0xFF)) \
+            .astype(jnp.int32).astype(jnp.float32)
+    lidx = idx_ref[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.float32)
+    oh = oh.reshape(TILE, W)
+    acc = jax.lax.dot_general(oh, wb_ref[...], (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    u = acc.astype(jnp.int32).astype(jnp.uint32)
+    o_ref[...] = (u[:, :L] | (u[:, L:2*L] << jnp.uint32(8))
+                  | (u[:, 2*L:3*L] << jnp.uint32(16))
+                  | (u[:, 3*L:4*L] << jnp.uint32(24)))
+
+if MODE == "mm6":
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm6,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((TILE, L), lambda j: (j, jnp.int32(0))),
+                         out_shape=jax.ShapeDtypeStruct((TILE, L), jnp.uint32),
+                         scratch_shapes=[pltpu.VMEM((4 * L, W), jnp.float32)],
+                         )(x, idx2)
+    exp = np.asarray(x).T[idxn]
+    got = np.asarray(out)
+    eq = got == exp
+    print("mm6 exact:", bool(eq.all()), "bad:", int((~eq.all(axis=1)).sum()))
+
+# mode mm7: transposed acc (4L, TILE): planes are SUBLANE slices; output (L, TILE)
+def kern_mm7(x_ref, idx_ref, o_ref, wb_ref):
+    w32 = x_ref[...]
+    for k in range(4):
+        wb_ref[pl.ds(k * L, L), :] = ((w32 >> jnp.uint32(8 * k))
+                                      & jnp.uint32(0xFF)) \
+            .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+    lidx = idx_ref[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+    oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+    oh = oh.reshape(TILE, W)
+    accT = jax.lax.dot_general(wb_ref[...], oh, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)  # (4L, TILE)
+    u = accT.astype(jnp.int32).astype(jnp.uint32)
+    o_ref[...] = (u[0:L] | u[L:2*L] << jnp.uint32(8)
+                  | u[2*L:3*L] << jnp.uint32(16)
+                  | u[3*L:4*L] << jnp.uint32(24))
+
+if MODE == "mm7":
+    idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+    idx2 = jnp.asarray(idxn.reshape(1, 8, TILE // 8))
+    out = pl.pallas_call(kern_mm7,
+                         grid=(1,),
+                         in_specs=[pl.BlockSpec((L, W), lambda j: (jnp.int32(0), jnp.int32(0))),
+                                   pl.BlockSpec((1, 8, TILE // 8), lambda j: (j, jnp.int32(0), jnp.int32(0)))],
+                         out_specs=pl.BlockSpec((L, TILE), lambda j: (jnp.int32(0), j)),
+                         out_shape=jax.ShapeDtypeStruct((L, TILE), jnp.uint32),
+                         scratch_shapes=[pltpu.VMEM((4 * L, W), jnp.bfloat16)],
+                         )(x, idx2)
+    exp = np.asarray(x)[:, idxn]          # (L, TILE)
+    got = np.asarray(out)
+    eq = got == exp
+    print("mm7 exact:", bool(eq.all()), "bad:", int((~eq).sum()))

@@ -1,0 +1,29 @@
+"""Isolate: bf16 sublane concatenate of byte planes in Mosaic."""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu
+from jax.experimental import pallas as pl
+
+L, W = 8, 1024
+
+def kern(x_ref, o_ref):
+    w32 = x_ref[...]
+    parts = [((w32 >> jnp.uint32(8 * k)) & jnp.uint32(0xFF))
+             .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+             for k in range(4)]
+    wb = jnp.concatenate(parts, axis=0)        # (4L, W) bf16
+    back = [wb[k * L:(k + 1) * L].astype(jnp.float32).astype(jnp.int32)
+            .astype(jnp.uint32) for k in range(4)]
+    o_ref[...] = (back[0] | back[1] << jnp.uint32(8)
+                  | back[2] << jnp.uint32(16) | back[3] << jnp.uint32(24))
+
+rng = np.random.default_rng(1)
+x = jnp.asarray(rng.integers(0, 1 << 32, (L, W), dtype=np.uint32))
+out = pl.pallas_call(kern, out_shape=jax.ShapeDtypeStruct((L, W), jnp.uint32))(x)
+got, exp = np.asarray(out), np.asarray(x)
+eq = got == exp
+print("concat exact:", bool(eq.all()), "bad:", int((~eq).sum()))
+if not eq.all():
+    r, c = np.argwhere(~eq)[0]
+    print(hex(got[r, c]), "vs", hex(exp[r, c]))

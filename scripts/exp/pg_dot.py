@@ -1,0 +1,47 @@
+"""Isolate dot_general corruption: select columns of B via one-hot A."""
+import sys
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu
+from jax.experimental import pallas as pl
+
+MODE = sys.argv[1]
+TILE, W, R = 256, 1024, 32
+rng = np.random.default_rng(2)
+bn = rng.integers(0, 256, (R, W)).astype(np.float32)   # like u8 planes
+idxn = np.sort(rng.choice(W, TILE, replace=False)).astype(np.int32)
+ohn = np.zeros((TILE, W), np.float32); ohn[np.arange(TILE), idxn] = 1.0
+
+def kern_t(a_ref, b_ref, o_ref):   # contract dim1 of both (A @ B^T)
+    o_ref[...] = jax.lax.dot_general(a_ref[...], b_ref[...],
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+def kern_n(a_ref, b_ref, o_ref):   # standard A @ B
+    o_ref[...] = jax.lax.dot_general(a_ref[...], b_ref[...],
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+if MODE == "t":
+    out = pl.pallas_call(kern_t,
+        out_shape=jax.ShapeDtypeStruct((TILE, R), jnp.float32))(
+        jnp.asarray(ohn), jnp.asarray(bn))
+    exp = bn.T[idxn]
+elif MODE == "n":
+    out = pl.pallas_call(kern_n,
+        out_shape=jax.ShapeDtypeStruct((TILE, R), jnp.float32))(
+        jnp.asarray(ohn), jnp.asarray(bn.T.copy()))
+    exp = bn.T[idxn]
+elif MODE == "tbig":
+    bn2 = rng.integers(0, 65536, (R, W)).astype(np.float32)
+    out = pl.pallas_call(kern_t,
+        out_shape=jax.ShapeDtypeStruct((TILE, R), jnp.float32))(
+        jnp.asarray(ohn), jnp.asarray(bn2))
+    exp = bn2.T[idxn]
+got = np.asarray(out)
+eq = got == exp
+print(MODE, "exact:", bool(eq.all()), "bad:", int((~eq).sum()))
+if not eq.all():
+    bi = np.argwhere(~eq)[:4]
+    for r, c in bi:
+        print("row", r, "col", c, "got", got[r, c], "exp", exp[r, c])

@@ -1,0 +1,93 @@
+"""Find the per-step overhead: strip kernel stages at full scale."""
+import sys
+sys.path.insert(0, "/root/repo")
+from functools import partial
+import time
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MODE = sys.argv[1]
+TILE = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+W = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+L = 8
+N = 64_000_000; SEG = 33_554_432
+
+_pull = jax.jit(lambda x: x.reshape(-1)[:2].astype(jnp.float32).sum())
+def sync(out): np.asarray(_pull(jax.tree.leaves(out)[0]))
+
+def kern(ws_ref, idx_ref, mat_ref, out_ref, win_ref, wb_ref, sem_ref):
+    j = pl.program_id(0)
+    nt = pl.num_programs(0)
+    def dma(slot, t):
+        slot = jnp.asarray(slot, jnp.int32)
+        start = pl.multiple_of(ws_ref[t], 128)
+        return pltpu.make_async_copy(
+            mat_ref.at[:, pl.ds(start, W)],
+            win_ref.at[slot], sem_ref.at[slot])
+    if MODE != "nodma":
+        @pl.when(j == 0)
+        def _():
+            dma(0, jnp.int32(0)).start()
+        @pl.when(j + 1 < nt)
+        def _():
+            dma(jax.lax.rem(j + 1, jnp.int32(2)), j + 1).start()
+        slot = jax.lax.rem(j, jnp.int32(2))
+        dma(slot, j).wait()
+    else:
+        slot = jnp.int32(0)
+    if MODE in ("full", "nohot"):
+        w32 = win_ref[slot]
+        for k in range(4):
+            wb_ref[pl.ds(k * L, L), :] = ((w32 >> jnp.uint32(8 * k))
+                                          & jnp.uint32(0xFF)) \
+                .astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+    if MODE == "full":
+        lidx = idx_ref[0] - ws_ref[j]
+        iota = jax.lax.broadcasted_iota(jnp.int32, (8, TILE // 8, W), 2)
+        oh = (iota == lidx[:, :, None]).astype(jnp.bfloat16)
+        oh = oh.reshape(TILE, W)
+    elif MODE == "nohot":
+        oh = jnp.zeros((TILE, W), jnp.bfloat16)
+    if MODE in ("full", "nohot"):
+        accT = jax.lax.dot_general(wb_ref[...], oh, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        u = accT.astype(jnp.int32).astype(jnp.uint32)
+        out_ref[...] = (u[0:L] | u[L:2*L] << jnp.uint32(8)
+                        | u[2*L:3*L] << jnp.uint32(16)
+                        | u[3*L:4*L] << jnp.uint32(24))
+    else:
+        out_ref[...] = jnp.zeros((L, TILE), jnp.uint32)
+
+rng = np.random.default_rng(0)
+sn = np.sort(rng.choice(N, 29_000_000, replace=False)).astype(np.int32)
+idx = np.full(SEG, N, np.int32); idx[:len(sn)] = sn
+idx = jnp.asarray(idx)
+mat_t = jnp.asarray(rng.integers(0, 1 << 32, (L, N + 128), dtype=np.uint32))
+G = SEG // TILE
+heads = idx[::TILE]
+ws = jnp.minimum((heads // 128) * 128, jnp.int32(((N + 128 - W) // 128) * 128))
+idx2 = idx.reshape(G, 8, TILE // 8)
+
+def run(ws, idx2, mat_t):
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(G,),
+            in_specs=[pl.BlockSpec((1, 8, TILE // 8),
+                                   lambda j, ws: (j, jnp.int32(0), jnp.int32(0))),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec((L, TILE), lambda j, ws: (jnp.int32(0), j)),
+            scratch_shapes=[pltpu.VMEM((2, L, W), jnp.uint32),
+                            pltpu.VMEM((4 * L, W), jnp.bfloat16),
+                            pltpu.SemaphoreType.DMA((2,))]),
+        out_shape=jax.ShapeDtypeStruct((L, SEG), jnp.uint32),
+    )(ws, idx2, mat_t)
+
+f = jax.jit(run)
+sync(f(ws, idx2, mat_t))
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter(); sync(f(ws, idx2, mat_t)); best = min(best, time.perf_counter() - t0)
+print(f"{MODE} TILE={TILE} W={W}: {best*1e3:.1f} ms")

@@ -6,6 +6,9 @@ Reference analog: non-fixed-width keys flatten to binary and hash
 stable 64-bit value hashes (no n-entry dictionary is ever built), raw
 values stay host-side, equality ops are exact (up to 64-bit collisions),
 ordered ops raise.
+Round 5: ordered SORTS on hashed strings now work via value-stable
+byte-lane expansion (relational/sort._expand_hashed_string_keys);
+min/max/range-compares still raise.
 """
 
 import numpy as np
@@ -133,12 +136,6 @@ class TestRelationalOps:
 
 
 class TestOrderedOpsRaise:
-    def test_sort_raises(self, env1, rng, hashed_mode):
-        df = pd.DataFrame({"k": _keys(rng, 2000), "v": np.arange(2000)})
-        t = ct.Table.from_pandas(df, env1)
-        with pytest.raises(Exception, match="hashed"):
-            sort_table(t, "k")
-
     def test_range_compare_raises(self, env1, rng, hashed_mode):
         df = pd.DataFrame({"k": _keys(rng, 2000)})
         f = ct.DataFrame(df, env=env1)
@@ -210,3 +207,133 @@ class TestReviewRegressions:
         assert isinstance(f._table.column("k").dictionary, HashedStrings)
         out = f.loc[["id_7", "id_42"]].to_pandas()
         assert sorted(out["v"].tolist()) == [7, 42]
+
+
+class TestStringSort:
+    """Lexical sort on hashed (high-cardinality) string keys — VERDICT r4
+    missing #1.  Reference: arrow_kernels.hpp:53 IndexSortKernel over
+    StringArray; distributed via MapToSortPartitions."""
+
+    def _check(self, df, env, by="k", ascending=True, npos="last"):
+        t = ct.Table.from_pandas(df, env)
+        assert isinstance(t.column("k").dictionary, HashedStrings)
+        out = sort_table(t, by, ascending=ascending, nulls_position=npos)
+        got = out.to_pandas()
+        exp = df.sort_values(by, ascending=ascending,
+                             na_position=npos).reset_index(drop=True)
+        assert got["k"].tolist() == exp["k"].tolist()
+        if "v" in df:
+            # ties (equal keys) may order differently; compare key-wise sums
+            assert got.groupby("k", dropna=False)["v"].sum().sort_index() \
+                .tolist() == exp.groupby("k", dropna=False)["v"].sum() \
+                .sort_index().tolist()
+
+    def test_sort_matches_pandas_w1(self, env1, rng, hashed_mode):
+        df = pd.DataFrame({"k": _keys(rng, 3000, card=100000),
+                           "v": np.arange(3000)})
+        self._check(df, env1)
+
+    def test_sort_matches_pandas_w4(self, env4, rng, hashed_mode):
+        df = pd.DataFrame({"k": _keys(rng, 4000, card=100000),
+                           "v": np.arange(4000)})
+        self._check(df, env4)
+
+    def test_sort_matches_pandas_w8(self, env8, rng, hashed_mode):
+        df = pd.DataFrame({"k": _keys(rng, 6000, card=100000),
+                           "v": np.arange(6000)})
+        self._check(df, env8)
+
+    def test_descending(self, env4, rng, hashed_mode):
+        df = pd.DataFrame({"k": _keys(rng, 2000, card=50000)})
+        self._check(df, env4, ascending=False)
+
+    def test_nulls_first_and_last(self, env4, rng, hashed_mode):
+        k = _keys(rng, 2000, card=50000)
+        k[rng.random(2000) < 0.05] = None
+        df = pd.DataFrame({"k": k, "v": np.arange(2000)})
+        t = ct.Table.from_pandas(df, env4)
+        for npos in ("last", "first"):
+            got = sort_table(t, "k", nulls_position=npos).to_pandas()
+            exp = df.sort_values("k", na_position=npos) \
+                .reset_index(drop=True)
+            assert got["k"].tolist() == exp["k"].tolist()
+
+    def test_mixed_string_and_numeric_keys(self, env4, rng, hashed_mode):
+        df = pd.DataFrame({"k": _keys(rng, 2500, card=1000),
+                           "v": rng.integers(0, 50, 2500)})
+        t = ct.Table.from_pandas(df, env4)
+        assert isinstance(t.column("k").dictionary, HashedStrings)
+        got = sort_table(t, ["k", "v"]).to_pandas()
+        exp = df.sort_values(["k", "v"]).reset_index(drop=True)
+        assert got["k"].tolist() == exp["k"].tolist()
+        assert got["v"].tolist() == exp["v"].tolist()
+
+    def test_variable_length_prefix_order(self, env4, hashed_mode):
+        # short strings sort before their extensions; multi-lane depths
+        vals = ["b", "ba", "b0", "a" * 9, "a" * 9 + "z", "a" * 8, "aa",
+                "", "zz", "z"]
+        k = np.asarray([vals[i % len(vals)] + f"_{i}" for i in range(1500)],
+                       dtype=object)
+        df = pd.DataFrame({"k": k})
+        self._check(df, env4)
+
+    def test_deep_common_prefix_rank_fallback(self, env4, hashed_mode):
+        # >64 shared prefix bytes: lanes cannot separate; exact dense-rank
+        # fallback (single-process)
+        pre = "p" * 80
+        k = np.asarray([f"{pre}{i:06d}" for i in
+                        np.random.default_rng(0).permutation(1500)],
+                       dtype=object)
+        df = pd.DataFrame({"k": k})
+        self._check(df, env4)
+
+    def test_grouped_by_contract(self, env4, rng, hashed_mode):
+        # groupby after string sort must take the no-shuffle fast path and
+        # still be correct (lane equality == value equality)
+        df = pd.DataFrame({"k": _keys(rng, 3000, card=500),
+                           "v": rng.random(3000)})
+        t = ct.Table.from_pandas(df, env4)
+        out = sort_table(t, "k")
+        assert out.grouped_by == ("k",)
+        got = groupby_aggregate(out, ["k"], [("v", "sum")]).to_pandas()
+        exp = df.groupby("k", as_index=False)["v"].sum()
+        got = got.sort_values("k").reset_index(drop=True)
+        exp = exp.sort_values("k").reset_index(drop=True)
+        assert got["k"].tolist() == exp["k"].tolist()
+        np.testing.assert_allclose(got["v_sum"], exp["v"])
+
+
+class TestOrderLanesNative:
+    def test_prefix_lanes_order(self):
+        vals = np.asarray(["", "a", "ab", "abc", "abcd", "abcde", "b",
+                           "aa" * 10], dtype=object)
+        L = 3
+        lanes = native.prefix_lanes(vals, L)
+        assert lanes.shape == (len(vals), L)
+        key = [tuple(r) for r in lanes]
+        order = sorted(range(len(vals)), key=lambda i: key[i])
+        exp = sorted(range(len(vals)), key=lambda i: vals[i])
+        assert order == exp
+
+    def test_max_adjacent_lcp(self):
+        assert native.max_adjacent_lcp(
+            np.asarray(["ab", "abc", "abd", "b"], dtype=object)) == 2
+        assert native.max_adjacent_lcp(
+            np.asarray(["x"], dtype=object)) == 0
+        assert native.max_adjacent_lcp(
+            np.asarray(["q", "q"], dtype=object)) == 0
+
+    def test_trailing_nul_bytes(self, env4, hashed_mode):
+        # 'ab' vs 'ab\0': zero-padded lanes are identical — the length
+        # lane must break the tie in bytewise order
+        base = [f"v{i}" for i in range(300)]
+        vals = []
+        for b in base:
+            vals += [b, b + "\0", b + "\0\0"]
+        k = np.asarray(vals, dtype=object)
+        df = pd.DataFrame({"k": k})
+        t = ct.Table.from_pandas(df, env4)
+        assert isinstance(t.column("k").dictionary, HashedStrings)
+        got = sort_table(t, "k").to_pandas()
+        exp = df.sort_values("k").reset_index(drop=True)
+        assert got["k"].tolist() == exp["k"].tolist()

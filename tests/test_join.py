@@ -129,3 +129,128 @@ def test_join_right_table_key_only(env4):
                         ct.Table.from_pandas(rdf, env4), "k", "k", how=how)
         exp = ldf.merge(rdf, on="k", how=how)
         assert j.row_count == len(exp), (how, j.row_count, len(exp))
+
+
+class TestSemiAntiJoin:
+    """LEFT SEMI / LEFT ANTI joins (round-5: the NOT-EXISTS operator family
+    TPC-H Q16/Q21/Q22 need).  Output = filtered left rows, no expansion."""
+
+    def _oracle(self, ldf, rdf, on, how):
+        m = ldf[on].isin(set(rdf[on]))
+        return ldf[m] if how == "semi" else ldf[~m]
+
+    @pytest.mark.parametrize("how", ["semi", "anti"])
+    def test_matches_pandas_w4(self, env4, rng, how):
+        ldf = pd.DataFrame({"k": rng.integers(0, 60, 400).astype(np.int64),
+                            "a": rng.random(400)})
+        rdf = pd.DataFrame({"k": rng.integers(30, 90, 250).astype(np.int64),
+                            "b": rng.random(250)})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        out = join_tables(lt, rt, "k", "k", how=how).to_pandas()
+        exp = self._oracle(ldf, rdf, "k", how)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+        assert np.isclose(out["a"].sum(), exp["a"].sum())
+
+    @pytest.mark.parametrize("how", ["semi", "anti"])
+    def test_local_w1(self, env1, rng, how):
+        ldf = pd.DataFrame({"k": rng.integers(0, 30, 120).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.integers(15, 45, 80).astype(np.int64)})
+        lt = ct.Table.from_pandas(ldf, env1)
+        rt = ct.Table.from_pandas(rdf, env1)
+        out = join_tables(lt, rt, "k", "k", how=how).to_pandas()
+        exp = self._oracle(ldf, rdf, "k", how)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+
+    def test_duplicates_emit_once(self, env4):
+        # semi/anti never multiply rows, whatever the right multiplicity
+        ldf = pd.DataFrame({"k": np.asarray([1, 1, 2, 3], np.int64)})
+        rdf = pd.DataFrame({"k": np.asarray([1] * 50 + [3], np.int64)})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        semi = join_tables(lt, rt, "k", "k", how="semi").to_pandas()
+        anti = join_tables(lt, rt, "k", "k", how="anti").to_pandas()
+        assert sorted(semi["k"].tolist()) == [1, 1, 3]
+        assert anti["k"].tolist() == [2]
+
+    def test_null_keys_match_nulls(self, env4):
+        # pandas-merge semantics: null keys equal each other (like the
+        # other join types here)
+        ldf = pd.DataFrame({"k": pd.array([1, None, 2], dtype="Int64")})
+        rdf = pd.DataFrame({"k": pd.array([None, 2], dtype="Int64")})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        semi = join_tables(lt, rt, "k", "k", how="semi").to_pandas()
+        assert len(semi) == 2   # the null row and the 2 row
+        anti = join_tables(lt, rt, "k", "k", how="anti").to_pandas()
+        assert anti["k"].tolist() == [1]
+
+    @pytest.mark.parametrize("how", ["semi", "anti"])
+    def test_string_keys(self, env4, rng, how):
+        lk = np.asarray([f"u{i}" for i in rng.integers(0, 40, 300)], object)
+        rk = np.asarray([f"u{i}" for i in rng.integers(20, 60, 200)], object)
+        ldf = pd.DataFrame({"k": lk})
+        rdf = pd.DataFrame({"k": rk})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        out = join_tables(lt, rt, "k", "k", how=how).to_pandas()
+        exp = self._oracle(ldf, rdf, "k", how)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+
+    @pytest.mark.parametrize("how", ["semi", "anti"])
+    def test_skewed_probe(self, env8, rng, how, monkeypatch):
+        from cylon_tpu import config
+        monkeypatch.setattr(config, "SKEW_MIN_SHARE", 0.01)
+        n = 4000
+        lk = rng.integers(0, 500, n).astype(np.int64)
+        lk[rng.random(n) < 0.9] = 7          # 90% one key
+        ldf = pd.DataFrame({"k": lk})
+        rdf = pd.DataFrame({"k": rng.integers(0, 500, 600).astype(np.int64)})
+        lt = ct.Table.from_pandas(ldf, env8)
+        rt = ct.Table.from_pandas(rdf, env8)
+        out = join_tables(lt, rt, "k", "k", how=how).to_pandas()
+        exp = self._oracle(ldf, rdf, "k", how)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+
+
+class TestOuterSkew:
+    """Round-5: full outer joins get the heavy-key split (VERDICT weak #3)
+    via the left-join ∪ anti-complement decomposition."""
+
+    def test_outer_90pct_one_key_w8(self, env8, rng, monkeypatch):
+        from cylon_tpu import config
+        monkeypatch.setattr(config, "SKEW_MIN_SHARE", 0.01)
+        n = 3000
+        lk = rng.integers(0, 400, n).astype(np.int64)
+        lk[rng.random(n) < 0.9] = 11
+        ldf = pd.DataFrame({"k": lk, "a": rng.random(n)})
+        rdf = pd.DataFrame({"k": rng.integers(200, 600, 800).astype(np.int64),
+                            "b": rng.random(800)})
+        lt = ct.Table.from_pandas(ldf, env8)
+        rt = ct.Table.from_pandas(rdf, env8)
+        out = join_tables(lt, rt, "k", "k", how="outer").to_pandas()
+        exp = ldf.merge(rdf, on="k", how="outer")
+        assert len(out) == len(exp)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+        assert np.isclose(out["a"].sum(), exp["a"].sum())
+        assert np.isclose(out["b"].sum(), exp["b"].sum())
+        assert int(out["b"].isna().sum()) == int(exp["b"].isna().sum())
+
+    def test_outer_skew_with_string_payload(self, env8, rng, monkeypatch):
+        from cylon_tpu import config
+        monkeypatch.setattr(config, "SKEW_MIN_SHARE", 0.01)
+        n = 2000
+        lk = rng.integers(0, 200, n).astype(np.int64)
+        lk[rng.random(n) < 0.85] = 3
+        ldf = pd.DataFrame({"k": lk,
+                            "s": [f"L{i%37}" for i in range(n)]})
+        rdf = pd.DataFrame({"k": rng.integers(100, 300, 500).astype(np.int64),
+                            "t": [f"R{i%23}" for i in range(500)]})
+        lt = ct.Table.from_pandas(ldf, env8)
+        rt = ct.Table.from_pandas(rdf, env8)
+        out = join_tables(lt, rt, "k", "k", how="outer").to_pandas()
+        exp = ldf.merge(rdf, on="k", how="outer")
+        assert len(out) == len(exp)
+        assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
+        assert (out["t"].dropna().value_counts().sort_index()
+                .equals(exp["t"].dropna().value_counts().sort_index()))

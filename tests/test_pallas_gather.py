@@ -1,0 +1,70 @@
+"""Windowed Pallas gather (ops/pallas_gather) — interpret-mode checks on
+the CPU rig; the real-TPU path is exercised by bench.py and the fused
+groupby dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cylon_tpu.ops import pallas_gather as pg
+
+
+def _ref(mat, idx):
+    return np.asarray(mat)[:, np.asarray(idx)]
+
+
+def _mk(n_rows, n_lanes, seg, density_pattern, rng):
+    # lane-major (L, M), as the API requires
+    mat = jnp.asarray(
+        rng.integers(0, 1 << 32, (n_lanes, n_rows), dtype=np.uint32))
+    if density_pattern == "dense":
+        k = min(int(n_rows * 0.45), seg)
+        real = np.sort(rng.choice(n_rows - 1, k, replace=False))
+    elif density_pattern == "skewed":
+        # one huge group: a long index gap that overflows any window
+        k = min(int(n_rows * 0.45), seg)
+        real = np.sort(rng.choice(n_rows // 8, k - 1, replace=False))
+        real = np.concatenate([real, [n_rows - 1]])
+    else:  # tail sentinels only
+        real = np.zeros(0, np.int64)
+    idx = np.full(seg, n_rows - 1, np.int32)
+    idx[:len(real)] = real
+    return mat, jnp.asarray(idx)
+
+
+class TestWindowedTake:
+    @pytest.mark.parametrize("n_lanes", [1, 7, 8, 13])
+    def test_matches_plain_gather(self, rng, n_lanes):
+        n_rows, seg = 4096, 2048
+        mat, idx = _mk(n_rows, n_lanes, seg, "dense", rng)
+        out, ok = jax.jit(lambda m, i: pg.windowed_take_t(
+            m, i, window=1024, interpret=True))(mat, idx)
+        assert bool(np.asarray(ok))
+        np.testing.assert_array_equal(np.asarray(out), _ref(mat, idx))
+
+    def test_sentinel_tail(self, rng):
+        # all-sentinel tail tiles (empty groups past n_groups)
+        mat, idx = _mk(4096, 5, 1024, "tail", rng)
+        out, ok = jax.jit(lambda m, i: pg.windowed_take_t(
+            m, i, window=1024, interpret=True))(mat, idx)
+        assert bool(np.asarray(ok))
+        np.testing.assert_array_equal(np.asarray(out), _ref(mat, idx))
+
+    def test_skewed_spans_flagged(self, rng):
+        # a span overflow must be reported so the dispatch layer can
+        # redispatch a plain-gather program
+        mat, idx = _mk(1 << 15, 6, 4096, "skewed", rng)
+        out, ok = jax.jit(lambda m, i: pg.windowed_take_t(
+            m, i, window=1024, interpret=True))(mat, idx)
+        assert not bool(np.asarray(ok))
+
+    def test_supported_gate(self):
+        assert pg.supported(1 << 20, 1 << 20, 8, 1024)
+        assert not pg.supported(512, 1 << 20, 8, 1024)   # mat < window
+        assert not pg.supported(1 << 20, 100, 8, 1024)   # seg not tiled
+
+    def test_pick_window(self):
+        assert pg.pick_window(0.45) == 1024
+        assert pg.pick_window(0.25) == 2048
+        assert pg.pick_window(0.05) == pg.MAX_WINDOW
