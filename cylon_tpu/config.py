@@ -117,6 +117,11 @@ PROGRAM_CACHE_SIZE = int(os.environ.get("CYLON_TPU_PROGRAM_CACHE", "256"))
 #: 4).  Detection runs on the ROW HASH of the (possibly multi-column) key
 #: tuple, so float keys and multi-column keys participate uniformly and
 #: the flag predicate is exactly the shuffle-routing hash.
+#: a join side at or below this row count is REPLICATED (allgather)
+#: instead of shuffling both sides — the broadcast-hash-join cutover
+BROADCAST_JOIN_ROWS = int(os.environ.get("CYLON_TPU_BROADCAST_JOIN_ROWS",
+                                         "65536"))
+
 #: Rows sampled per shard for the heavy-hitter estimate:
 SKEW_SAMPLE = int(os.environ.get("CYLON_TPU_SKEW_SAMPLE", "4096"))
 #: Minimum per-shard sampled share for a key to enter the estimate:

@@ -201,6 +201,10 @@ class GroupBySink:
         if prev is not None:
             self._settle(prev)
         if h is None:
+            # a crash-exhausted begin must not let groupby_aggregate
+            # re-run the identical (uncached) compile ladder — force the
+            # materialize path first, exactly like _settle
+            chunk.columns  # noqa: B018 — triggers DeferredTable thunk
             self._parts.append(
                 groupby_aggregate(chunk, self.by, list(self._chunk_aggs)))
         return None

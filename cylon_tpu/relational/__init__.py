@@ -13,7 +13,7 @@ path, mirroring the reference's ``world==1 -> local op`` dispatch
 (table.cpp:866-868).
 """
 
-from .join import join_tables  # noqa: F401
+from .join import join_tables, join_tables_multi  # noqa: F401
 from .groupby import groupby_aggregate  # noqa: F401
 from .sort import sort_table  # noqa: F401
 from .setops import (equals, set_operation, unique_table)  # noqa: F401

@@ -169,6 +169,24 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
     from ..parallel.collectives import allgather_table
     from .repart import concat_tables, exchange_by_targets, filter_table
 
+    # ---- broadcast join: replicate a SMALL side, shuffle NOTHING --------
+    # (the classic broadcast-hash-join; reference analog: Bcast(Table) +
+    # local join, net/communicator.hpp:51).  Safe only when the small
+    # side's unmatched rows are never emitted (they would emit once per
+    # replica): small-RIGHT for inner/left/semi/anti, small-LEFT for
+    # inner/right.  The big side stays in place, so equal keys are NOT
+    # co-located afterwards — the returned flag suppresses grouped_by and
+    # the deferred fused pushdown exactly like the skew split does.
+    bc = config.BROADCAST_JOIN_ROWS
+    if (how in ("inner", "left", "semi", "anti")
+            and rwork.row_count <= bc
+            and lwork.row_count >= 4 * max(rwork.row_count, 1)):
+        return lwork, allgather_table(rwork), True
+    if (how in ("inner", "right")
+            and lwork.row_count <= bc
+            and rwork.row_count >= 4 * max(lwork.row_count, 1)):
+        return allgather_table(lwork), rwork, True
+
     if how in ("inner", "left", "right", "semi", "anti"):
         # semi/anti behave like 'left' here: output ⊆ left rows, and a
         # replicated heavy build row lets ANY shard detect the match
@@ -513,6 +531,72 @@ def join_tables(left: Table, right: Table, left_on, right_on,
         can_fallback=(not assume_colocated and coalesce_keys
                       and how not in ("semi", "anti")),
         fallback=fallback, label="join")
+
+
+def join_tables_multi(tables: list, ons: list, how: str = "inner",
+                      suffixes=("_x", "_y")) -> Table:
+    """N-way join on ONE shared key set: every table is co-partitioned
+    ONCE (a single hash shuffle each — or a broadcast for small tables),
+    then the chain runs as LOCAL colocated joins.  A naive binary chain
+    re-shuffles the accumulated intermediate at every step; this issues
+    exactly one exchange per input table.  Reference: the multi-table
+    ``JoinTables`` overload, cpp/src/cylon/join/join.hpp:29.
+
+    ``ons[i]``: key column name(s) of ``tables[i]`` (all key sets must be
+    equal length; values are compared pairwise-promoted).  ``how`` applies
+    to every step (inner/left)."""
+    if len(tables) < 2 or len(tables) != len(ons):
+        raise InvalidError("join_tables_multi needs >= 2 tables with one "
+                           "key set each")
+    if how not in ("inner", "left"):
+        raise InvalidError("join_tables_multi supports how in "
+                           "('inner','left') — chain others manually")
+    ons = [[o] if isinstance(o, str) else list(o) for o in ons]
+    if len({len(o) for o in ons}) != 1:
+        raise InvalidError("all key sets must have the same length")
+    env = tables[0].env
+    # promote every table's keys to ONE representation BEFORE the
+    # shuffles: the routing hash depends on the physical dtype (int64
+    # hashes as two u32 lanes, int32 as one) and on string dictionaries
+    # (table-local codes) — unpromoted shuffles would send equal keys to
+    # different shards and the colocated chain would silently drop
+    # matches.  Pairwise promotion converges on cols[0]; a second sweep
+    # brings the middles to the final representation (same pattern as
+    # concat_tables).
+    tables = list(tables)
+    for ki in range(len(ons[0])):
+        cols = [t.column(ons[i][ki]) for i, t in enumerate(tables)]
+        for j in range(1, len(cols)):
+            cols[0], cols[j] = promote_key_pair(cols[0], cols[j])
+        cols = [cols[0]] + [promote_key_pair(cols[0], c)[1]
+                            for c in cols[1:]]
+        tables = [t.with_columns({ons[i][ki]: c})
+                  for i, (t, c) in enumerate(zip(tables, cols))]
+    bc = config.BROADCAST_JOIN_ROWS
+    big = max(t.row_count for t in tables)
+    shuffled = []
+    from ..parallel.collectives import allgather_table
+    for i, (t, on) in enumerate(zip(tables, ons)):
+        if env.world_size == 1:
+            shuffled.append(t)
+        elif (i > 0 and t.row_count <= bc
+                and big >= 4 * max(t.row_count, 1)):
+            # only RIGHT-side tables may replicate: a replicated LEFT
+            # accumulator would emit its matches once per shard
+            shuffled.append(allgather_table(t))
+        else:
+            shuffled.append(shuffle_table(t, on))
+    acc = shuffled[0]
+    acc_on = ons[0]
+    for t, on in zip(shuffled[1:], ons[1:]):
+        acc = join_tables(acc, t, acc_on, on, how=how, suffixes=suffixes,
+                          assume_colocated=True, allow_defer=False)
+        # keys coalesce onto the left names when equal; otherwise the
+        # accumulated left key names survive
+        acc_on = acc_on if all(n in acc.column_names for n in acc_on) \
+            else on
+    acc.grouped_by = None
+    return acc
 
 
 def _join_tables_impl(left: Table, right: Table, left_on, right_on,

@@ -154,9 +154,41 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         "r_regionkey": np.arange(5, dtype=np.int64),
         "r_name": REGIONS,
     })
+    # Q16/Q21/Q22 additions (round 5) draw from a THIRD independent stream
+    # so every earlier table/column stays byte-identical (same regression-
+    # baseline rule as the rng2 block above)
+    rng3 = np.random.default_rng(seed + 7919)
+    ps_partkey = np.repeat(part["p_partkey"].to_numpy(), 4)  # spec: 4/part
+    partsupp = pd.DataFrame({
+        "ps_partkey": ps_partkey.astype(np.int64),
+        "ps_suppkey": rng3.integers(0, n_supp,
+                                    len(ps_partkey)).astype(np.int64),
+        "ps_availqty": rng3.integers(1, 10_000,
+                                     len(ps_partkey)).astype(np.int64),
+    })
+    supplier["s_name"] = np.char.add("Supplier#",
+                                     np.arange(n_supp).astype(np.str_))
+    supplier["s_comment"] = np.where(rng3.random(n_supp) < 0.02,
+                                     "Customer Complaints", "ok")
+    # orderstatus: F when every line shipped by the cutoff, O when none,
+    # else P — derived from the open_line flags per order (spec semantics)
+    open_per_order = np.zeros(n_ord, np.int64)
+    np.add.at(open_per_order, l_orderkey, open_line.astype(np.int64))
+    orders["o_orderstatus"] = np.where(
+        open_per_order == 0, "F",
+        np.where(open_per_order == lines_per_order, "O", "P"))
+    # Q22 uses substring(c_phone,1,2); phones here are generated with the
+    # spec's countrycode+10 prefix AND the prefix is carried as its own
+    # int column (the engine has no device-side substring — documented
+    # simplification, the pandas oracle mirrors it)
+    cntry = customer["c_nationkey"].to_numpy() + 10
+    customer["c_phone"] = np.char.add(
+        np.char.add(cntry.astype(np.str_), "-555-"),
+        np.arange(n_cust).astype(np.str_))
+    customer["c_cntrycode"] = cntry.astype(np.int64)
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
-            "part": part}
+            "part": part, "partsupp": partsupp}
 
 
 def generate_tables(scale: float = 0.01, env=None, seed: int = 0) -> dict:
@@ -636,6 +668,157 @@ def q19_pandas(pdfs: dict, brand1: str = "Brand#12", brand2: str = "Brand#23",
 
 
 # ---------------------------------------------------------------------------
+# Q16 — parts/supplier relationship (ANTI join vs complained suppliers)
+# ---------------------------------------------------------------------------
+
+def q16(dfs: dict, env=None, brand: str = "Brand#45",
+        sizes=(49, 14, 23, 45, 19, 3, 36, 9)):
+    """SELECT p_brand, p_type, p_size, count(DISTINCT ps_suppkey) AS
+    supplier_cnt FROM partsupp, part WHERE p_partkey = ps_partkey AND
+    p_brand <> :brand AND p_type NOT LIKE 'PROMO%' AND p_size IN :sizes
+    AND ps_suppkey NOT IN (SELECT s_suppkey FROM supplier WHERE s_comment
+    LIKE '%Customer%Complaints%') GROUP BY p_brand, p_type, p_size ORDER
+    BY supplier_cnt DESC, p_brand, p_type, p_size.  The NOT IN is an ANTI
+    join; NOT LIKE maps to the generator's type vocabulary."""
+    p = dfs["part"]
+    p = p[(p["p_brand"] != brand)
+          & ~_isin(p["p_type"], list(PROMO_TYPES))
+          & _isin(p["p_size"], list(sizes))]
+    ps = dfs["partsupp"].merge(p, left_on="ps_partkey",
+                               right_on="p_partkey", env=env)
+    s = dfs["supplier"]
+    bad = s[s["s_comment"] == "Customer Complaints"]
+    ps = ps.merge(bad[["s_suppkey"]], how="anti", left_on="ps_suppkey",
+                  right_on="s_suppkey", env=env)
+    g = (ps.groupby(["p_brand", "p_type", "p_size"], env=env)
+         .agg([("ps_suppkey", "nunique")]))
+    g = g.rename({"ps_suppkey_nunique": "supplier_cnt"})
+    return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                         ascending=[False, True, True, True], env=env)
+
+
+def q16_pandas(pdfs: dict, brand: str = "Brand#45",
+               sizes=(49, 14, 23, 45, 19, 3, 36, 9)) -> pd.DataFrame:
+    p = pdfs["part"]
+    p = p[(p.p_brand != brand) & ~p.p_type.isin(list(PROMO_TYPES))
+          & p.p_size.isin(list(sizes))]
+    ps = pdfs["partsupp"].merge(p, left_on="ps_partkey",
+                                right_on="p_partkey")
+    bad = set(pdfs["supplier"][pdfs["supplier"].s_comment ==
+                               "Customer Complaints"].s_suppkey)
+    ps = ps[~ps.ps_suppkey.isin(bad)]
+    g = (ps.groupby(["p_brand", "p_type", "p_size"], as_index=False)
+         .agg(supplier_cnt=("ps_suppkey", "nunique")))
+    return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                         ascending=[False, True, True, True]) \
+        .reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q21 — suppliers who kept orders waiting (SEMI + SEMI-on-condition)
+# ---------------------------------------------------------------------------
+
+def q21(dfs: dict, env=None, nation: str = "SAUDI ARABIA",
+        limit: int = 100):
+    """SELECT s_name, count(*) AS numwait FROM supplier, lineitem l1,
+    orders, nation WHERE s_suppkey = l1.l_suppkey AND o_orderkey =
+    l1.l_orderkey AND o_orderstatus = 'F' AND l1.l_receiptdate >
+    l1.l_commitdate AND EXISTS (l2: same order, other supplier) AND NOT
+    EXISTS (l3: same order, other supplier, late) AND s_nationkey =
+    n_nationkey AND n_name = :nation GROUP BY s_name ORDER BY numwait
+    DESC, s_name LIMIT 100.
+
+    The correlated EXISTS pair decomposes into per-order supplier
+    statistics + SEMI joins: an order qualifies for l1's supplier iff it
+    has >= 2 distinct suppliers overall and EXACTLY ONE distinct late
+    supplier (l1's own)."""
+    l = dfs["lineitem"]
+    late = l[l["l_receiptdate"] > l["l_commitdate"]]
+    o = dfs["orders"]
+    of = o[o["o_orderstatus"] == "F"][["o_orderkey"]]
+    # per-order distinct-supplier counts (all lines / late lines)
+    nsupp = (l.groupby(["l_orderkey"], env=env)
+             .agg([("l_suppkey", "nunique")]))
+    multi = nsupp[nsupp["l_suppkey_nunique"] >= 2][["l_orderkey"]]
+    nlate = (late.groupby(["l_orderkey"], env=env)
+             .agg([("l_suppkey", "nunique")]))
+    onelate = nlate[nlate["l_suppkey_nunique"] == 1][["l_orderkey"]]
+    l1 = late.merge(of, left_on="l_orderkey", right_on="o_orderkey",
+                    env=env)
+    l1 = l1.merge(multi, how="semi", on="l_orderkey", env=env)
+    l1 = l1.merge(onelate, how="semi", on="l_orderkey", env=env)
+    s = dfs["supplier"].merge(dfs["nation"], left_on="s_nationkey",
+                              right_on="n_nationkey", env=env)
+    s = s[s["n_name"] == nation][["s_suppkey", "s_name"]]
+    j = l1.merge(s, left_on="l_suppkey", right_on="s_suppkey", env=env)
+    g = (j.groupby(["s_name"], env=env).agg([("l_orderkey", "count")])
+         .rename({"l_orderkey_count": "numwait"}))
+    return g.sort_values(["numwait", "s_name"],
+                         ascending=[False, True], env=env).head(limit)
+
+
+def q21_pandas(pdfs: dict, nation: str = "SAUDI ARABIA",
+               limit: int = 100) -> pd.DataFrame:
+    l = pdfs["lineitem"]
+    late = l[l.l_receiptdate > l.l_commitdate]
+    of = pdfs["orders"][pdfs["orders"].o_orderstatus == "F"][["o_orderkey"]]
+    nsupp = l.groupby("l_orderkey")["l_suppkey"].nunique()
+    multi = set(nsupp[nsupp >= 2].index)
+    nlate = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    onelate = set(nlate[nlate == 1].index)
+    l1 = late.merge(of, left_on="l_orderkey", right_on="o_orderkey")
+    l1 = l1[l1.l_orderkey.isin(multi) & l1.l_orderkey.isin(onelate)]
+    s = pdfs["supplier"].merge(pdfs["nation"], left_on="s_nationkey",
+                               right_on="n_nationkey")
+    s = s[s.n_name == nation][["s_suppkey", "s_name"]]
+    j = l1.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    g = (j.groupby("s_name", as_index=False)
+         .agg(numwait=("l_orderkey", "count")))
+    return g.sort_values(["numwait", "s_name"],
+                         ascending=[False, True]).head(limit) \
+        .reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q22 — global sales opportunity (ANTI join vs orders)
+# ---------------------------------------------------------------------------
+
+def q22(dfs: dict, env=None, codes=(13, 31, 23, 29, 30, 18, 17)):
+    """SELECT cntrycode, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+    FROM customer WHERE cntrycode IN :codes AND c_acctbal > (SELECT
+    avg(c_acctbal) FROM customer WHERE c_acctbal > 0 AND cntrycode IN
+    :codes) AND NOT EXISTS (SELECT * FROM orders WHERE o_custkey =
+    c_custkey) GROUP BY cntrycode ORDER BY cntrycode.  cntrycode =
+    substring(c_phone,1,2) rides the generator's c_cntrycode int column
+    (no device-side substring); the NOT EXISTS is an ANTI join."""
+    c = dfs["customer"]
+    c = c[_isin(c["c_cntrycode"], list(codes))]
+    pos = c[c["c_acctbal"] > 0.0]
+    avg_bal = float(pos["c_acctbal"].mean())
+    c = c[c["c_acctbal"] > avg_bal]
+    c = c.merge(dfs["orders"][["o_custkey"]], how="anti",
+                left_on="c_custkey", right_on="o_custkey", env=env)
+    g = (c.groupby(["c_cntrycode"], env=env)
+         .agg([("c_custkey", "count"), ("c_acctbal", "sum")])
+         .rename({"c_custkey_count": "numcust",
+                  "c_acctbal_sum": "totacctbal"}))
+    return g.sort_values("c_cntrycode", env=env)
+
+
+def q22_pandas(pdfs: dict,
+               codes=(13, 31, 23, 29, 30, 18, 17)) -> pd.DataFrame:
+    c = pdfs["customer"]
+    c = c[c.c_cntrycode.isin(list(codes))]
+    avg_bal = float(c[c.c_acctbal > 0.0].c_acctbal.mean())
+    c = c[c.c_acctbal > avg_bal]
+    c = c[~c.c_custkey.isin(set(pdfs["orders"].o_custkey))]
+    g = (c.groupby("c_cntrycode", as_index=False)
+         .agg(numcust=("c_custkey", "count"),
+              totacctbal=("c_acctbal", "sum")))
+    return g.sort_values("c_cntrycode").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
 # bench entry (bench.py --tpch)
 # ---------------------------------------------------------------------------
 
@@ -654,6 +837,11 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
                 raise
             scale = scale / 2
             print(f"# TPC-H OOM; retrying at SF{scale:g}", flush=True)
+            # the failed attempt's tables/intermediates sit in REFERENCE
+            # CYCLES (DeferredTable thunks close over their tables): the
+            # retry must not inherit their device buffers
+            import gc
+            gc.collect()
 
 
 def _bench_tpch_once(scale: float, iters: int) -> dict:
@@ -667,6 +855,8 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
     dfs = generate_tables(scale=scale, env=env)
 
     def run_query(fn):
+        import gc
+
         def step():
             out = fn(dfs, env=env)
             if hasattr(out, "to_pandas"):
@@ -678,10 +868,15 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
             t0 = time.perf_counter()
             step()
             ts.append(time.perf_counter() - t0)
+        # drop this query's intermediates (incl. cyclic DeferredTable
+        # state) before the next query allocates — at SF10 the base
+        # tables alone hold ~half of HBM
+        gc.collect()
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q10": q10, "q12": q12, "q14": q14, "q18": q18, "q19": q19}
+               "q10": q10, "q12": q12, "q14": q14, "q16": q16, "q18": q18,
+               "q19": q19, "q21": q21, "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"

@@ -1,0 +1,182 @@
+"""Property-based cross-world fuzz: generator-driven sweep of
+dtype x nulls x skew x world x operator against the pandas oracle.
+
+The example-based suite pins known shapes; the bugs that survived past
+rounds lived in INTERACTIONS (fused string-agg under defer, skewed
+exchange x fallback).  This sweep draws structured-random configs from a
+fixed seed (deterministic in CI) and checks every drawn (tables, op)
+against pandas.  Time-boxed: small row counts in a few pow2 buckets so
+compiled programs are shared across draws.
+
+Reference analog: the randomized table generators the C++ tests lean on
+(util/arrow_rand.hpp + test_utils.hpp random csv-pair runners).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.relational import (groupby_aggregate, join_tables,
+                                  sort_table, unique_table)
+from cylon_tpu.relational.setops import set_operation
+
+SEED = 20260731
+N_DRAWS = 28
+
+KEY_DTYPES = ["int64", "int32", "float64", "str"]
+VAL_DTYPES = ["int64", "float64", "float32"]
+
+
+def _gen_col(rng, n, dtype, nulls: float, skew: float, card: int):
+    if dtype == "str":
+        vals = np.asarray([f"s{v:05d}" for v in rng.integers(0, card, n)],
+                          dtype=object)
+    elif dtype.startswith("float"):
+        vals = rng.integers(0, card, n).astype(dtype)
+    else:
+        vals = rng.integers(0, card, n).astype(dtype)
+    if skew > 0:
+        hot = vals[0]
+        m = rng.random(n) < skew
+        vals = vals.copy()
+        vals[m] = hot
+    if nulls > 0:
+        vals = pd.array(vals).astype(object)
+        mask = rng.random(n) < nulls
+        vals = np.asarray(vals, dtype=object)
+        vals[mask] = None
+        return pd.Series(vals).astype(
+            "object" if dtype == "str" else f"{dtype.capitalize()}"
+            if dtype.startswith("int") else dtype)
+    return pd.Series(vals)
+
+
+def _draw(rng):
+    """One random scenario (sizes in pow2-friendly buckets for program
+    reuse across draws)."""
+    return {
+        "n_l": int(rng.choice([96, 256, 700])),
+        "n_r": int(rng.choice([96, 256, 700])),
+        "key": str(rng.choice(KEY_DTYPES)),
+        "val": str(rng.choice(VAL_DTYPES)),
+        "nulls": float(rng.choice([0.0, 0.0, 0.1])),
+        "skew": float(rng.choice([0.0, 0.0, 0.7])),
+        "card": int(rng.choice([8, 40, 400])),
+        "op": str(rng.choice(["join_inner", "join_left", "join_right",
+                              "join_outer", "join_semi", "join_anti",
+                              "groupby", "sort", "unique", "union",
+                              "subtract"])),
+    }
+
+
+def _tables(rng, cfg, env):
+    lk = _gen_col(rng, cfg["n_l"], cfg["key"], cfg["nulls"], cfg["skew"],
+                  cfg["card"])
+    lv = _gen_col(rng, cfg["n_l"], cfg["val"], 0.0, 0.0, 1000)
+    rk = _gen_col(rng, cfg["n_r"], cfg["key"], 0.0, 0.0, cfg["card"])
+    rv = _gen_col(rng, cfg["n_r"], cfg["val"], 0.0, 0.0, 1000)
+    ldf = pd.DataFrame({"k": lk, "a": lv})
+    rdf = pd.DataFrame({"k": rk, "b": rv})
+    return ldf, rdf, ct.Table.from_pandas(ldf, env), \
+        ct.Table.from_pandas(rdf, env)
+
+
+def _sorted_vals(df, cols):
+    return sorted(map(tuple, df[cols].astype(str).to_numpy()))
+
+
+def _check(cfg, env):
+    rng = np.random.default_rng(cfg.pop("_seed"))
+    ldf, rdf, lt, rt = _tables(rng, cfg, env)
+    op = cfg["op"]
+    if op.startswith("join_"):
+        how = op.split("_")[1]
+        got = join_tables(lt, rt, "k", "k", how=how).to_pandas()
+        if how in ("semi", "anti"):
+            rset = set(rdf["k"].dropna()) | (
+                {None} if rdf["k"].isna().any() else set())
+            m = ldf["k"].map(lambda v: (v in rset) or
+                             (pd.isna(v) and None in rset))
+            exp = ldf[m] if how == "semi" else ldf[~m]
+            assert len(got) == len(exp), cfg
+            assert _sorted_vals(got, ["k"]) == _sorted_vals(exp, ["k"]), cfg
+        else:
+            exp = ldf.merge(rdf, on="k", how=how)
+            assert len(got) == len(exp), cfg
+            assert np.isclose(got["a"].sum(), exp["a"].sum(),
+                              equal_nan=True), cfg
+            assert np.isclose(got["b"].sum(), exp["b"].sum(),
+                              equal_nan=True), cfg
+    elif op == "groupby":
+        got = groupby_aggregate(lt, ["k"], [("a", "sum"), ("a", "count"),
+                                            ("a", "max")]).to_pandas()
+        exp = (ldf.groupby("k", dropna=False, as_index=False)
+               .agg(a_sum=("a", "sum"), a_count=("a", "count"),
+                    a_max=("a", "max")))
+        assert len(got) == len(exp), cfg
+        assert np.isclose(got["a_sum"].sum(), exp["a_sum"].sum()), cfg
+        assert got["a_count"].sum() == exp["a_count"].sum(), cfg
+    elif op == "sort":
+        got = sort_table(lt, "k").to_pandas()
+        exp = ldf.sort_values("k", na_position="last") \
+            .reset_index(drop=True)
+        assert got["k"].astype(str).tolist() == \
+            exp["k"].astype(str).tolist(), cfg
+    elif op == "unique":
+        got = unique_table(lt, ["k"]).to_pandas()
+        assert len(got) == ldf["k"].nunique(dropna=False), cfg
+    elif op == "union":
+        got = set_operation(lt, _align(rt, env), "union").to_pandas()
+        exp = pd.concat([ldf, _align_df(rdf)]).drop_duplicates()
+        assert len(got) == len(exp), cfg
+    elif op == "subtract":
+        got = set_operation(lt, _align(rt, env), "subtract").to_pandas()
+        exp = ldf.drop_duplicates().merge(
+            _align_df(rdf).drop_duplicates(), how="left", indicator=True,
+            on=list(ldf.columns))
+        exp = exp[exp["_merge"] == "left_only"]
+        assert len(got) == len(exp), cfg
+
+
+def _align_df(rdf):
+    out = rdf.rename(columns={"b": "a"})
+    return out[["k", "a"]]
+
+
+def _align(rt, env):
+    from cylon_tpu.frame import DataFrame
+    df = DataFrame(_table=rt)
+    df = df.rename({"b": "a"})
+    return df[["k", "a"]]._table
+
+
+def _run_sweep(env):
+    rng = np.random.default_rng(SEED)
+    failures = []
+    for i in range(N_DRAWS):
+        cfg = _draw(rng)
+        cfg["_seed"] = SEED + 1000 + i
+        # float keys with nulls: NaN-vs-None oracle semantics differ in
+        # pandas merge; keep the sweep on the well-defined space
+        if cfg["key"].startswith("float") and cfg["nulls"] > 0:
+            cfg["nulls"] = 0.0
+        if cfg["key"] == "str" and cfg["op"] == "sort":
+            cfg["nulls"] = 0.0   # exercised in test_hashed_strings
+        try:
+            _check(dict(cfg), env)
+        except AssertionError as e:
+            failures.append((i, cfg, str(e)[:200]))
+    assert not failures, failures
+
+
+def test_fuzz_world4(env4):
+    _run_sweep(env4)
+
+
+def test_fuzz_world8(env8):
+    _run_sweep(env8)
+
+
+def test_fuzz_world1(env1):
+    _run_sweep(env1)

@@ -254,3 +254,129 @@ class TestOuterSkew:
         assert sorted(out["k"].tolist()) == sorted(exp["k"].tolist())
         assert (out["t"].dropna().value_counts().sort_index()
                 .equals(exp["t"].dropna().value_counts().sort_index()))
+
+
+class TestBroadcastJoin:
+    """Small-side broadcast joins (round 5): the small table replicates,
+    the big side never shuffles; reference analog Bcast(Table) + local
+    join (net/communicator.hpp:51)."""
+
+    def _mk(self, env, rng, n_big=3000, n_small=40):
+        big = pd.DataFrame({"k": rng.integers(0, 50, n_big).astype(np.int64),
+                            "a": rng.random(n_big)})
+        small = pd.DataFrame({"k": np.arange(25, 25 + n_small,
+                                             dtype=np.int64) % 60,
+                              "b": rng.random(n_small)})
+        return big, small, ct.Table.from_pandas(big, env), \
+            ct.Table.from_pandas(small, env)
+
+    @pytest.mark.parametrize("how", ["inner", "left", "semi", "anti"])
+    def test_small_right(self, env8, rng, how, monkeypatch):
+        from cylon_tpu import config
+        monkeypatch.setattr(config, "BROADCAST_JOIN_ROWS", 1000)
+        big, small, bt, st = self._mk(env8, rng)
+        out = join_tables(bt, st, "k", "k", how=how)
+        if how in ("inner", "left"):
+            assert out.grouped_by is None   # big side never co-located
+            exp = big.merge(small, on="k", how=how)
+            got = out.to_pandas()
+            assert len(got) == len(exp)
+            assert np.isclose(got["a"].sum(), exp["a"].sum())
+            assert sorted(got["k"]) == sorted(exp["k"])
+        else:
+            m = big["k"].isin(set(small["k"]))
+            exp = big[m] if how == "semi" else big[~m]
+            assert sorted(out.to_pandas()["k"]) == sorted(exp["k"])
+
+    def test_small_left_right_join(self, env8, rng, monkeypatch):
+        from cylon_tpu import config
+        monkeypatch.setattr(config, "BROADCAST_JOIN_ROWS", 1000)
+        big, small, bt, st = self._mk(env8, rng)
+        out = join_tables(st, bt, "k", "k", how="right").to_pandas()
+        exp = small.merge(big, on="k", how="right")
+        assert len(out) == len(exp)
+        assert np.isclose(out["a"].sum(), exp["a"].sum())
+
+    def test_no_shuffle_issued(self, env8, rng, monkeypatch):
+        from cylon_tpu import config
+        from cylon_tpu.relational import join as jmod
+        monkeypatch.setattr(config, "BROADCAST_JOIN_ROWS", 1000)
+        calls = []
+        orig = jmod.shuffle_table
+        monkeypatch.setattr(jmod, "shuffle_table",
+                            lambda *a, **k: (calls.append(1) or
+                                             orig(*a, **k)))
+        big, small, bt, st = self._mk(env8, rng)
+        join_tables(bt, st, "k", "k", how="inner").to_pandas()
+        assert calls == []   # broadcast replaced both shuffles
+
+
+class TestJoinTablesMulti:
+    """Same-key N-way join: ONE co-partition per table (C17 parity,
+    reference join.hpp:29 multi-table overload)."""
+
+    def test_three_way_matches_pandas(self, env4, rng):
+        n = 1500
+        a = pd.DataFrame({"k": rng.integers(0, 80, n).astype(np.int64),
+                          "a": rng.random(n)})
+        b = pd.DataFrame({"k": rng.integers(0, 80, n).astype(np.int64),
+                          "b": rng.random(n)})
+        c = pd.DataFrame({"k": rng.integers(0, 80, 200).astype(np.int64),
+                          "c": rng.random(200)})
+        from cylon_tpu.relational import join_tables_multi
+        out = join_tables_multi(
+            [ct.Table.from_pandas(x, env4) for x in (a, b, c)],
+            ["k", "k", "k"]).to_pandas()
+        exp = a.merge(b, on="k").merge(c, on="k")
+        assert len(out) == len(exp)
+        for col in ("a", "b", "c"):
+            assert np.isclose(out[col].sum(), exp[col].sum())
+
+    def test_one_shuffle_per_table(self, env4, rng, monkeypatch):
+        from cylon_tpu.relational import join as jmod
+        from cylon_tpu.relational import join_tables_multi
+        calls = []
+        orig = jmod.shuffle_table
+        monkeypatch.setattr(jmod, "shuffle_table",
+                            lambda *a, **k: (calls.append(1) or
+                                             orig(*a, **k)))
+        n = 1200
+        ts = [ct.Table.from_pandas(
+            pd.DataFrame({"k": rng.integers(0, 60, n).astype(np.int64),
+                          f"v{i}": rng.random(n)}), env4)
+            for i in range(4)]
+        out = join_tables_multi(ts, ["k"] * 4).to_pandas()
+        assert len(calls) == 4   # one exchange per table, none repeated
+        assert len(out) > 0
+
+    def test_mixed_dtype_keys_promote_before_shuffle(self, env4, rng):
+        # int64 vs int32 keys hash differently unpromoted; the N-way path
+        # must promote BEFORE its one-shuffle-per-table co-partition
+        from cylon_tpu.relational import join_tables_multi
+        a = pd.DataFrame({"k": rng.integers(0, 50, 900).astype(np.int64),
+                          "a": rng.random(900)})
+        b = pd.DataFrame({"k": rng.integers(0, 50, 900).astype(np.int32),
+                          "b": rng.random(900)})
+        c = pd.DataFrame({"k": rng.integers(0, 50, 300).astype(np.int64),
+                          "c": rng.random(300)})
+        out = join_tables_multi(
+            [ct.Table.from_pandas(x, env4) for x in (a, b, c)],
+            ["k", "k", "k"]).to_pandas()
+        exp = a.merge(b.assign(k=b["k"].astype(np.int64)), on="k") \
+            .merge(c, on="k")
+        assert len(out) == len(exp)
+        for col in ("a", "b", "c"):
+            assert np.isclose(out[col].sum(), exp[col].sum())
+
+    def test_string_keys_multi(self, env4, rng):
+        from cylon_tpu.relational import join_tables_multi
+        mk = lambda n, lo, hi: pd.DataFrame(
+            {"k": np.asarray([f"u{v}" for v in rng.integers(lo, hi, n)],
+                             object),
+             f"v{lo}": rng.random(n)})
+        a, b, c = mk(800, 0, 40), mk(800, 20, 60), mk(200, 0, 60)
+        out = join_tables_multi(
+            [ct.Table.from_pandas(x, env4) for x in (a, b, c)],
+            ["k", "k", "k"]).to_pandas()
+        exp = a.merge(b, on="k").merge(c, on="k")
+        assert len(out) == len(exp)

@@ -129,3 +129,29 @@ def test_q19_matches_pandas(env):
     exp = tpch.q19_pandas(pdfs)
     assert exp != 0.0
     assert got == pytest.approx(exp, rel=1e-9)
+
+
+@pytest.mark.parametrize("qname", ["q16", "q21", "q22"])
+def test_round5_queries_match_pandas(env, qname):
+    """Q16/Q21/Q22 — the semi/anti-join query family (round 5)."""
+    pdfs = tpch.generate_pandas(scale=0.004, seed=16)
+    dfs = {k: __import__("cylon_tpu").DataFrame(v, env=env)
+           for k, v in pdfs.items()}
+    got = getattr(tpch, qname)(dfs, env=env).to_pandas() \
+        .reset_index(drop=True)
+    exp = getattr(tpch, f"{qname}_pandas")(pdfs)
+    assert len(got) == len(exp)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_round5_generator_additions():
+    pdfs = tpch.generate_pandas(scale=0.01, seed=0)
+    assert len(pdfs["partsupp"]) == 4 * len(pdfs["part"])
+    assert set(pdfs["orders"].o_orderstatus) <= {"F", "O", "P"}
+    s = pdfs["supplier"]
+    assert {"s_name", "s_comment"} <= set(s.columns)
+    c = pdfs["customer"]
+    assert (c.c_cntrycode == c.c_nationkey + 10).all()
+    assert (c.c_phone.str.split("-").str[0].astype(int)
+            == c.c_nationkey + 10).all()
