@@ -112,16 +112,22 @@ STRING_HASH_RATIO = float(os.environ.get("CYLON_TPU_STRING_HASH_RATIO",
 #: eviction drops the jit wrapper (and its executables); re-use recompiles.
 PROGRAM_CACHE_SIZE = int(os.environ.get("CYLON_TPU_PROGRAM_CACHE", "256"))
 
-#: Heavy-key (skew) split tuning — reference analog: the sampled partition
-#: machinery of table.cpp:620-689 applied to skew (SURVEY.md §7 hard-part
-#: 4).  Detection runs on the ROW HASH of the (possibly multi-column) key
-#: tuple, so float keys and multi-column keys participate uniformly and
-#: the flag predicate is exactly the shuffle-routing hash.
-#: a join side at or below this row count is REPLICATED (allgather)
-#: instead of shuffling both sides — the broadcast-hash-join cutover
+#: Per-shard exchange RECEIVE allocation ceiling (bytes): a predicted
+#: receive above this raises an OOM-shaped error BEFORE allocating so the
+#: streaming-pipeline fallback engages without a doomed multi-GB alloc.
+EXCHANGE_RECV_BUDGET_BYTES = int(os.environ.get(
+    "CYLON_TPU_EXCHANGE_RECV_BUDGET", str(6 * 1024**3)))
+
+#: A join side at or below this row count is REPLICATED (allgather)
+#: instead of shuffling both sides — the broadcast-hash-join cutover.
 BROADCAST_JOIN_ROWS = int(os.environ.get("CYLON_TPU_BROADCAST_JOIN_ROWS",
                                          "65536"))
 
+# Heavy-key (skew) split tuning — reference analog: the sampled partition
+# machinery of table.cpp:620-689 applied to skew (SURVEY.md §7 hard-part
+# 4).  Detection runs on the ROW HASH of the (possibly multi-column) key
+# tuple, so float keys and multi-column keys participate uniformly and
+# the flag predicate is exactly the shuffle-routing hash.
 #: Rows sampled per shard for the heavy-hitter estimate:
 SKEW_SAMPLE = int(os.environ.get("CYLON_TPU_SKEW_SAMPLE", "4096"))
 #: Minimum per-shard sampled share for a key to enter the estimate:

@@ -249,7 +249,8 @@ def exchange_block_cap(total: int, w: int) -> int:
     return config.pow2ceil(max(2 * uniform, 8192))
 
 
-def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple):
+def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
+             guard: bool = False):
     """Run the (possibly multi-round) padded all-to-all for every array in
     ``cols`` (payload-agnostic: callers pre-pack laneable columns into one
     (cap, L) u32 lane matrix — relational/repart._flatten_for_exchange —
@@ -270,6 +271,29 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple):
     rounds = -(-max_c // block) if max_c else 1
     per_dest = counts.sum(axis=0)
     out_cap = config.pow2ceil(int(per_dest.max()) if per_dest.size else 1)
+
+    # receive-side memory guard (``guard=True`` — callers under a
+    # run_with_oom_fallback wrapper ONLY, i.e. hash shuffles for
+    # join/groupby/setops): the multi-round protocol bounds SEND buffers,
+    # but the receiving shard still materializes every row routed to it
+    # (out_cap is per-DEST).  A catastrophic route (skew the heavy-key
+    # split didn't model, e.g. hash clustering) is known from the COUNT
+    # SIDECAR before any allocation — raise an OOM-shaped error here so
+    # the fallback reroutes to the streaming pipeline without first
+    # corrupting the allocator with a doomed multi-GB alloc (which this
+    # rig never recovers from).  Sort/repartition exchanges have no
+    # streaming reroute and stay unguarded — their failure mode is the
+    # allocator's own error.
+    row_bytes = sum(int(np.dtype(c.dtype).itemsize)
+                    * int(np.prod(c.shape[1:], dtype=np.int64))
+                    for c in cols)
+    if guard and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES:
+        raise MemoryError(
+            f"RESOURCE_EXHAUSTED (predicted): exchange receive allocation "
+            f"{out_cap} rows x {row_bytes} B/row exceeds "
+            f"CYLON_TPU_EXCHANGE_RECV_BUDGET "
+            f"({config.EXCHANGE_RECV_BUDGET_BYTES} B); one destination "
+            "shard would materialize the bulk of the table")
 
     counts_i = np.asarray(counts, np.int32)
     tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)

@@ -135,7 +135,10 @@ def shuffle_table(table: Table, key_names) -> Table:
     tgt = shuffle.hash_targets(env.mesh, datas, valids, table.valid_counts)
     counts = shuffle.count_targets(env.mesh, tgt)
     flat, recipe = _flatten_for_exchange(table)
-    new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat)
+    # hash shuffles run under join/groupby/setops OOM fallbacks: the
+    # receive-budget guard may preempt a doomed allocation
+    new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat,
+                                           guard=True)
     return _rebuild(recipe, new_flat, new_valid, env)
 
 

@@ -1,21 +1,22 @@
-"""TPC-H subset: data generator + a 10-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q10 Q12 Q14 Q18 Q19).
+"""TPC-H subset: data generator + a 13-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q10 Q12 Q14 Q16 Q18 Q19 Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
 SF10 Q3/Q5 on 8 ranks).  This module provides:
 
-* :func:`generate_tables` — a numpy dbgen-alike for the seven tables the
+* :func:`generate_tables` — a numpy dbgen-alike for the eight tables the
   suite touches (customer, orders, lineitem, supplier, nation, region,
-  part) with the standard cardinalities (150K/1.5M/~6M/10K/25/5/200K rows
-  x SF) and the value distributions the queries are sensitive to
+  part, partsupp) with the standard cardinalities
+  (150K/1.5M/~6M/10K/25/5/200K/800K rows x SF) and the value distributions the queries are sensitive to
   (mktsegment 5-way uniform, order dates uniform over 1992-1998, discount
   0-0.10, one region in 5, closed p_type/brand/container vocabularies);
 * ``q1``..``q19`` — the queries written against the public DataFrame API
   (filter -> merge -> arithmetic -> groupby -> sort -> head), exactly how
   a user would port them — together they cover join+conditional-agg
-  (Q14), groupby-HAVING semi-join (Q18) and disjunctive multi-attribute
-  filters (Q19) beyond the round-3 seven;
+  (Q14), groupby-HAVING semi-join (Q18), disjunctive multi-attribute
+  filters (Q19) and — round 5 — the NOT-EXISTS family on true SEMI/ANTI
+  joins (Q16 Q21 Q22);
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -823,11 +824,13 @@ def q22_pandas(pdfs: dict,
 # ---------------------------------------------------------------------------
 
 def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
-    """Runs the 7-query suite at ``scale``; on device OOM the scale halves
+    """Runs the 13-query suite at ``scale``; on device OOM the scale halves
     (the whole-working-set analog of bench.py's rows halving: TPC-H keeps
     every base table plus query intermediates resident, so past the HBM
     ceiling no operator-level chunking can save a single chip — the
     deploy story for SF10+ is a pod slice, deploy/README.md)."""
+    import jax
+
     from cylon_tpu.relational.common import is_oom
     while True:
         try:
@@ -835,6 +838,18 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
         except Exception as e:  # noqa: BLE001
             if not is_oom(e) or scale <= 0.02:
                 raise
+            if jax.devices()[0].platform != "cpu":
+                # measured (round 5): a device OOM on the axon TPU rig
+                # POISONS the process — the leaked HBM never returns and
+                # every later allocation fails, so in-process retries are
+                # doomed.  Surface the real remedy instead of burning
+                # minutes per shrinking attempt.
+                raise RuntimeError(
+                    f"TPC-H SF{scale:g} exceeded device memory and "
+                    "this rig does not recover HBM after an OOM in the "
+                    "same process; rerun at a smaller --scale in a FRESH "
+                    "process, or use scripts/bench_tpch_q3q5.py "
+                    "(column-projected ingest) for large scales") from e
             scale = scale / 2
             print(f"# TPC-H OOM; retrying at SF{scale:g}", flush=True)
             # the failed attempt's tables/intermediates sit in REFERENCE

@@ -1,0 +1,77 @@
+"""Star-schema join plan: broadcast joins for dimensions, semi/anti
+filters, and the same-key N-way join (round 5).
+
+A fact table joins several small dimensions: each dimension at or below
+``config.BROADCAST_JOIN_ROWS`` replicates via AllGather and the fact
+table NEVER shuffles (the broadcast-hash-join; reference analog
+Bcast(Table) + local join).  Same-key chains co-partition once through
+``join_tables_multi`` (reference join.hpp:29 multi-table overload).
+
+Run on a simulated 8-device CPU mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/star_schema_join.py
+"""
+
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+import jax
+import cylon_tpu as ct
+from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+from cylon_tpu.relational import join_tables, join_tables_multi
+
+
+def main():
+    on_accel = jax.devices()[0].platform != "cpu"
+    env = ct.CylonEnv(config=TPUConfig() if on_accel else CPUMeshConfig())
+    rng = np.random.default_rng(7)
+
+    n = 200_000
+    fact = pd.DataFrame({
+        "store_id": rng.integers(0, 200, n).astype(np.int64),
+        "product_id": rng.integers(0, 1000, n).astype(np.int64),
+        "units": rng.integers(1, 20, n).astype(np.int64),
+    })
+    stores = pd.DataFrame({
+        "store_id": np.arange(200, dtype=np.int64),
+        "region": np.asarray([f"R{i % 5}" for i in range(200)], object),
+    })
+    recalled = pd.DataFrame({
+        "product_id": rng.choice(1000, 30, replace=False).astype(np.int64)})
+
+    ft = ct.Table.from_pandas(fact, env)
+    st = ct.Table.from_pandas(stores, env)
+    rt = ct.Table.from_pandas(recalled, env)
+
+    # dimension join: stores (200 rows) broadcasts, the 500K fact rows
+    # stay in place — zero shuffles
+    enriched = join_tables(ft, st, "store_id", "store_id", how="inner")
+    # NOT EXISTS recall: anti join against the recalled product keys
+    clean = join_tables(enriched, rt, "product_id", "product_id",
+                        how="anti")
+    got = clean.to_pandas()
+    exp = fact.merge(stores, on="store_id")
+    exp = exp[~exp["product_id"].isin(set(recalled["product_id"]))]
+    assert len(got) == len(exp)
+    print(f"broadcast dim join + anti recall filter: {len(got)} rows "
+          f"(dropped {len(fact) - len(got)})")
+
+    # same-key chain: three monthly per-store summaries co-partition
+    # ONCE each (one row per store per month — the chain stays 1:1)
+    slices = [ct.Table.from_pandas(pd.DataFrame({
+        "store_id": np.sort(rng.choice(200, 180,
+                                       replace=False)).astype(np.int64),
+        f"month{i}_units": rng.integers(0, 5000, 180).astype(np.int64)}),
+        env) for i in range(3)]
+    chained = join_tables_multi(slices, ["store_id"] * 3)
+    print(f"3-way same-key chain: {chained.row_count} stores with all "
+          f"three months, one exchange per table")
+
+
+if __name__ == "__main__":
+    main()

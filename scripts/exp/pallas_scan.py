@@ -1,0 +1,173 @@
+"""EXPERIMENT (not wired into the package): Pallas streaming multi-scan.
+
+Verdict: measured 1.7x over XLA's co-resident scans (100 ms vs 176 ms
+for 4 forward scans at 67M i32; 96 vs 148 ms for the reverse pair) —
+XLA already amortizes co-resident scans well (~35 ms/scan), and the
+block-scan's shifted-combine relayouts dominate the Pallas version, so
+per-scan costs land within ~1.5x of each other.  Not enough to clear
+the integration risk on the fused hot path; kept here with the working
+grid/SMEM-carry/reverse-scan patterns (mirrored shift directions — no
+Mosaic `rev`).  Run scripts/exp/pallas_scan_bench.py for the numbers.
+
+The fused kernel (relational/fused.py) derives its per-position group
+geometry from seven full-length scans (cumsum / cummax forward, cummin
+reverse).  XLA:TPU runs them at ~0.5 ns/element even co-resident
+(measured: 248 ms for the 7-scan block at 67M positions) — each lowers
+to its own multi-pass loop.  A sequential-grid Pallas kernel streams the
+arrays ONCE: per block, lane scans are log2(128) shifted combines on the
+VPU, sublane offsets a tiny axis-0 scan, and the running carry lives in
+SMEM across grid steps (TPU grids are sequential).  All forward scans of
+the algebra ride ONE pass; the reverse pair rides a second pass with a
+REVERSED grid and in-block flips.
+
+Cost: ~2 passes of memory traffic over the operand set vs one XLA loop
+per scan — ~5-10x on the boundary block.
+
+Reference slot: this feeds the same geometry the C++ sort-join derives
+with per-row comparator loops (sort_join.cpp:66 ``advance()``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: block shape (rows, lanes): 64 KiB of i32 per array per step
+BLOCK_R, LANES = 128, 128
+_IMAX = np.int32(2**31 - 1)
+_IMIN = np.int32(-(2**31 - 1) - 1)
+
+_IDENT = {"sum": np.int32(0), "max": _IMIN, "min": _IMAX}
+_COMBINE = {"sum": jnp.add, "max": jnp.maximum, "min": jnp.minimum}
+
+
+def _block_scan(x, kind: str):
+    """Inclusive scan of an (R, LANES) block in LINEAR (row-major) order.
+    Returns (scanned block, block total scalar)."""
+    comb = _COMBINE[kind]
+    ident = _IDENT[kind]
+    R = x.shape[0]
+    # lane scan within rows: log2(LANES) shifted combines
+    k = 1
+    while k < LANES:
+        shifted = jnp.concatenate(
+            [jnp.full((R, k), ident, x.dtype), x[:, :-k]], axis=1)
+        x = comb(x, shifted)
+        k *= 2
+    # row offsets: exclusive scan of row totals down the sublanes.
+    # Full-width blocks throughout — narrow (R,1) vectors trip Mosaic's
+    # offset-layout concatenate.
+    tot = jnp.broadcast_to(x[:, LANES - 1:LANES], (R, LANES))
+    off = jnp.concatenate([jnp.full((1, LANES), ident, x.dtype),
+                           tot[:-1]], axis=0)
+    k = 1
+    while k < R:
+        shifted = jnp.concatenate(
+            [jnp.full((k, LANES), ident, x.dtype), off[:-k]], axis=0)
+        off = comb(off, shifted)
+        k *= 2
+    x = comb(x, off)
+    return x, x[R - 1, LANES - 1]
+
+
+def _block_scan_rev(x, kind: str):
+    """Reverse (back-to-front) inclusive scan of an (R, LANES) block in
+    linear order — MIRRORED shift directions instead of flips (Mosaic has
+    no `rev` lowering): lanes pull from the right, row offsets propagate
+    upward from the bottom rows."""
+    comb = _COMBINE[kind]
+    ident = _IDENT[kind]
+    R = x.shape[0]
+    k = 1
+    while k < LANES:
+        shifted = jnp.concatenate(
+            [x[:, k:], jnp.full((R, k), ident, x.dtype)], axis=1)
+        x = comb(x, shifted)
+        k *= 2
+    tot = jnp.broadcast_to(x[:, 0:1], (R, LANES))   # reverse row totals
+    off = jnp.concatenate([tot[1:],
+                           jnp.full((1, LANES), ident, x.dtype)], axis=0)
+    k = 1
+    while k < R:
+        shifted = jnp.concatenate(
+            [off[k:], jnp.full((k, LANES), ident, x.dtype)], axis=0)
+        off = comb(off, shifted)
+        k *= 2
+    x = comb(x, off)
+    return x, x[0, 0]
+
+
+def _kernel(*refs, kinds: tuple, reverse: bool):
+    n = len(kinds)
+    in_refs = refs[:n]
+    out_refs = refs[n:2 * n]
+    carry = refs[2 * n]                              # SMEM (n,)
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _():
+        for i, kind in enumerate(kinds):
+            carry[i] = _IDENT[kind]
+
+    scan = _block_scan_rev if reverse else _block_scan
+    for i, kind in enumerate(kinds):
+        y, tot = scan(in_refs[i][...], kind)
+        y = _COMBINE[kind](y, carry[i])
+        out_refs[i][...] = y
+        carry[i] = _COMBINE[kind](carry[i], tot)
+
+
+def multi_scan(arrays, kinds, reverse: bool = False,
+               interpret: bool | None = None):
+    """Inclusive scans of equal-length 1-D int32 arrays in ONE streaming
+    pass.  ``kinds[i]`` in {'sum','max','min'}; ``reverse=True`` scans
+    back-to-front (the grid walks blocks in reverse and blocks flip
+    in-VMEM — no XLA flip passes).  Returns a tuple of scanned arrays."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n0 = arrays[0].shape[0]
+    blk = BLOCK_R * LANES
+    npad = -(-n0 // blk) * blk
+    G = npad // blk
+    kinds = tuple(kinds)
+    ins = []
+    for a, kind in zip(arrays, kinds):
+        a = a.astype(jnp.int32)
+        if npad != n0:
+            a = jnp.concatenate(
+                [a, jnp.full(npad - n0, _IDENT[kind], jnp.int32)])
+        ins.append(a.reshape(G * BLOCK_R, LANES))
+
+    if reverse:
+        def imap(j):
+            return (G - 1 - j, jnp.int32(0))
+    else:
+        def imap(j):
+            return (j, jnp.int32(0))
+
+    spec = pl.BlockSpec((BLOCK_R, LANES), imap)
+    # under shard_map (check_vma) outputs must declare their mesh axes
+    vma = frozenset()
+    for a in ins:
+        vma = vma | getattr(a.aval, "vma", frozenset())
+    outs = pl.pallas_call(
+        partial(_kernel, kinds=kinds, reverse=reverse),
+        grid=(G,),
+        in_specs=[spec] * len(ins),
+        out_specs=[spec] * len(ins),
+        out_shape=[jax.ShapeDtypeStruct((G * BLOCK_R, LANES), jnp.int32,
+                                        vma=vma)
+                   for _ in ins],
+        scratch_shapes=[pltpu.SMEM((len(ins),), jnp.int32)],
+        interpret=interpret,
+    )(*ins)
+    res = []
+    for o in outs:
+        o = o.reshape(npad)
+        res.append(o[:n0] if npad != n0 else o)
+    return tuple(res)

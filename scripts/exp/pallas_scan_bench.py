@@ -1,0 +1,28 @@
+"""Bench for the pallas_scan experiment (see its docstring verdict)."""
+import sys, time, os
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+import cylon_tpu
+import pallas_scan as ps
+
+_pull = jax.jit(lambda x: x.reshape(-1)[:2].astype(jnp.float32).sum())
+def sync(out): np.asarray(_pull(jax.tree.leaves(out)[0]))
+def timed(label, fn, *args):
+    f = jax.jit(fn); sync(f(*args)); best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter(); sync(f(*args)); best = min(best, time.perf_counter()-t0)
+    print(f"{label:44s} {best*1e3:8.1f} ms")
+
+N = 67_108_864
+rng = np.random.default_rng(0)
+arrs = [jnp.asarray(rng.integers(0, 3, N, dtype=np.int32)) for _ in range(4)]
+timed("pallas 4 fwd (sum,sum,max,max)",
+      lambda *xs: ps.multi_scan(list(xs), ["sum", "sum", "max", "max"]), *arrs)
+timed("pallas 2 rev (min,min)",
+      lambda *xs: ps.multi_scan(list(xs), ["min", "min"], reverse=True),
+      *arrs[:2])
+timed("XLA 4 fwd", lambda a, b, c, d: (jnp.cumsum(a), jnp.cumsum(b),
+      jax.lax.cummax(c), jax.lax.cummax(d)), *arrs)
+timed("XLA 2 rev", lambda a, b: (jax.lax.cummin(a, reverse=True),
+      jax.lax.cummin(b, reverse=True)), *arrs[:2])
