@@ -112,11 +112,17 @@ STRING_HASH_RATIO = float(os.environ.get("CYLON_TPU_STRING_HASH_RATIO",
 #: eviction drops the jit wrapper (and its executables); re-use recompiles.
 PROGRAM_CACHE_SIZE = int(os.environ.get("CYLON_TPU_PROGRAM_CACHE", "256"))
 
-#: Per-shard exchange RECEIVE allocation ceiling (bytes): a predicted
-#: receive above this raises an OOM-shaped error BEFORE allocating so the
-#: streaming-pipeline fallback engages without a doomed multi-GB alloc.
+#: Per-shard exchange RECEIVE allocation ceiling (bytes, accelerators
+#: only): a predicted receive above this fails fast with an OOM-shaped
+#: error BEFORE allocating — a real device OOM poisons this rig's
+#: process, so preempting a doomed alloc is the only clean failure.  The
+#: default leaves headroom under a 16 GB HBM for inputs + exchange
+#: staging; the remedy for receive concentration is the heavy-key split.
 EXCHANGE_RECV_BUDGET_BYTES = int(os.environ.get(
-    "CYLON_TPU_EXCHANGE_RECV_BUDGET", str(6 * 1024**3)))
+    "CYLON_TPU_EXCHANGE_RECV_BUDGET", str(12 * 1024**3)))
+#: apply the receive guard on CPU meshes too (tests; host RAM is
+#: normally far above HBM-sized budgets, so default off)
+EXCHANGE_RECV_GUARD_CPU = _env_flag("CYLON_TPU_EXCHANGE_GUARD_CPU", False)
 
 #: A join side at or below this row count is REPLICATED (allgather)
 #: instead of shuffling both sides — the broadcast-hash-join cutover.

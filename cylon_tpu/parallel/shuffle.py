@@ -272,22 +272,28 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     per_dest = counts.sum(axis=0)
     out_cap = config.pow2ceil(int(per_dest.max()) if per_dest.size else 1)
 
-    # receive-side memory guard (``guard=True`` — callers under a
-    # run_with_oom_fallback wrapper ONLY, i.e. hash shuffles for
-    # join/groupby/setops): the multi-round protocol bounds SEND buffers,
-    # but the receiving shard still materializes every row routed to it
-    # (out_cap is per-DEST).  A catastrophic route (skew the heavy-key
-    # split didn't model, e.g. hash clustering) is known from the COUNT
-    # SIDECAR before any allocation — raise an OOM-shaped error here so
-    # the fallback reroutes to the streaming pipeline without first
-    # corrupting the allocator with a doomed multi-GB alloc (which this
-    # rig never recovers from).  Sort/repartition exchanges have no
-    # streaming reroute and stay unguarded — their failure mode is the
-    # allocator's own error.
+    # Receive-side memory guard (accelerators only; ``guard=True`` from
+    # hash-shuffle callers): the multi-round protocol bounds SEND
+    # buffers, but the receiving shard still materializes every row
+    # routed to it (out_cap is per-DEST).  A catastrophic route (skew
+    # the heavy-key split didn't model, e.g. hash clustering) is known
+    # from the COUNT SIDECAR before any allocation — raising an
+    # OOM-shaped error here FAILS FAST AND CLEAN instead of submitting a
+    # doomed multi-GB alloc, which this rig never recovers from (a real
+    # device OOM poisons the process, docs/DESIGN.md).  Receive
+    # concentration is not curable downstream — the streaming pipeline
+    # shuffles the same full tables — so the REMEDY is the heavy-key
+    # split (on by default); this guard is the backstop for routes the
+    # split didn't model.  CPU meshes skip it (host RAM is typically far
+    # above any HBM-sized budget); sort/repartition exchanges are
+    # unguarded likewise.
+    on_accel = mesh.devices.flat[0].platform != "cpu" \
+        or config.EXCHANGE_RECV_GUARD_CPU
     row_bytes = sum(int(np.dtype(c.dtype).itemsize)
                     * int(np.prod(c.shape[1:], dtype=np.int64))
                     for c in cols)
-    if guard and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES:
+    if (guard and on_accel
+            and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES):
         raise MemoryError(
             f"RESOURCE_EXHAUSTED (predicted): exchange receive allocation "
             f"{out_cap} rows x {row_bytes} B/row exceeds "

@@ -48,7 +48,7 @@ def main():
     st = ct.Table.from_pandas(stores, env)
     rt = ct.Table.from_pandas(recalled, env)
 
-    # dimension join: stores (200 rows) broadcasts, the 500K fact rows
+    # dimension join: stores (200 rows) broadcasts, the 200K fact rows
     # stay in place — zero shuffles
     enriched = join_tables(ft, st, "store_id", "store_id", how="inner")
     # NOT EXISTS recall: anti join against the recalled product keys

@@ -222,8 +222,10 @@ class TestReceiveBudgetGuard:
         from cylon_tpu import config
         from cylon_tpu.relational.common import is_oom
         from cylon_tpu.relational.repart import shuffle_table
-        # tiny budget so a normal-sized skewed shuffle trips it
+        # tiny budget so a normal-sized skewed shuffle trips it (the
+        # guard skips CPU meshes unless forced)
         monkeypatch.setattr(config, "EXCHANGE_RECV_BUDGET_BYTES", 4096)
+        monkeypatch.setattr(config, "EXCHANGE_RECV_GUARD_CPU", True)
         n = 4000
         k = np.full(n, 7, np.int64)            # every row -> one shard
         t = ct.Table.from_pandas(
@@ -252,6 +254,7 @@ class TestReceiveBudgetGuard:
         # balanced receive ≈ n/8 rows x ~3 u32 lanes; one-shard ≈ 0.9n
         monkeypatch.setattr(config, "EXCHANGE_RECV_BUDGET_BYTES",
                             4 * (n // 8) * 40)
+        monkeypatch.setattr(config, "EXCHANGE_RECV_GUARD_CPU", True)
         from cylon_tpu.relational import join_tables
         out = join_tables(lt, rt, "k", "k", how="inner").to_pandas()
         exp = ldf.merge(rdf, on="k")
