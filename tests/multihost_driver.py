@@ -60,6 +60,13 @@ exp = (ldf.merge(rdf, on="k", how="inner")
 got = s.to_pandas().reset_index(drop=True)
 pd.testing.assert_frame_equal(got, exp, check_dtype=False, check_exact=False)
 
+# round-5 surface: SEMI/ANTI joins across processes
+semi = join_tables(lt, rt, "k", "k", how="semi")
+anti = join_tables(lt, rt, "k", "k", how="anti")
+m = ldf["k"].isin(set(rdf["k"]))
+assert semi.row_count == int(m.sum()), (semi.row_count, int(m.sum()))
+assert anti.row_count == int((~m).sum())
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)
