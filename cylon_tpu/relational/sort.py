@@ -266,16 +266,19 @@ def _expand_hashed_string_keys(table: Table, by: list, ascending):
             row_lanes = lanes[idx]
         else:
             row_lanes = np.zeros((len(cu), n_lanes), np.uint32)
-        sharding = env.sharding()
+        # ONE device upload for all of this key's lanes (the tunnel
+        # charges ~100 ms latency per buffer), sliced into columns
+        # device-side
+        mat = (row_lanes ^ np.uint32(0x80000000)).view(np.int32)
+        placed = _put(np.ascontiguousarray(mat), env.sharding())
         for li in range(n_lanes):
-            lane = (row_lanes[:, li] ^ np.uint32(0x80000000)) \
-                .view(np.int32).copy()
+            lane_host = mat[:, li]
             name = f"__strord_{n}_{li}"
             while name in table:
                 name += "_"
-            bounds = ((int(lane.min()), int(lane.max())) if lane.size
-                      else None)
-            add_cols[name] = Column(_put(lane, sharding), LogicalType.INT32,
+            bounds = ((int(lane_host.min()), int(lane_host.max()))
+                      if lane_host.size else None)
+            add_cols[name] = Column(placed[:, li], LogicalType.INT32,
                                     c.validity, bounds=bounds)
             new_by.append(name)
             new_asc.append(not desc)
