@@ -1,0 +1,453 @@
+"""Pass 1 — whole-package AST lint for trace-safety hazards.
+
+Static source analysis, no jax import required.  The pass identifies the
+*traced region* of each module — functions handed to ``shard_map`` or
+``jax.jit`` (directly, via decorator, or transitively called from such a
+function within the same module) — and flags:
+
+* **TS101** host-sync calls inside the traced region (``np.asarray``,
+  ``np.array``, ``jax.device_get``, ``host_array``/``host_arrays``/
+  ``sync_pull``, ``.item()``/``.tolist()``, and ``float()``/``int()``/
+  ``bool()`` on tracer-derived values): each forces a device→host pull
+  per *call* once the surrounding trace escapes to eager, or a trace
+  error inside jit — either way a silent serialization point;
+* **TS102** Python ``if``/``while`` whose condition derives from a
+  traced function's parameters (tracers): concretization error on TPU,
+  or — worse — a silently rank-divergent branch on CPU test rigs.
+  Conditions on factory-closure statics, ``x is (not) None`` tests, and
+  shape/dtype/len-derived values are exempt;
+* **TS103** ``jax.jit(f)`` call sites where ``f`` (a module-local def)
+  uses a parameter in Python control flow but the jit wrapper declares
+  no ``static_argnums``/``static_argnames`` — every distinct value
+  retraces, every tracer crashes;
+* **TS104** ``functools.lru_cache`` on a program builder taking a live
+  ``Mesh`` parameter — the global cache pins the mesh (and its
+  executables) forever; use
+  :func:`cylon_tpu.utils.cache.program_cache`, which scopes the entry to
+  the mesh's lifetime.
+
+The pass is heuristic by design (a linter, not a verifier): it
+under-approximates taint (module-local call graph only) and exempts
+provably-static derivations; residual false positives are silenced with
+``# tracecheck: off[RULE]`` (see :mod:`cylon_tpu.analysis.rules`).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .rules import Finding, file_suppressed, is_suppressed, suppressions
+
+#: call names that ALWAYS host-sync (flagged anywhere in the traced region)
+_HOST_SYNC_FUNCS = {"host_array", "host_arrays", "sync_pull"}
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_NUMPY_SYNC_ATTRS = {"asarray", "array", "ascontiguousarray"}
+_METHOD_SYNCS = {"item", "tolist"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
+                 "names", "ops"}
+_STATIC_CALLS = {"len", "range", "enumerate", "zip", "isinstance", "getattr",
+                 "hasattr", "tuple", "list", "str", "repr", "type"}
+
+
+def _func_name(node: ast.expr) -> str:
+    """Dotted name of a call target ('jax.jit' / 'shard_map' / ...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_name(name: str) -> bool:
+    return name in ("jit", "jax.jit", "pjit", "jax.pjit")
+
+
+def _is_shard_map_name(name: str) -> bool:
+    return name.split(".")[-1] == "shard_map"
+
+
+def _is_lru_cache_deco(dec: ast.expr) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    return _func_name(target).split(".")[-1] == "lru_cache"
+
+
+def _has_mesh_param(fn: ast.FunctionDef) -> bool:
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        if a.arg == "mesh":
+            return True
+        ann = a.annotation
+        if ann is not None and "Mesh" in ast.unparse(ann):
+            return True
+    return False
+
+
+class _Funcs(ast.NodeVisitor):
+    """Index every def with its enclosing-def line chain."""
+
+    def __init__(self):
+        self.funcs: list[tuple[ast.FunctionDef, list[int]]] = []
+        self._stack: list[int] = []
+
+    def _visit_fn(self, node):
+        self.funcs.append((node, list(reversed(self._stack))))
+        self._stack.append(node.lineno)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+def _param_names(fn) -> set[str]:
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _names_in(node) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _target_roots(tgt) -> set[str]:
+    """Names actually (re)bound by an assignment target: for ``a[i] = ...``
+    only ``a`` (never the index ``i``); tuples/lists recurse."""
+    if isinstance(tgt, ast.Name):
+        return {tgt.id}
+    if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+        return _target_roots(tgt.value)
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = set()
+        for e in tgt.elts:
+            out |= _target_roots(e)
+        return out
+    if isinstance(tgt, ast.Starred):
+        return _target_roots(tgt.value)
+    return set()
+
+
+def _static_params(fn, call: ast.Call | None) -> set[str]:
+    """Parameter names declared static via static_argnums/static_argnames
+    on a jit decorator (@partial(jax.jit, ...)) or a jit call site."""
+    sources = []
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            sources.append(dec)
+    if call is not None:
+        sources.append(call)
+    positional = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    out: set[str] = set()
+    for src in sources:
+        for kw in src.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if isinstance(v.value, int) and kw.arg == "static_argnums":
+                    if 0 <= v.value < len(positional):
+                        out.add(positional[v.value])
+                elif isinstance(v.value, str):
+                    out.add(v.value)
+    return out
+
+
+def _is_static_expr(node, tainted: set[str]) -> bool:
+    """True when the expression provably does not carry tracer values:
+    constants, untainted names, shape/dtype/len derivations, and
+    compositions thereof."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id not in tainted
+    if isinstance(node, ast.Attribute):
+        # .shape/.dtype/... of anything is static metadata
+        if node.attr in _STATIC_ATTRS:
+            return True
+        return _is_static_expr(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        return (_is_static_expr(node.value, tainted)
+                and _is_static_expr(node.slice, tainted))
+    if isinstance(node, ast.Call):
+        fname = _func_name(node.func)
+        if fname.split(".")[-1] in _STATIC_CALLS:
+            return True
+        return False
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_static_expr(e, tainted) for e in node.elts)
+    if isinstance(node, ast.BoolOp):
+        return all(_is_static_expr(v, tainted) for v in node.values)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand, tainted)
+    if isinstance(node, ast.BinOp):
+        return (_is_static_expr(node.left, tainted)
+                and _is_static_expr(node.right, tainted))
+    if isinstance(node, ast.Compare):
+        # identity tests against None are always static
+        if (len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.Is, ast.IsNot))):
+            return True
+        # `key in container` with a static key: statically-keyed dict/set
+        # membership (ubiquitous for op dispatch); a tracer KEY still taints
+        if (len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and _is_static_expr(node.left, tainted)):
+            return True
+        return (_is_static_expr(node.left, tainted)
+                and all(_is_static_expr(c, tainted)
+                        for c in node.comparators))
+    if isinstance(node, ast.IfExp):
+        return all(_is_static_expr(e, tainted)
+                   for e in (node.test, node.body, node.orelse))
+    return False
+
+
+class _ModuleLint:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.findings: list[Finding] = []
+        idx = _Funcs()
+        idx.visit(tree)
+        self.funcs = idx.funcs
+        self.by_name: dict[str, ast.FunctionDef] = {}
+        for fn, _parents in self.funcs:
+            # first binding wins; shadowed names are rare in practice
+            self.by_name.setdefault(fn.name, fn)
+        self.def_lines = {fn.name: parents for fn, parents in self.funcs}
+
+    # -- traced-region discovery -----------------------------------------
+    def traced_functions(self) -> tuple[set[str], set[str]]:
+        """Returns (roots, traced): names of functions directly wrapped by
+        shard_map/jit, and the transitive module-local closure."""
+        roots: set[str] = set()
+        self.wrap_calls: dict[str, ast.Call] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                fname = _func_name(node.func)
+                if ((_is_shard_map_name(fname) or _is_jit_name(fname))
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in self.by_name):
+                    roots.add(node.args[0].id)
+                    self.wrap_calls.setdefault(node.args[0].id, node)
+        for fn, _parents in self.funcs:
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dname = _func_name(target)
+                if _is_jit_name(dname) or _is_shard_map_name(dname):
+                    roots.add(fn.name)
+                elif (isinstance(dec, ast.Call)
+                      and dname.split(".")[-1] == "partial" and dec.args):
+                    inner = _func_name(dec.args[0])
+                    if _is_jit_name(inner) or _is_shard_map_name(inner):
+                        roots.add(fn.name)
+        # transitive closure over module-local calls
+        calls: dict[str, set[str]] = {}
+        for fn, _parents in self.funcs:
+            called = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func,
+                                                             ast.Name):
+                    if node.func.id in self.by_name:
+                        called.add(node.func.id)
+            calls[fn.name] = called
+        traced = set(roots)
+        frontier = list(roots)
+        while frontier:
+            cur = frontier.pop()
+            for callee in calls.get(cur, ()):
+                if callee not in traced:
+                    traced.add(callee)
+                    frontier.append(callee)
+        return roots, traced
+
+    # -- taint ------------------------------------------------------------
+    def _taint(self, fn: ast.FunctionDef, is_root: bool) -> set[str]:
+        """Single forward pass: parameter-derived names, with static
+        derivations (shape/dtype/len/None-tests) left clean."""
+        if is_root:
+            statics = _static_params(fn, self.wrap_calls.get(fn.name))
+            tainted = set(_param_names(fn)) - statics
+        else:
+            tainted = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if not _is_static_expr(node.value, tainted) \
+                        and (_names_in(node.value) & tainted):
+                    for tgt in node.targets:
+                        tainted |= _target_roots(tgt)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name) \
+                        and (_names_in(node.value) & tainted):
+                    tainted.add(node.target.id)
+        return tainted
+
+    # -- rules ------------------------------------------------------------
+    def run(self) -> list[Finding]:
+        roots, traced = self.traced_functions()
+        for fn, parents in self.funcs:
+            self._check_lru_mesh(fn)
+            if fn.name in traced:
+                self._check_traced_body(fn, fn.name in roots)
+        self._check_jit_sites()
+        return self.findings
+
+    def _emit(self, rule: str, node, msg: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path, getattr(node, "lineno", 0), msg))
+
+    def _check_lru_mesh(self, fn: ast.FunctionDef) -> None:
+        for dec in fn.decorator_list:
+            if _is_lru_cache_deco(dec) and _has_mesh_param(fn):
+                self._emit(
+                    "TS104", fn,
+                    f"builder '{fn.name}' is lru_cache'd on a live Mesh — "
+                    "the global cache pins the mesh and its executables; "
+                    "use cylon_tpu.utils.cache.program_cache")
+
+    def _check_traced_body(self, fn: ast.FunctionDef, is_root: bool) -> None:
+        tainted = self._taint(fn, is_root)
+        # nested defs are visited as their own functions; don't re-walk
+        for node in ast.iter_child_nodes(fn):
+            self._walk_traced(node, fn, tainted, is_root)
+
+    def _walk_traced(self, node, fn, tainted, is_root) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed separately
+        if isinstance(node, (ast.If, ast.While)) and is_root:
+            if not _is_static_expr(node.test, tainted):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._emit(
+                    "TS102", node.test,
+                    f"Python `{kind}` on tracer-derived value inside "
+                    f"traced '{fn.name}' — concretization error under "
+                    "jit, rank-divergent control flow under shard_map")
+        if isinstance(node, ast.Call):
+            self._check_host_sync_call(node, fn, tainted, is_root)
+        for child in ast.iter_child_nodes(node):
+            self._walk_traced(child, fn, tainted, is_root)
+
+    def _check_host_sync_call(self, node: ast.Call, fn, tainted,
+                              is_root) -> None:
+        fname = _func_name(node.func)
+        leaf = fname.split(".")[-1]
+        arg_taint = any((_names_in(a) & tainted) for a in node.args) \
+            if is_root else bool(node.args)
+        if fname == "jax.device_get" or leaf in _HOST_SYNC_FUNCS:
+            self._emit(
+                "TS101", node,
+                f"host-sync call `{fname}` inside traced '{fn.name}' — "
+                "device→host round-trip per call")
+            return
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if (isinstance(base, ast.Name) and base.id in _NUMPY_MODULES
+                    and node.func.attr in _NUMPY_SYNC_ATTRS and arg_taint):
+                self._emit(
+                    "TS101", node,
+                    f"`{fname}` on a traced value inside '{fn.name}' — "
+                    "materializes the tracer on host (or fails to trace)")
+                return
+            if (node.func.attr in _METHOD_SYNCS and not node.args
+                    and not _is_static_expr(base, tainted)):
+                self._emit(
+                    "TS101", node,
+                    f"`.{node.func.attr}()` on a traced value inside "
+                    f"'{fn.name}' — scalar host pull per call")
+                return
+        if (is_root and isinstance(node.func, ast.Name)
+                and node.func.id in _CAST_BUILTINS and node.args
+                and not _is_static_expr(node.args[0], tainted)):
+            self._emit(
+                "TS101", node,
+                f"`{node.func.id}()` on a tracer inside '{fn.name}' — "
+                "concretizes the value (host sync or trace error)")
+
+    def _check_jit_sites(self) -> None:
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_jit_name(_func_name(node.func))):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Name)):
+                continue
+            target = self.by_name.get(node.args[0].id)
+            if target is None:
+                continue
+            kw = {k.arg for k in node.keywords}
+            if kw & {"static_argnums", "static_argnames"}:
+                continue
+            params = _param_names(target)
+            control_params = set()
+            for sub in ast.walk(target):
+                if isinstance(sub, (ast.If, ast.While)):
+                    control_params |= (_names_in(sub.test) & params)
+            if control_params:
+                self._emit(
+                    "TS103", node,
+                    f"jax.jit({target.name}) without static_argnums, but "
+                    f"param(s) {sorted(control_params)} drive Python "
+                    "control flow — every call with a tracer there fails, "
+                    "every distinct value retraces")
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    if file_suppressed(source):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("TS101", path, e.lineno or 0,
+                        f"syntax error prevents linting: {e.msg}")]
+    lint = _ModuleLint(path, source, tree)
+    raw = lint.run()
+    sup = suppressions(source)
+    out = []
+    for f in raw:
+        def_lines = _enclosing_def_lines(lint, f.line)
+        if not is_suppressed(f, sup, def_lines):
+            out.append(f)
+    return out
+
+
+def _enclosing_def_lines(lint: _ModuleLint, line: int) -> list[int]:
+    """Def-statement lines of every function whose span contains ``line``
+    (innermost first) — a suppression on a def covers its body."""
+    spans = []
+    for fn, _parents in lint.funcs:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= line <= end:
+            spans.append(fn.lineno)
+    return sorted(spans, reverse=True)
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(path, f.read())
+
+
+def lint_paths(paths) -> list[Finding]:
+    """Lint every ``.py`` file under the given files/directories."""
+    findings = []
+    for root in paths:
+        if os.path.isfile(root):
+            findings.extend(lint_file(root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    findings.extend(lint_file(os.path.join(dirpath, name)))
+    return findings

@@ -1,0 +1,88 @@
+"""Rule registry + findings + suppression parsing for the trace-safety
+analyzer.
+
+Rule families (documented in ``docs/trace_safety.md``):
+
+* ``TS1xx`` — AST lint (:mod:`cylon_tpu.analysis.ast_lint`), source-level
+  hazards visible without tracing;
+* ``JX2xx`` — jaxpr verification (:mod:`cylon_tpu.analysis.jaxpr_check`),
+  SPMD invariants checked on the traced program;
+* ``RT3xx`` — runtime sentinel (:mod:`cylon_tpu.analysis.runtime`),
+  retrace / transfer budgets enforced during test sessions.
+
+Suppression: a trailing comment ``# tracecheck: off[TS101]`` (comma-
+separated rule ids, or bare ``off`` for all rules) on the flagged line or
+on the enclosing ``def`` line silences the finding; file-level ``#
+tracecheck: off`` within the first five lines silences the whole file.
+Suppressions are deliberate, reviewable artifacts — the linter never
+auto-inserts them.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+RULES = {
+    "TS101": "host-sync call reachable inside a traced (jit/shard_map) body",
+    "TS102": "Python if/while on a tracer-derived value in a traced body",
+    "TS103": "jax.jit wrapper missing static_argnums for a control param",
+    "TS104": "lru_cache'd program builder keyed on a live Mesh object",
+    "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
+    "JX202": "collective under data-dependent lax.while_loop",
+    "JX203": "int32→int64 widening of a row-scale array under x64",
+    "JX204": "host callback count exceeds the builder's budget",
+    "JX205": "collective set differs from the builder's declaration",
+    "RT301": "builder recompiled for an identical shape signature",
+    "RT302": "builder compiled more distinct programs than its budget",
+    "RT303": "op exceeded its declared host-transfer budget",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracecheck:\s*off(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
+
+
+def suppressions(source: str) -> dict[int, set[str] | None]:
+    """Per-line suppression map: line -> set of rule ids, or None = all.
+    Line numbers are 1-based, matching ast/Finding."""
+    out: dict[int, set[str] | None] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = m.group("rules")
+        out[i] = (None if ids is None
+                  else {r.strip() for r in ids.split(",") if r.strip()})
+    return out
+
+
+def file_suppressed(source: str) -> bool:
+    for i, text in enumerate(source.splitlines()[:5], start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m and m.group("rules") is None:
+            return True
+    return False
+
+
+def is_suppressed(finding: Finding, sup: dict, def_lines: list[int]) -> bool:
+    """``def_lines``: line numbers of enclosing function defs (innermost
+    first) — a suppression on a def line covers its whole body."""
+    for line in [finding.line, *def_lines]:
+        rules = sup.get(line, "missing")
+        if rules == "missing":
+            continue
+        if rules is None or finding.rule in rules:
+            return True
+    return False

@@ -16,6 +16,14 @@ import os
 
 import jax
 
+# jax < 0.5 compatibility: ``shard_map`` graduated from
+# ``jax.experimental.shard_map`` to ``jax.shard_map``; every module binds
+# ``jax.shard_map`` at import time, and this module is imported first
+# (package __init__ line 1), so the alias is in place before any binding.
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+    jax.shard_map = _shard_map
+
 X64_ENABLED = os.environ.get("CYLON_TPU_X64", "1") != "0"
 if X64_ENABLED:
     jax.config.update("jax_enable_x64", True)

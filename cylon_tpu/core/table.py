@@ -334,7 +334,13 @@ def _put(host: np.ndarray, sharding):
     """Place a host array under a sharding.  device_put in single-controller
     mode; in multi-controller (jax.distributed) mode each process holds the
     same full host copy and materializes only its addressable shards
-    (SPMD ingest — the reference's per-rank partition reads)."""
+    (SPMD ingest — the reference's per-rank partition reads).
+
+    This is the documented host→device UPLOAD boundary (trace-safety,
+    docs/trace_safety.md): device_put/make_array_from_callback are
+    explicit transfers, permitted under every transfer-guard level the
+    test rig uses; the matching device→host boundary is the
+    utils/host.py pull funnel."""
     import jax as _jax
     if _jax.process_count() > 1:
         return _jax.make_array_from_callback(host.shape, sharding,

@@ -29,6 +29,16 @@ from ..status import InvalidError
 ROW_AXIS = "cyl_rows"  # the mesh axis tables are row-sharded over
 
 
+def _distributed_initialized() -> bool:
+    """jax < 0.5 compatibility: ``jax.distributed.is_initialized`` landed
+    after 0.4.x; fall back to probing the distributed client state."""
+    try:
+        return jax.distributed.is_initialized()
+    except AttributeError:
+        from jax._src import distributed
+        return getattr(distributed.global_state, "client", None) is not None
+
+
 class CommConfig:
     """Base communicator config (reference: net/comm_config.hpp)."""
 
@@ -69,7 +79,7 @@ class TPUConfig(CommConfig):
         self.num_processes = num_processes
 
     def resolve_devices(self):
-        if self.distributed and not jax.distributed.is_initialized():
+        if self.distributed and not _distributed_initialized():
             jax.distributed.initialize(
                 coordinator_address=self.coordinator_address,
                 num_processes=self.num_processes,

@@ -26,14 +26,13 @@ Degenerate case C=1 equals the monolithic operator exactly.
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -46,7 +45,7 @@ from ..status import InvalidError
 shard_map = jax.shard_map
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _chunk_fn(mesh: Mesh, cap: int, step: int):
     """Per-shard dynamic slice [start, start+step) of every column."""
 
@@ -296,7 +295,7 @@ def _n_key_ops(dtypes: tuple, need_nf: tuple, narrow: tuple) -> int:
     return n
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _range_bounds_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
                      need_nf: tuple, n_ops: int):
     """Per-shard range boundaries over the LOCALLY SORTED build side:
@@ -336,7 +335,7 @@ def _range_bounds_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
                              out_specs=(ROW,) * (1 + n_ops)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
                       need_nf: tuple, n_ops: int):
     """Per-row range id for the probe side: count of splitters <= row key
@@ -364,7 +363,7 @@ def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
                              out_specs=(ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _piece_pack_fn(mesh: Mesh, spec, pad: int):
     from ..ops import lanes
 
@@ -379,7 +378,7 @@ def _piece_pack_fn(mesh: Mesh, spec, pad: int):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _pad_rows_fn(mesh: Mesh, pad: int):
     def per_shard(d):
         return jnp.concatenate([d, jnp.zeros((pad,), d.dtype)]) if pad else d
@@ -388,7 +387,7 @@ def _pad_rows_fn(mesh: Mesh, pad: int):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _piece_slice_fn(mesh: Mesh, spec, piece_cap: int):
     """Each shard's contiguous window [start, start+piece_cap) of the
     once-packed lane matrix (+f64 side arrays): dynamic slices, no gathers.

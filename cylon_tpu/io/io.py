@@ -186,6 +186,9 @@ def _shard_frames(table: Table):
         for _, _, gd, gv in getters:
             flat.append(gd(i))
             flat.append(gv(i))
+        # documented device→host PULL boundary (docs/trace_safety.md):
+        # one batched sanctioned fetch through the utils/host funnel —
+        # permitted under the tracecheck transfer guard
         pulled = host_arrays(flat)
         data = {}
         for j, (name, c, _, _) in enumerate(getters):

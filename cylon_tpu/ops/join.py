@@ -256,5 +256,5 @@ def join_take(carry: JoinCarry, n_l: int, how: str, out_cap: int,
         unpos = (jnp.cumsum(un) - un).astype(jnp.int32)
         slot = jnp.where(un > 0, total_main + unpos, jnp.int32(out_cap))
         r_take = r_take.at[slot].set(idx_s - n_l, mode="drop")
-        total = total_main + jnp.sum(un).astype(jnp.int32)
+        total = total_main + jnp.sum(un, dtype=jnp.int32)
     return JoinTake(total, valid, matched, mpos, l_take, r_take, extra_out)

@@ -54,7 +54,7 @@ def compact_by_flag(flag: jax.Array, out_cap: int):
     idx = jnp.arange(n, dtype=jnp.int32)
     fi = flag.astype(jnp.int32)
     pos = (jnp.cumsum(fi) - fi).astype(jnp.int32)
-    total = jnp.sum(fi).astype(jnp.int32)
+    total = jnp.sum(fi, dtype=jnp.int32)
     scat = jnp.where(flag, pos, jnp.int32(out_cap))
     out = jnp.full(out_cap, -1, jnp.int32).at[scat].set(idx, mode="drop")
     return out, total

@@ -27,14 +27,13 @@ stays inside the compiled program, no controller round-trip.
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -46,7 +45,7 @@ ROW = P(ROW_AXIS)
 REP = P()
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _allgather_fn(mesh: Mesh, w: int, cap: int, out_cap: int, ncols: int):
     def per_shard(vc, *cols):
         k = jnp.arange(w * cap, dtype=jnp.int32)
@@ -70,7 +69,7 @@ def _allgather_fn(mesh: Mesh, w: int, cap: int, out_cap: int, ncols: int):
                              out_specs=(ROW,) * ncols))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _bcast_fn(mesh: Mesh, root: int, ncols: int):
     def per_shard(*cols):
         outs = []
@@ -99,13 +98,15 @@ def _identity_for(op: str, dtype):
     return jnp.asarray(big if op == "min" else small, dtype)
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _allreduce_fn(mesh: Mesh, op: str, ncols: int):
     def per_shard(vc, *cols):
         my = jax.lax.axis_index(ROW_AXIS)
         outs = []
         for c in cols:
-            mask = jnp.arange(c.shape[0]) < vc[my]
+            # dtype pins the iota: a default arange is int64 under x64 —
+            # a row-scale array at 2x the bytes just to build a mask
+            mask = jnp.arange(c.shape[0], dtype=jnp.int32) < vc[my]
             ident = _identity_for(op, c.dtype)
             masked = jnp.where(mask, c, ident)
             outs.append(_REDUCERS[op](masked, ROW_AXIS))
@@ -194,3 +195,49 @@ def allreduce(table_or_column, op: str = "sum", valid_counts=None):
           else np.full(w, cap, np.int32))
     (res,) = _allreduce_fn(mesh, op, 1)(vc, arr)
     return np.asarray(res)  # out_specs REP: replicated, locally addressable
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the jaxpr pass
+# traces each builder abstractly and verifies its SPMD invariants — the
+# declared collective set, collective unconditionality, no row-scale
+# i32→i64 widening, zero host callbacks.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_allgather(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    out_cap = 2 * cap
+    fn = _unwrap(_allgather_fn(mesh, w, cap, out_cap, 2))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64),
+                              S((w * cap,), np.float64))
+
+
+def _trace_bcast(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_bcast_fn(mesh, 0, 2))
+    return jax.make_jaxpr(fn)(S((w * cap,), np.int64),
+                              S((w * cap,), np.float64))
+
+
+def _trace_allreduce(mesh):
+    # one combined trace covers all three reducers so the declared set
+    # {psum, pmin, pmax} is verified in a single walk
+    w, cap, S = _decl_shapes(mesh)
+    fns = [_unwrap(_allreduce_fn(mesh, op, 1)) for op in ("sum", "min", "max")]
+
+    def all_ops(vc, col):
+        return tuple(fn(vc, col) for fn in fns)
+
+    return jax.make_jaxpr(all_ops)(S((w,), np.int32),
+                                   S((w * cap,), np.float64))
+
+
+from ..analysis.registry import (declare_builder, decl_shapes as _decl_shapes,  # noqa: E402
+                                 unwrap as _unwrap)
+
+declare_builder(f"{__name__}._allgather_fn", _trace_allgather,
+                collectives={"all_gather"}, tags=("collectives",))
+declare_builder(f"{__name__}._bcast_fn", _trace_bcast,
+                collectives={"all_gather"}, tags=("collectives",))
+declare_builder(f"{__name__}._allreduce_fn", _trace_allreduce,
+                collectives={"psum", "pmin", "pmax"}, tags=("collectives",))

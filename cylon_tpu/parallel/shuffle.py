@@ -25,7 +25,7 @@ table payloads; only the O(W²) count matrix crosses to the host.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
+from ..utils.cache import program_cache
 from ..ctx.context import ROW_AXIS
 from ..ops import hashing
 
@@ -43,7 +44,7 @@ shard_map = jax.shard_map
 # Phase A: target computation + count matrix
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _hash_targets_fn(mesh: Mesh, w: int, nkeys: int, with_valids: bool):
     def per_shard(vc, *keys):
         cap = keys[0].shape[0]
@@ -77,7 +78,7 @@ def hash_targets(mesh: Mesh, key_datas, key_valids, valid_counts: np.ndarray):
     return _hash_targets_fn(mesh, w, len(key_datas), with_valids)(vc, *args)
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _count_fn(mesh: Mesh, w: int):
     def per_shard(tgt):
         counts = jax.ops.segment_sum(
@@ -95,7 +96,7 @@ def count_targets(mesh: Mesh, tgt) -> np.ndarray:
     return host_array(_count_fn(mesh, w)(tgt))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _skew_targets_fn(mesh: Mesh, w: int, k_heavy: int, nkeys: int):
     """Targets for a skew-split probe side: heavy-HASH rows spread evenly
     over all ranks (round-robin by global position) instead of hashing —
@@ -151,7 +152,7 @@ def skew_targets(mesh: Mesh, key_datas, key_valids,
 # stays at W·block ≈ one shard's worth regardless of skew.
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _prep_fn(mesh: Mesh, w: int):
     """Per shard: stable order rows by destination once; reused each round.
     Returns (tgt_s, perm, pos): sorted targets, source permutation, and the
@@ -174,7 +175,7 @@ def _prep_fn(mesh: Mesh, w: int):
                              out_specs=(P(ROW_AXIS),) * 3))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int,
               rounds: int = 1):
     """The exchange round engine: select a round's position window,
@@ -233,7 +234,7 @@ def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int,
     return jax.jit(fn, donate_argnums=(4,))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _alloc_fn(mesh: Mesh, out_cap: int, dtype: str, extra_shape: tuple):
     def per_shard():
         return jnp.zeros((out_cap,) + extra_shape, jnp.dtype(dtype))
@@ -309,3 +310,71 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
     outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
     return outs, per_dest.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry) — the jaxpr pass
+# verifies the exchange engine's SPMD invariants.  The high-value check is
+# _round_fn: its all_to_all must stay UNCONDITIONAL — the multi-round path
+# runs it under a static-trip-count fori_loop (lowered to scan, identical
+# on every rank: allowed), never under cond/while (rank-divergent
+# participation deadlocks the mesh).  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_round(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    block, out_cap, rounds = cap // 4, 2 * cap, 3
+    fn = _unwrap(_round_fn(mesh, w, block, out_cap, rounds))
+    one = _unwrap(_round_fn(mesh, w, cap, out_cap, 1))
+    i32 = np.int32
+
+    def both(tgt_s, perm, pos, counts, outs, cols):
+        # single-round and scan-wrapped multi-round paths in one walk
+        a = one(tgt_s, perm, pos, counts, outs, cols)
+        b = fn(tgt_s, perm, pos, counts, outs, cols)
+        return a, b
+
+    args = (S((w * cap,), i32), S((w * cap,), i32), S((w * cap,), i32),
+            S((w, w), i32), (S((w * out_cap,), np.int64),),
+            (S((w * cap,), np.int64),))
+    return jax.make_jaxpr(both)(*args)
+
+
+def _trace_hash_targets(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_hash_targets_fn(mesh, w, 1, True))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64),
+                              S((w * cap,), np.bool_))
+
+
+def _trace_count(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_count_fn(mesh, w))
+    return jax.make_jaxpr(fn)(S((w * cap,), np.int32))
+
+
+def _trace_skew_targets(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_skew_targets_fn(mesh, w, 2, 1))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((2,), np.uint32),
+                              S((w * cap,), np.int64),
+                              S((w * cap,), np.bool_))
+
+
+def _trace_prep(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_prep_fn(mesh, w))
+    return jax.make_jaxpr(fn)(S((w * cap,), np.int32), S((w, w), np.int32))
+
+
+from ..analysis.registry import (declare_builder, decl_shapes as _decl_shapes,  # noqa: E402
+                                 unwrap as _unwrap)
+
+declare_builder(f"{__name__}._round_fn", _trace_round,
+                collectives={"all_to_all"}, tags=("shuffle",))
+declare_builder(f"{__name__}._hash_targets_fn", _trace_hash_targets,
+                tags=("shuffle",))
+declare_builder(f"{__name__}._count_fn", _trace_count, tags=("shuffle",))
+declare_builder(f"{__name__}._skew_targets_fn", _trace_skew_targets,
+                tags=("shuffle", "skew"))
+declare_builder(f"{__name__}._prep_fn", _trace_prep, tags=("shuffle",))

@@ -29,7 +29,6 @@ transparently) unless every condition holds.
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import NamedTuple
 
 import jax
@@ -38,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType
 from ..core.table import DeferredTable, Table
@@ -90,7 +90,7 @@ def _col_entry(state: JoinState, name: str):
     return None
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
               vspecs: tuple, key_cols: tuple, key_narrow: tuple,
               seg_cap: int, ddof: int, pad_lanes: int = 0,

@@ -15,14 +15,13 @@ reference ships ``MapReduceKernel`` intermediates through ArrowAllToAll.
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from ..core.table import Table
@@ -299,7 +298,7 @@ def _runs_reduce(specs_ops, val_datas, vmasks, gids, first, mask, vc,
     return inters, key_out, kval_out
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                 narrow: tuple, vspec=None, val_map: tuple = (),
                 pad_lanes: int = 0, gather_parts: int = 1):
@@ -344,7 +343,7 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                              out_specs=(ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
               pad_lanes: int = 0, use_runs: bool = True,
               gather_parts: int = 1):
@@ -430,7 +429,7 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             narrow: tuple, vnarrow: tuple = (), vspec=None,
             val_map: tuple = (), pad_lanes: int = 0, use_runs: bool = True,
@@ -505,7 +504,7 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _shrink_fn(mesh: Mesh, new_cap: int):
     def per_shard(d):
         return d[:new_cap]
@@ -820,3 +819,40 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     out = _shrink(out, n_groups)
     out.grouped_by = tuple(by)
     return out
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): groupby's two
+# phases are pure-local shard programs separated by the hash shuffle — the
+# jaxpr pass asserts no hidden collective, no row-scale i32→i64 widening,
+# zero host callbacks.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _decl_args(mesh, cap=1024):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    vc = S((w,), np.int32)
+    keys = (S((w * cap,), np.int64),)
+    valids = (S((w * cap,), np.bool_),)
+    vals = (S((w * cap,), np.float64),)
+    return w, S, vc, keys, valids, vals
+
+
+def _trace_combine(mesh):
+    _w, _S, vc, keys, valids, vals = _decl_args(mesh)
+    fn = _unwrap(_combine_fn(mesh, ("sum",), 256, False, (False,),
+                             None, (0,)))
+    return jax.make_jaxpr(fn)(vc, keys, valids, vals, valids)
+
+
+def _trace_shrink(mesh):
+    w, S, _vc, _k, _v, _vals = _decl_args(mesh)
+    fn = _unwrap(_shrink_fn(mesh, 512))
+    return jax.make_jaxpr(fn)(S((w * 1024,), np.float64))
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._combine_fn", _trace_combine,
+                tags=("groupby",))
+declare_builder(f"{__name__}._shrink_fn", _trace_shrink, tags=("groupby",))

@@ -24,14 +24,13 @@ keys whose values fit in 32 bits sort as a single native operand.
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -59,7 +58,7 @@ HOW = ("inner", "left", "right", "outer", "semi", "anti")
 #: hit every time.
 _CAP_CACHE = BoundedCache()
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _hash_sample_fn(mesh: Mesh, m: int, nkeys: int):
     """Evenly spaced per-shard sample of the key tuple's ROW HASH —
     detection runs in hash space so multi-column and float keys work
@@ -132,7 +131,7 @@ def _heavy_keys(table: Table, key_names: list, env):
                       np.uint32)
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _heavy_flag_fn(mesh: Mesh, k: int, nkeys: int):
     from ..ops import hashing
 
@@ -299,7 +298,7 @@ def _sorted_state(vcl, vcr, l_datas, l_valids, r_datas, r_valids,
     return bnd, idx_s, live_cat, pl_s
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _semi_flag_fn(mesh: Mesh, narrow: tuple, all_live: bool, anti: bool):
     """Per-left-row matched flag for SEMI/ANTI joins over the single-sort
     state: one run of the boundary algebra (right-count per key run), no
@@ -341,7 +340,7 @@ def _semi_flag_fn(mesh: Mesh, narrow: tuple, all_live: bool, anti: bool):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _count_fn(mesh: Mesh, how: str, narrow: tuple,
               lspec: lanes.LaneSpec | None = None,
               rspec: lanes.LaneSpec | None = None, all_live: bool = False,
@@ -393,7 +392,7 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
                              out_specs=(ROW,) * n_out))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _carry_fn(mesh: Mesh, how: str, cap_l: int, cap_r: int,
               all_live: bool):
     """Recompute the full phase-1 carry from a held SLIM state (idx_s, bnd)
@@ -411,7 +410,7 @@ def _carry_fn(mesh: Mesh, how: str, cap_l: int, cap_r: int,
                              out_specs=(ROW,) * 6))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                     plan: tuple, lspec: lanes.LaneSpec,
                     rspec: lanes.LaneSpec, carry_emit: bool = False,
@@ -886,3 +885,52 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
         # half of the contract does not hold there.
         out.grouped_by = tuple(left_on)
     return out
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the join kernels
+# are pure-local shard programs — the jaxpr pass asserts NO collective ever
+# appears in them (the shuffle happens upstream in parallel/shuffle.py), no
+# row-scale i32→i64 widening, zero host callbacks.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _decl_args(mesh, cap=1024):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    vc = S((w,), np.int32)
+    keys = (S((w * cap,), np.int64),)
+    valids = (S((w * cap,), np.bool_),)
+    return w, S, vc, keys, valids
+
+
+def _trace_semi_flag(mesh):
+    _w, _S, vc, keys, valids = _decl_args(mesh)
+    fn = _unwrap(_semi_flag_fn(mesh, (False,), False, False))
+    return jax.make_jaxpr(fn)(vc, vc, keys, valids, keys, valids)
+
+
+def _trace_count(mesh):
+    _w, _S, vc, keys, valids = _decl_args(mesh)
+    fn = _unwrap(_count_fn(mesh, "inner", (False,), None, None, False, False))
+    return jax.make_jaxpr(fn)(vc, vc, keys, valids, keys, valids,
+                              (), (), (), ())
+
+
+def _trace_carry(mesh):
+    w, S, vc, _keys, _valids = _decl_args(mesh)
+    cap = 1024
+    fn = _unwrap(_carry_fn(mesh, "inner", cap, cap, False))
+    cat = S((w * 2 * cap,), np.int32)
+    return jax.make_jaxpr(fn)(vc, vc, cat, cat)
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._semi_flag_fn", _trace_semi_flag,
+                tags=("join",))
+# _count_fn's static key spans (how x narrow x lane-spec x liveness x
+# slim) — a combinatorially larger legitimate program family than the
+# capacity-keyed builders, so its session budget is wider
+declare_builder(f"{__name__}._count_fn", _trace_count, tags=("join",),
+                retrace_budget=128)
+declare_builder(f"{__name__}._carry_fn", _trace_carry, tags=("join",))

@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import CylonEnv
@@ -153,12 +154,14 @@ def exchange_by_targets(table: Table, tgt, counts: np.ndarray) -> Table:
 # repartition (reference table.cpp:1481, repartition.hpp:94 index math)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _range_targets_fn(mesh: Mesh, cap: int):
     def per_shard(vc, offs, bounds, _probe):
         w = vc.shape[0]
         my = jax.lax.axis_index(shuffle.ROW_AXIS)
-        mask = jnp.arange(cap) < vc[my]
+        # int32 iota for the mask only; gpos below stays int64 — GLOBAL
+        # row positions legitimately exceed int32 at multi-billion rows
+        mask = jnp.arange(cap, dtype=jnp.int32) < vc[my]
         gpos = offs[my] + jnp.arange(cap, dtype=jnp.int64)
         # bounds[d] = last global row index destined to d; first d with
         # bounds[d] >= gpos owns the row (empty destinations skip naturally)
@@ -217,7 +220,7 @@ def repartition(table: Table, rows_per_partition=None) -> Table:
     return exchange_by_targets(table, tgt, counts)
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _repad_fn(mesh: Mesh, cap: int, new_cap: int):
     def per_shard(d):
         if new_cap <= cap:
@@ -254,7 +257,7 @@ def repad_table(table: Table, new_cap: int) -> Table:
 # slice / head / tail (reference indexing/slice.cpp:31, table.hpp:512-527)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, spec):
     from ..ops import lanes
 
@@ -309,7 +312,7 @@ def tail(table: Table, n: int) -> Table:
 # row filter (reference: compute.pyx filter path — table[bool_mask])
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _filter_count_fn(mesh: Mesh, cap: int):
     def per_shard(vc, flag):
         mask = live_mask(vc, cap)
@@ -319,7 +322,7 @@ def _filter_count_fn(mesh: Mesh, cap: int):
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int, spec):
     from ..ops import lanes
 
@@ -359,7 +362,7 @@ def filter_table(table: Table, flag) -> Table:
 # concat (reference Merge/concat, frame.py:2295)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _concat_fn(mesh: Mesh, caps: tuple, out_cap: int, with_valid: tuple):
     """Per-shard append of k tables' live prefixes: each table's FULL padded
     block is block-copied (``dynamic_update_slice`` — contiguous, ~1 ns/row
@@ -475,3 +478,27 @@ def concat_tables(tables: list[Table]) -> Table:
                       if all(b is not None for b in bs) else None)
     return build_table(names, out_d, out_v, types, dicts, new_valid, env,
                        bounds=bounds)
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry) — pure-local
+# shard programs (the exchange rides parallel/shuffle.py); no collective
+# may appear.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_range_targets(mesh):
+    w = int(mesh.devices.size)
+    cap = 1024
+    S = jax.ShapeDtypeStruct
+    fn = _unwrap(_range_targets_fn(mesh, cap))
+    # dtypes mirror the production caller (_order_preserving_targets):
+    # int32 valid counts, int64 offsets/bounds — the gate must verify the
+    # dtype specialization that actually runs
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w,), np.int64),
+                              S((w,), np.int64), S((w * cap,), np.int64))
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._range_targets_fn", _trace_range_targets,
+                tags=("repart", "shuffle"))

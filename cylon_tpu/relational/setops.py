@@ -14,14 +14,13 @@ comparator analog) and membership/uniqueness become segment min/max logic
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType
 from ..core.table import Table
@@ -50,17 +49,17 @@ def _unique_flags_per_shard(vc, key_datas, key_valids, keep: str):
     return setk.unique_flags(gids, mask, keep), mask
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _unique_count_fn(mesh: Mesh, keep: str):
     def per_shard(vc, key_datas, key_valids):
         flags, _ = _unique_flags_per_shard(vc, key_datas, key_valids, keep)
-        return jnp.sum(flags).astype(jnp.int32).reshape(1)
+        return jnp.sum(flags, dtype=jnp.int32).reshape(1)
 
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _unique_mat_fn(mesh: Mesh, keep: str, out_cap: int, spec):
     from ..ops import lanes
 
@@ -144,19 +143,19 @@ def _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids,
     return flags
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _setop_count_fn(mesh: Mesh, op: str):
     def per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids):
         flags = _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas,
                                        b_valids, op)
-        return jnp.sum(flags).astype(jnp.int32).reshape(1)
+        return jnp.sum(flags, dtype=jnp.int32).reshape(1)
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW),
                              out_specs=ROW))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _setop_mat_fn(mesh: Mesh, op: str, out_cap: int):
     def per_shard(vca, vcb, a_datas, a_valids, b_datas, b_valids):
         flags = _setop_flags_per_shard(vca, vcb, a_datas, a_valids, b_datas,
@@ -237,7 +236,7 @@ def _set_operation_impl(a: Table, b: Table, op: str,
 # equals (reference table.cpp:1389 Equals / :1440 DistributedEquals)
 # ---------------------------------------------------------------------------
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _equals_fn(mesh: Mesh, kinds: tuple):
     def per_shard(vc, a_datas, a_valids, b_datas, b_valids):
         cap = a_datas[0].shape[0]
@@ -295,3 +294,23 @@ def equals(a: Table, b: Table, ordered: bool = True) -> bool:
     vc = np.asarray(a.valid_counts, np.int32)
     res = _equals_fn(env.mesh, kinds)(vc, a_datas, a_valids, b_datas, b_valids)
     return bool(host_array(res).all())
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry) — pure-local
+# shard programs; no collective may appear.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_unique_count(mesh):
+    w = int(mesh.devices.size)
+    cap = 1024
+    S = jax.ShapeDtypeStruct
+    fn = _unwrap(_unique_count_fn(mesh, "first"))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), (S((w * cap,), np.int64),),
+                              (S((w * cap,), np.bool_),))
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._unique_count_fn", _trace_unique_count,
+                tags=("setops",))

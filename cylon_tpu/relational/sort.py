@@ -19,14 +19,13 @@ merge).  Differences from the reference forced/afforded by the TPU model:
 
 from __future__ import annotations
 
-from functools import lru_cache
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -58,7 +57,7 @@ def _norm_dirs(by, ascending):
     return tuple(not a for a in ascending)
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                    narrow: tuple, vspec, f64_idx: tuple = ()):
     """Per-shard multi-key sort.  Laneable columns RIDE THE SORT as u32
@@ -104,7 +103,7 @@ def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                              out_specs=(ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int,
                narrow: tuple = ()):
     """Uniform per-shard sample of transformed key operands (reference
@@ -127,7 +126,7 @@ def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int,
                              out_specs=(ROW, ROW)))
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _target_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                narrow: tuple = ()):
     """Per-row destination rank = number of splitters strictly below the row
@@ -144,7 +143,9 @@ def _target_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                                nulls_position=nulls_position,
                                narrow32=narrow or None)
         gt = pack.rows_gt_splitters(ko, splitter_ops)
-        tgt = jnp.sum(gt, axis=1).astype(jnp.int32)
+        # dtype pins the accumulator: plain sum(bool) widens the (cap, W-1)
+        # operand to int64 under x64 (JX203) — W fits int32 trivially
+        tgt = jnp.sum(gt, axis=1, dtype=jnp.int32)
         return jnp.where(mask, tgt, jnp.int32(w))
 
     return jax.jit(shard_map(per_shard, mesh=mesh,
@@ -404,3 +405,41 @@ def local_sort_table(table: Table, by, ascending=True,
     # range exchange in sort_table, the hash shuffle in pipelined_join)
     # set it themselves.
     return Table(cols, env, table.valid_counts)
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the sample-sort
+# builders are pure-local shard programs (splitter selection is a
+# controller round-trip, the range exchange rides the shuffle engine) —
+# the jaxpr pass asserts no hidden collective, no row-scale i32→i64
+# widening, zero host callbacks.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _decl_args(mesh, cap=1024):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    vc = S((w,), np.int32)
+    keys = (S((w * cap,), np.int64),)
+    valids = (S((w * cap,), np.bool_),)
+    return w, S, vc, keys, valids
+
+
+def _trace_sample(mesh):
+    _w, _S, vc, keys, valids = _decl_args(mesh)
+    fn = _unwrap(_sample_fn(mesh, 64, (False,), pack.NULL_LAST, (False,)))
+    return jax.make_jaxpr(fn)(vc, keys, valids)
+
+
+def _trace_target(mesh):
+    w, S, vc, keys, valids = _decl_args(mesh)
+    sample = _unwrap(_sample_fn(mesh, 64, (False,), pack.NULL_LAST, (False,)))
+    sampled, _live = jax.eval_shape(sample, vc, keys, valids)
+    splitters = tuple(S((w - 1,), s.dtype) for s in sampled)
+    fn = _unwrap(_target_fn(mesh, (False,), pack.NULL_LAST, (False,)))
+    return jax.make_jaxpr(fn)(vc, keys, valids, splitters)
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._sample_fn", _trace_sample, tags=("sort",))
+declare_builder(f"{__name__}._target_fn", _trace_target, tags=("sort",))

@@ -14,7 +14,6 @@ Null semantics: validity propagates through arithmetic/comparison as AND
 
 from __future__ import annotations
 
-from functools import lru_cache
 from typing import Any
 
 import jax
@@ -23,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import config
+from .utils.cache import program_cache
 from .core.column import Column
 from .core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from .core.table import Table
@@ -454,7 +454,7 @@ class Series:
         return unique_table(t, [self.name]).to_pandas()[self.name].to_numpy()
 
 
-@lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
+@program_cache()
 def _reduce_fn(mesh: Mesh, kind: str, cap: int):
     from .relational.common import REP, ROW, live_mask
 

@@ -1,0 +1,161 @@
+"""Compiled-program builder cache with mesh-scoped, globally bounded
+entries.
+
+Every shard_map/jit program factory in the framework is memoized on its
+static arguments.  A plain ``functools.lru_cache`` keyed on the ``Mesh``
+has two hazards the trace-safety analyzer (TS104) flags:
+
+* **pinning** — the global cache holds the Mesh (and, through the jitted
+  program's closure, every executable built for it) long after the
+  owning ``CylonEnv`` is gone, and keeps doing so even on jax versions
+  whose Mesh interning is weak;
+* **cache-miss hazard** — two structurally identical meshes are distinct
+  keys only by object identity quirks, so an innocently rebuilt mesh
+  silently recompiles the whole program family.
+
+:func:`program_cache` stores the per-mesh program table **on the mesh
+object itself** (a descriptor-style key): structurally equal interned
+meshes share one table, and this module adds no strong global reference
+to any mesh.  Note the limit of that guarantee on current jax (0.4.x):
+``Mesh.__new__`` interns instances in a strong module-level dict, so
+meshes — and therefore their tables — live for the process regardless
+of this cache.  To keep total retained executables bounded across
+processes that cycle through many meshes, a module-level LRU of mesh
+tables (:data:`MESH_TABLE_LIMIT`, weakly referenced) clears the
+least-recently-used mesh's programs when the population overflows —
+cleared entries rebuild on demand.
+
+The wrapper also feeds the retrace sentinel
+(:mod:`cylon_tpu.analysis.runtime`): each returned program is tagged
+with its builder name, static key, and mesh identity so XLA compile
+events can be attributed to the op that triggered them.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from .. import config
+
+#: single per-mesh attribute holding {builder_qualname: OrderedDict}
+_MESH_ATTR = "_cylon_tpu_program_cache"
+
+#: max meshes with live program tables: jax interns meshes for the
+#: process lifetime, so without this LRU a mesh-cycling process would
+#: retain up to PROGRAM_CACHE_SIZE programs per builder PER MESH forever
+MESH_TABLE_LIMIT = 8
+
+#: id(mesh) -> (weakref-or-mesh, table); the LRU of live tables.  Holds
+#: the mesh weakly (strongly only for exotic non-weakrefable mesh types,
+#: where identity must be pinned to rule out id() reuse aliasing).
+_TABLES: "OrderedDict[int, tuple]" = OrderedDict()
+
+_lock = threading.RLock()
+
+
+def _track_table(mesh, table) -> None:
+    """Register a mesh's table in the global LRU; evict the oldest mesh's
+    programs past MESH_TABLE_LIMIT (its table empties; entries rebuild on
+    demand)."""
+    def _on_collect(_r, k=id(mesh)):
+        with _lock:  # RLock: safe even if GC fires inside a locked section
+            _TABLES.pop(k, None)
+
+    try:
+        ref = weakref.ref(mesh, _on_collect)
+    except TypeError:
+        ref = mesh  # not weakrefable: pin (also rules out id() aliasing)
+    _TABLES[id(mesh)] = (ref, table)
+    while len(_TABLES) > MESH_TABLE_LIMIT:
+        _oldest, (_ref, old_table) = _TABLES.popitem(last=False)
+        old_table.clear()
+
+
+def _mesh_table(mesh) -> dict:
+    entry = _TABLES.get(id(mesh))
+    if entry is not None:
+        ref, table = entry
+        referent = ref() if isinstance(ref, weakref.ref) else ref
+        if referent is mesh:
+            _TABLES.move_to_end(id(mesh))
+            return table
+        _TABLES.pop(id(mesh), None)  # id reuse after a mesh died
+    table = getattr(mesh, _MESH_ATTR, None)
+    if table is None:
+        table = {}
+        try:
+            object.__setattr__(mesh, _MESH_ATTR, table)
+        except (AttributeError, TypeError):
+            pass  # tracked via _TABLES only
+    _track_table(mesh, table)
+    return table
+
+
+def program_cache(maxsize: int | None = None):
+    """LRU-memoize a program factory whose FIRST argument is the Mesh.
+
+    Per-mesh, per-builder bounded LRU (default
+    ``config.PROGRAM_CACHE_SIZE``) living on the mesh object, with a
+    global :data:`MESH_TABLE_LIMIT`-mesh bound — see module docstring.
+    Remaining arguments must be hashable (the same contract
+    ``lru_cache`` had).  Lookups are lock-protected; a concurrent miss
+    may build the same program twice (harmless — last insert wins), the
+    same semantics ``lru_cache`` has for in-flight calls.  The cached
+    value is wrapped by the retrace sentinel's builder tag so compiles
+    are attributable.
+    """
+
+    def deco(fn):
+        name = f"{fn.__module__}.{fn.__qualname__}"
+        limit = maxsize if maxsize is not None else config.PROGRAM_CACHE_SIZE
+
+        def wrapper(mesh, *args, **kwargs):
+            from ..analysis import runtime
+            key = (args, tuple(sorted(kwargs.items())) if kwargs else ())
+            with _lock:
+                table = _mesh_table(mesh)
+                lru = table.get(name)
+                if lru is None:
+                    lru = table[name] = OrderedDict()
+                hit = lru.get(key)
+                if hit is not None:
+                    lru.move_to_end(key)
+            if hit is not None:
+                runtime.note_builder(name, key, miss=False)
+                return hit
+            runtime.note_builder(name, key, miss=True)
+            built = fn(mesh, *args, **kwargs)
+            # the retrace identity includes the mesh: the same static key
+            # on another mesh (tests run 1/4/8-rank worlds side by side)
+            # legitimately compiles once per mesh
+            mesh_ident = (tuple(mesh.axis_names),
+                          tuple(d.id for d in mesh.devices.flat))
+            built = runtime.tag_program(name, built, (mesh_ident, key))
+            with _lock:
+                lru[key] = built
+                while len(lru) > limit:
+                    lru.popitem(last=False)
+            return built
+
+        def cache_clear(mesh=None):
+            with _lock:
+                if mesh is not None:
+                    _mesh_table(mesh).pop(name, None)
+                # without a mesh there is nothing global to clear —
+                # tables live on the meshes themselves
+
+        wrapper.cache_clear = cache_clear
+        # lru_cache-compatible introspection: the per-mesh, per-builder LRU
+        # bound (tests assert every factory in the package is bounded)
+        wrapper.cache_parameters = lambda: {"maxsize": limit, "typed": False}
+        wrapper.__wrapped__ = fn
+        wrapper.__name__ = fn.__name__
+        wrapper.__qualname__ = fn.__qualname__
+        wrapper.__doc__ = fn.__doc__
+        wrapper.__module__ = fn.__module__
+        wrapper._is_program_cache = True
+        return wrapper
+
+    return deco

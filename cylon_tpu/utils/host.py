@@ -15,6 +15,20 @@ from __future__ import annotations
 import numpy as np
 
 
+def _sanctioned_pull(kind: str):
+    """The DOCUMENTED device→host boundary: every framework host pull runs
+    inside this scope, so test sessions can run under
+    ``jax.transfer_guard_device_to_host("disallow")``
+    (``CYLON_TPU_TRACECHECK=1``) and still permit the sidecar pulls this
+    module funnels — any implicit D2H transfer *outside* this funnel is a
+    trace-safety violation.  Also feeds the per-op transfer ledger
+    (:func:`cylon_tpu.analysis.runtime.note_transfer`, rule RT303)."""
+    import jax
+    from ..analysis import runtime
+    runtime.note_transfer(kind)
+    return jax.transfer_guard_device_to_host("allow")
+
+
 def host_array(x) -> np.ndarray:
     """Materialize a (possibly multi-host row-sharded) array on this host."""
     if isinstance(x, np.ndarray):
@@ -23,8 +37,10 @@ def host_array(x) -> np.ndarray:
     if jax.process_count() > 1 and not getattr(x, "is_fully_addressable",
                                                True):
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-    return np.asarray(x)
+        with _sanctioned_pull("host_array"):
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    with _sanctioned_pull("host_array"):
+        return np.asarray(x)
 
 
 def host_arrays(xs) -> list:
@@ -38,7 +54,8 @@ def host_arrays(xs) -> list:
     if jax.process_count() > 1:
         return [None if x is None else host_array(x) for x in xs]
     devs = [x for x in xs if x is not None and not isinstance(x, np.ndarray)]
-    fetched = iter(jax.device_get(devs))
+    with _sanctioned_pull("host_arrays"):
+        fetched = iter(jax.device_get(devs))
     return [x if x is None or isinstance(x, np.ndarray) else next(fetched)
             for x in xs]
 
@@ -59,4 +76,5 @@ def sync_pull(arr) -> None:
     if _pull_fn is None:
         _pull_fn = jax.jit(
             lambda x: x.reshape(-1)[:4].astype(jnp.float32).sum())
-    np.asarray(_pull_fn(arr))
+    with _sanctioned_pull("sync_pull"):
+        np.asarray(_pull_fn(arr))

@@ -20,7 +20,15 @@ _GLOG_LEVELS = {0: logging.INFO, 1: logging.WARNING, 2: logging.ERROR,
 
 
 def set_log_level(level) -> None:
-    """glog-style int (0=INFO..3=FATAL), a logging level int, or a name."""
+    """glog-style int (0=INFO..3=FATAL), a logging level int, or a name.
+
+    Rejects bools explicitly: ``bool`` is an ``int`` subclass, so ``True``
+    would silently resolve as glog level 1 (WARNING) — almost certainly a
+    caller bug (``set_log_level(verbose)``), not a level choice."""
+    if isinstance(level, bool):
+        raise TypeError(
+            "set_log_level expects a glog int (0-3), logging int, or level "
+            f"name — got {level!r} (bool would alias glog level {int(level)})")
     if isinstance(level, str):
         lv = getattr(logging, level.upper())
     elif level in _GLOG_LEVELS:

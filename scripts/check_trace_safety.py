@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Trace-safety / SPMD-hazard lint gate (CI entry point).
+
+Usage:
+    python scripts/check_trace_safety.py [paths...]      # AST lint only
+    python scripts/check_trace_safety.py --strict        # lint + jaxpr pass
+    python scripts/check_trace_safety.py --list-rules
+
+Exit status: 0 when no findings, 1 when any rule fires (each printed as
+``file:line: RULE message``), 2 on usage errors.  ``--strict`` addition-
+ally traces every registered program builder over a virtual 8-device CPU
+mesh and verifies the jaxpr-level SPMD invariants (JX2xx) — tracing
+only, nothing compiles, so the gate stays fast enough to run before
+every test session (see ROADMAP.md tier-1 recipe).
+
+Rule catalog + suppression syntax: docs/trace_safety.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "cylon_tpu")],
+                    help="files/directories to lint (default: cylon_tpu/)")
+    ap.add_argument("--strict", action="store_true",
+                    help="also run the jaxpr verification pass over every "
+                         "registered builder")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="run only the jaxpr pass (skip the AST lint)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    # rules import is jax-free; keep the lint-only path light
+    from cylon_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+
+    findings = []
+    if not args.jaxpr:
+        from cylon_tpu.analysis.ast_lint import lint_paths
+        findings.extend(lint_paths(args.paths))
+
+    if args.strict or args.jaxpr:
+        # the jaxpr pass needs a mesh: force the virtual 8-device CPU rig
+        # BEFORE jax initializes a backend
+        flag = "--xla_force_host_platform_device_count=8"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import cylon_tpu as ct
+        from cylon_tpu.ctx.context import CPUMeshConfig
+        from cylon_tpu.analysis import jaxpr_check, registry
+        env = ct.CylonEnv(config=CPUMeshConfig())
+        decls = registry.collect()
+        if not decls:
+            print("error: no builders registered for the jaxpr pass",
+                  file=sys.stderr)
+            return 2
+        findings.extend(jaxpr_check.verify_all(env.mesh, decls))
+        checked = ", ".join(sorted({t for d in decls for t in d.tags}))
+        print(f"jaxpr pass: {len(decls)} builders verified ({checked})",
+              file=sys.stderr)
+
+    for f in findings:
+        print(f)
+    if findings:
+        counts = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        summary = ", ".join(f"{r}x{n}" for r, n in sorted(counts.items()))
+        print(f"\n{len(findings)} finding(s): {summary}", file=sys.stderr)
+        return 1
+    print("trace-safety: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,47 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# trace-safety sentinel (CYLON_TPU_TRACECHECK=1): every test runs under a
+# device→host transfer guard — the ONLY sanctioned implicit D2H pulls are
+# the cylon_tpu.utils.host funnel's (wrapped in explicit allow scopes) —
+# and the retrace sentinel counts XLA compiles per (builder, shape
+# signature); budget overruns (RT301/RT302) fail the session at exit.
+# Off by default so the plain tier-1 run is byte-identical.
+# ---------------------------------------------------------------------------
+TRACECHECK = os.environ.get("CYLON_TPU_TRACECHECK") == "1"
+
+if TRACECHECK:
+    from cylon_tpu.analysis import runtime as _rt
+    _rt.enable()
+
+
+@pytest.fixture(autouse=TRACECHECK)
+def _tracecheck_transfer_guard():
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not TRACECHECK:
+        return
+    from cylon_tpu.analysis import runtime as _rt
+    violations = _rt.check_budgets()
+    if violations:
+        rep = "\n".join(f"  {rule} {msg}" for rule, _b, msg in violations)
+        print(f"\n[tracecheck] retrace-sentinel violations:\n{rep}")
+        session.exitstatus = 1
+    else:
+        st = _rt.state()
+        n = sum(st.compiles.values())
+        print(f"\n[tracecheck] retrace sentinel clean: "
+              f"{n} compiling calls across {len(st.builds)} builders")
+
 
 @pytest.fixture(scope="session")
 def env8():

@@ -1,0 +1,24 @@
+"""TS101 fixture: host-sync calls inside a traced body — each one is a
+device→host round-trip per call (or a trace error under jit)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.utils.host import host_array
+
+shard_map = jax.shard_map
+
+
+def build(mesh):
+    def per_shard(vc, col):
+        counts = np.asarray(vc)          # TS101: implicit D2H pull
+        top = col.max().item()           # TS101: scalar host pull
+        host = host_array(col)           # TS101: the framework pull funnel
+        scale = float(jnp.sum(col))      # TS101: concretizing cast
+        _ = jax.device_get(vc)           # TS101: explicit D2H inside trace
+        return col * scale + counts[0] + top + host[0]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=None, out_specs=None))

@@ -1,0 +1,25 @@
+"""TS103 fixture: jax.jit wrapper without static_argnums for a parameter
+that drives Python control flow — tracers crash it, every distinct value
+retraces it."""
+
+import jax
+import jax.numpy as jnp
+
+
+def kernel(x, mode):
+    if mode == "double":                 # needs mode declared static
+        return x * 2
+    return x + 1
+
+
+jitted = jax.jit(kernel)                 # TS103: no static_argnums
+
+
+def good_kernel(x, mode):
+    if mode == "double":
+        return x * 2
+    return x + 1
+
+
+# properly declared: not flagged
+good = jax.jit(good_kernel, static_argnames=("mode",))

@@ -1,0 +1,25 @@
+"""TS104 fixture: program builder lru_cache'd on a live Mesh — the global
+cache pins the mesh (and every executable built for it) for the process
+lifetime, and object-identity keys silently recompile for rebuilt
+meshes."""
+
+from functools import lru_cache
+
+import jax
+from jax.sharding import Mesh
+
+shard_map = jax.shard_map
+
+
+@lru_cache(maxsize=256)
+def _builder_fn(mesh: Mesh, w: int, cap: int):   # TS104
+    def per_shard(col):
+        return col * w
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=None, out_specs=None))
+
+
+@lru_cache(maxsize=256)
+def _spec_fn(spec: tuple):                       # mesh-free: not flagged
+    return spec

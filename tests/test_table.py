@@ -81,7 +81,10 @@ def test_from_pandas_extension_dtypes(env4):
     real nulls, not stringified '<NA>' (regression: verify-drive finding)."""
     import pandas as pd
     df = pd.DataFrame({
-        "s": pd.array(["a", None, "b", None], dtype="str"),
+        # "string" (StringDtype) keeps pd.NA; plain "str" is a numpy
+        # str_ cast on pandas < 3 and stringifies None to "None" before
+        # the frame ever reaches cylon_tpu
+        "s": pd.array(["a", None, "b", None], dtype="string"),
         "i": pd.array([1, None, 3, 4], dtype="Int64"),
         "f": pd.array([1.5, 2.5, None, 4.0], dtype="Float64"),
         "b": pd.array([True, None, False, True], dtype="boolean"),
