@@ -25,10 +25,12 @@ BUILDER_MODULES = (
     "cylon_tpu.parallel.collectives",
     "cylon_tpu.parallel.shuffle",
     "cylon_tpu.relational.join",
+    "cylon_tpu.relational.piece",
     "cylon_tpu.relational.sort",
     "cylon_tpu.relational.groupby",
     "cylon_tpu.relational.setops",
     "cylon_tpu.relational.repart",
+    "cylon_tpu.exec.pipeline",
 )
 
 #: default bound on distinct compiled programs per builder per session

@@ -81,12 +81,14 @@ _CACHE_DIR = os.environ.get("CYLON_TPU_COMPILE_CACHE",
                             os.path.expanduser("~/.cache/cylon_tpu/jax"))
 if _cpu_only():
     _CACHE_DIR = ""
+COMPILE_CACHE_ENABLED = False
 if _CACHE_DIR not in ("", "0"):
     _CACHE_DIR = os.path.join(_CACHE_DIR, _machine_fingerprint())
     try:
         os.makedirs(_CACHE_DIR, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        COMPILE_CACHE_ENABLED = True
     except Exception:  # noqa: BLE001 — read-only fs: run uncached
         pass
 
@@ -100,6 +102,30 @@ def _env_flag(name: str, default: bool) -> bool:
 
 #: Print [BENCH] timing lines (reference: CYLON_BENCH_TIMER, util/macros.hpp:102).
 BENCH_TIMINGS = _env_flag("CYLON_TPU_BENCH", False)
+
+#: Phase-timing attribution mode (``CYLON_TPU_TIMING``).  ``block``
+#: (default): ``timing.maybe_block`` syncs the device inside each region
+#: so async work is charged to the phase that dispatched it — exact
+#: attribution, but it SERIALIZES piece production against join compute,
+#: perturbing exactly the overlap the pipeline exists for.  ``async``:
+#: regions record dispatch-only wall time and the caller blocks ONCE at
+#: iteration end (bench.py) — phase numbers stop hiding overlap.
+TIMING_ASYNC = os.environ.get("CYLON_TPU_TIMING", "block") == "async"
+
+#: Consume range pieces as PACKED windows (relational/piece.PackedPiece):
+#: the pipelined join slices + unpacks lanes INSIDE the jitted join
+#: program instead of materializing each piece to full-width HBM columns
+#: and re-packing.  Off = the seed's materialize-then-join path (kept as
+#: the equivalence reference; tests compare the two exactly).
+PACKED_PIECES = _env_flag("CYLON_TPU_PACKED_PIECES", True)
+
+#: AOT pre-compile (lower().compile()) the per-piece join programs for
+#: every distinct piece-capacity pair BEFORE the range loop, so a
+#: mid-stream capacity change never stalls dispatch on a compile.  The
+#: AOT executable lands in the persistent compile cache (the in-process
+#: jit call path re-loads it from there), so this only pays off where
+#: that cache is enabled — accelerator processes; CPU runs skip it.
+PREWARM_PIECE_PROGRAMS = _env_flag("CYLON_TPU_PREWARM", True)
 
 #: Round variable capacities up to powers of two to bound recompilation.
 POW2_CAPACITIES = _env_flag("CYLON_TPU_POW2_CAPS", True)

@@ -242,20 +242,49 @@ class DeferredTable(Table):
     answer from stored metadata so DataFrame-level bookkeeping does not
     force materialization; ``column()``/``columns`` do."""
 
-    __slots__ = ("_thunk", "_cap", "_meta", "op_state")
+    __slots__ = ("_thunk", "_cap", "_meta", "op_state", "_counts_thunk")
 
-    def __init__(self, env, valid_counts, capacity: int, thunk,
-                 meta, op_state=None):
+    def __init__(self, env, valid_counts, capacity: int | None, thunk,
+                 meta, op_state=None, counts_thunk=None):
         """``meta`` = (names, types, dicts, has_nulls) tuples parallel to
         the eventual columns; ``thunk()`` -> dict[str, Column]; ``op_state``
         is consumed by fused downstream operators (cleared on
-        materialization)."""
+        materialization).
+
+        ``counts_thunk`` (with ``valid_counts=None``): the per-shard output
+        counts are still on device — the producer dispatched its count
+        phase but did NOT pull the result, so the NEXT operator's dispatch
+        can be enqueued before this one's host sync (the pipelined piece
+        loop's one-deep software pipeline).  First access of
+        ``valid_counts``/``row_count``/``capacity`` pulls; a fused consumer
+        that drains ``op_state`` never does."""
         self._thunk = None
+        self._counts_thunk = None
+        if valid_counts is None:
+            if counts_thunk is None:
+                raise InvalidError("DeferredTable needs valid_counts or "
+                                   "counts_thunk")
+            valid_counts = np.zeros(
+                (env or default_env()).world_size, np.int64)
         super().__init__({}, env, valid_counts)
-        self._cap = int(capacity)
+        self._counts_thunk = counts_thunk
+        self._cap = None if capacity is None else int(capacity)
         self._meta = meta
         self._thunk = thunk
         self.op_state = op_state
+
+    # _valid shadows the Table slot: reads pull the pending device counts
+    @property
+    def _valid(self):
+        if self._counts_thunk is not None:
+            th, self._counts_thunk = self._counts_thunk, None
+            Table._valid.__set__(self, np.asarray(th(), np.int64))
+        return Table._valid.__get__(self)
+
+    @_valid.setter
+    def _valid(self, v):
+        self._counts_thunk = None
+        Table._valid.__set__(self, v)
 
     # _cols shadows the Table slot: reads trigger materialization
     @property
@@ -299,6 +328,14 @@ class DeferredTable(Table):
 
     @property
     def capacity(self) -> int:
+        if self._cap is None:
+            # capacity prediction pending on the device counts (lazy-count
+            # deferred join): pull and bucket exactly like the producer
+            # would have
+            from .. import config
+            counts = self._valid
+            self._cap = config.pow2ceil(int(counts.max())
+                                        if counts.size else 1)
         return self._cap
 
     @property

@@ -2,5 +2,6 @@
 re-think of the reference's streaming operator DAG (cpp/src/cylon/ops/,
 SURVEY.md §2 C9)."""
 
+from ..relational.piece import PackedPiece, PieceSource  # noqa: F401
 from .pipeline import (GroupBySink, chunk_table,  # noqa: F401
                        pipelined_join, pipelined_set_op)

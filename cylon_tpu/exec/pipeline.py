@@ -26,6 +26,8 @@ Degenerate case C=1 equals the monolithic operator exactly.
 
 from __future__ import annotations
 
+from collections.abc import Sequence
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,6 +41,7 @@ from ..ctx.context import ROW_AXIS
 from ..relational.common import (PAD_L, REP, ROW, check_same_env,
                                  promote_key_pair)
 from ..relational.join import join_tables
+from ..relational.piece import PackedPiece, PieceSource  # noqa: F401
 from ..relational.repart import concat_tables, shuffle_table
 from ..status import InvalidError
 
@@ -61,10 +64,51 @@ def _chunk_fn(mesh: Mesh, cap: int, step: int):
                              in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
 
 
-def chunk_table(table: Table, n_chunks: int) -> list[Table]:
+class _LazyChunks(Sequence):
+    """Dispatch-on-demand chunk views of one table: ``chunks[i]`` slices
+    chunk i when (and each time) it is accessed, so a streaming consumer
+    holds ONE chunk's arrays live at a time — the seed dispatched every
+    chunk before any consumer ran, pinning all slices at once (the peak
+    the pipelined ops' docstrings promise to avoid).  Re-indexing
+    re-dispatches: slices are cheap and deterministic."""
+
+    def __init__(self, table: Table, n_chunks: int, step: int):
+        self._table = table
+        self._n = int(n_chunks)
+        self._step = int(step)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if i < 0:
+            i += self._n
+        if not 0 <= i < self._n:
+            raise IndexError(i)
+        t = self._table
+        items = list(t.columns.items())
+        fn = _chunk_fn(t.env.mesh, t.capacity, self._step)
+        start = i * self._step
+        # chunk validity = how much of each shard's live prefix falls
+        # inside [start, start+step)
+        vc = np.clip(t.valid_counts - start, 0, self._step)
+        out_d, out_v = fn(np.int32(start),
+                          tuple(c.data for _, c in items),
+                          tuple(c.validity for _, c in items))
+        cols = {}
+        for (n, c), d, v in zip(items, out_d, out_v):
+            cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
+        return Table(cols, t.env, vc.astype(np.int64))
+
+
+def chunk_table(table: Table, n_chunks: int) -> Sequence:
     """Split each shard's valid prefix into ``n_chunks`` contiguous row
     ranges; chunk i is a Table holding every shard's i-th range (so the
-    concatenation of chunks in order re-covers the table, per shard)."""
+    concatenation of chunks in order re-covers the table, per shard).
+    Returns a lazy sequence: each chunk's device slice dispatches on
+    access, not up front."""
     if n_chunks <= 1:
         return [table]
     from ..relational.repart import repad_table
@@ -72,23 +116,7 @@ def chunk_table(table: Table, n_chunks: int) -> list[Table]:
     step = -(-cap // n_chunks)
     if step * n_chunks != cap:      # make every window in-bounds
         table = repad_table(table, step * n_chunks)
-        cap = step * n_chunks
-    items = list(table.columns.items())
-    datas = tuple(c.data for _, c in items)
-    valids = tuple(c.validity for _, c in items)
-    fn = _chunk_fn(table.env.mesh, cap, step)
-    out = []
-    for i in range(n_chunks):
-        start = i * step
-        # chunk validity = how much of each shard's live prefix falls
-        # inside [start, start+step)
-        vc = np.clip(table.valid_counts - start, 0, step)
-        out_d, out_v = fn(np.int32(start), datas, valids)
-        cols = {}
-        for (n, c), d, v in zip(items, out_d, out_v):
-            cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
-        out.append(Table(cols, table.env, vc.astype(np.int64)))
-    return out
+    return _LazyChunks(table, n_chunks, step)
 
 
 def pipelined_set_op(a: Table, b: Table, op: str, n_chunks: int = 4):
@@ -353,7 +381,9 @@ def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
                                pad_key=PAD_L, need_null_flags=need_nf,
                                narrow32=narrow)
         ge = pack.rows_ge_splitters(ko, tuple(sops))
-        tgt = jnp.sum(ge, axis=1).astype(jnp.int32)
+        # pinned accumulator: jnp.sum(bool) defaults to int64 under x64 —
+        # a row-scale widening the jaxpr pass (JX203) flags
+        tgt = jnp.sum(ge, axis=1, dtype=jnp.int32)
         tgt = jnp.where(mask, tgt, jnp.int32(n_ranges))
         counts = jnp.zeros(n_ranges + 1, jnp.int32).at[tgt].add(1)
         return tgt, counts[:n_ranges]
@@ -361,105 +391,6 @@ def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
     in_specs = (REP, ROW, ROW) + (ROW,) * n_ops
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                              out_specs=(ROW, ROW)))
-
-
-@program_cache()
-def _piece_pack_fn(mesh: Mesh, spec, pad: int):
-    from ..ops import lanes
-
-    def per_shard(datas, valids):
-        mat = lanes.pack_lanes(spec, list(datas), list(valids))
-        if pad:
-            mat = jnp.concatenate(
-                [mat, jnp.zeros((pad, mat.shape[1]), mat.dtype)])
-        return mat
-
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW, ROW),
-                             out_specs=ROW))
-
-
-@program_cache()
-def _pad_rows_fn(mesh: Mesh, pad: int):
-    def per_shard(d):
-        return jnp.concatenate([d, jnp.zeros((pad,), d.dtype)]) if pad else d
-
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
-                             out_specs=ROW))
-
-
-@program_cache()
-def _piece_slice_fn(mesh: Mesh, spec, piece_cap: int):
-    """Each shard's contiguous window [start, start+piece_cap) of the
-    once-packed lane matrix (+f64 side arrays): dynamic slices, no gathers.
-    The matrix is padded by the max piece capacity, so slices never clamp."""
-    from ..ops import lanes
-
-    has_mat = spec.n_lanes > 0
-    n_f64 = sum(1 for cl in spec.cols if not cl.lanes)
-
-    def per_shard(starts, *arrs):
-        my = jax.lax.axis_index(ROW_AXIS)
-        s = starts[my]
-        if has_mat:
-            mat, f64s = arrs[0], arrs[1:]
-            sub = jax.lax.dynamic_slice(mat, (s, jnp.int32(0)),
-                                        (piece_cap, spec.n_lanes))
-            datas, valids = lanes.unpack_lanes(spec, sub)
-            datas, valids = list(datas), list(valids)
-        else:
-            f64s = arrs
-            datas = [None] * len(spec.cols)
-            valids = [None] * len(spec.cols)
-        j = 0
-        for i, cl in enumerate(spec.cols):
-            if not cl.lanes:
-                datas[i] = jax.lax.dynamic_slice(f64s[j], (s,), (piece_cap,))
-                j += 1
-        return tuple(datas), tuple(valids)
-
-    in_specs = (REP,) + (ROW,) * (int(has_mat) + n_f64)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                             out_specs=(ROW, ROW)))
-
-
-class _PieceSource:
-    """Range-piece provider over a resident sorted table: the table's
-    columns pack into ONE u32 lane matrix up front (padded by the largest
-    piece capacity so windows never clamp); each piece is then a per-shard
-    ``dynamic_slice`` — the per-piece cost is proportional to the PIECE,
-    not the table.  The caller should drop its reference to the source
-    table: the matrix (plus f64 side arrays) carries everything."""
-
-    def __init__(self, table: Table, pad: int, drop: tuple = ()):
-        from ..relational.common import table_lane_spec
-        self.env = table.env
-        items = [(n, c) for n, c in table.columns.items() if n not in drop]
-        cols = [c for _, c in items]
-        self.spec = table_lane_spec(cols)
-        self.meta = [
-            (n, c.type, c.dictionary,
-             (min(c.bounds[0], 0), max(c.bounds[1], 0))
-             if c.bounds is not None else None)
-            for n, c in items]
-        mesh = self.env.mesh
-        self.arrs = []
-        if self.spec.n_lanes:
-            self.arrs.append(_piece_pack_fn(mesh, self.spec, pad)(
-                tuple(c.data for c in cols),
-                tuple(c.validity for c in cols)))
-        for c, cl in zip(cols, self.spec.cols):
-            if not cl.lanes:
-                self.arrs.append(_pad_rows_fn(mesh, pad)(c.data))
-        self.arrs = tuple(self.arrs)
-
-    def piece(self, starts: np.ndarray, lens: np.ndarray) -> Table:
-        piece_cap = config.pow2ceil(max(int(lens.max(initial=0)), 1))
-        fn = _piece_slice_fn(self.env.mesh, self.spec, piece_cap)
-        out_d, out_v = fn(starts.astype(np.int32), *self.arrs)
-        cols = {}
-        for (n, t, dc, nb), d, v in zip(self.meta, out_d, out_v):
-            cols[n] = Column(d, t, v, dc, bounds=nb)
-        return Table(cols, self.env, lens.astype(np.int64))
 
 
 def pipelined_join(left: Table, right: Table, left_on, right_on,
@@ -590,36 +521,75 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     l_starts = np.concatenate([np.zeros((w, 1), np.int64),
                                np.cumsum(pcounts, axis=1)], axis=1)[:, :-1]
 
-    def max_piece_cap(lens_by_range):
-        caps = [config.pow2ceil(max(int(lens_by_range[:, r].max()), 1))
-                for r in range(n_ranges)]
-        return max(caps)
+    # all per-range pow2 piece capacities are host-known UP FRONT — the
+    # static shape family of every slice/join program the loop will need
+    caps_l = [config.pow2ceil(max(int(pcounts[:, r].max()), 1))
+              for r in range(n_ranges)]
+    caps_r = [config.pow2ceil(max(int(r_lens[:, r].max()), 1))
+              for r in range(n_ranges)]
 
     with timing.region("pipe.pack"):
-        src_l = _PieceSource(lsorted, max_piece_cap(pcounts), drop=(tmp,))
-        src_r = _PieceSource(rsorted, max_piece_cap(r_lens))
+        src_l = PieceSource(lsorted, max(caps_l), drop=(tmp,))
+        src_r = PieceSource(rsorted, max(caps_r))
         timing.maybe_block(src_r.arrs)
     del lsorted, rsorted
 
-    outs = []
-    for r in range(n_ranges):
-        any_l = pcounts[:, r].sum() > 0
-        any_r = r_lens[:, r].sum() > 0
-        if how == "inner" and not (any_l and any_r):
-            continue
-        if how == "left" and not any_l:
-            continue
-        if how == "right" and not any_r:
-            continue
-        if how == "outer" and not (any_l or any_r):
-            continue
+    packed = config.PACKED_PIECES
+
+    def make_pieces(r):
+        """Pieces for range r: packed window descriptors (free — the
+        slice+unpack runs inside the join program) or, with the packed
+        path disabled, the seed's materialized window tables."""
+        if packed:
+            return (src_l.packed(l_starts[:, r], pcounts[:, r], caps_l[r]),
+                    src_r.packed(r_starts[:, r], r_lens[:, r], caps_r[r]))
         with timing.region("pipe.piece_slice"):
             piece_l = src_l.piece(l_starts[:, r], pcounts[:, r])
             piece_r = src_r.piece(r_starts[:, r], r_lens[:, r])
             timing.maybe_block(next(iter(piece_r.columns.values())).data)
-        res_r = join_tables(piece_l, piece_r, left_on, right_on, how=how,
-                            suffixes=suffixes, assume_colocated=True,
-                            allow_defer=(sink is not None))
+        return piece_l, piece_r
+
+    def qualifies(r):
+        any_l = pcounts[:, r].sum() > 0
+        any_r = r_lens[:, r].sum() > 0
+        return {"inner": any_l and any_r, "left": any_l,
+                "right": any_r, "outer": any_l or any_r}[how]
+
+    live_ranges = [r for r in range(n_ranges) if qualifies(r)]
+
+    if packed and live_ranges:
+        # pre-warm: with the capacities known, every distinct join
+        # program can AOT-compile BEFORE the range loop (while the probe
+        # sort still occupies the device) instead of stalling dispatch
+        # mid-stream.  No-op where the persistent compile cache is off.
+        from ..relational.join import prewarm_packed_join
+        warmed = set()
+        for r in live_ranges:
+            # the program's static key includes the all-live class (lens
+            # exactly at capacity drops the liveness operand), not just
+            # the capacity pair — dedupe on the same signature
+            key = (caps_l[r], caps_r[r],
+                   bool((pcounts[:, r] == caps_l[r]).all()
+                        and (r_lens[:, r] == caps_r[r]).all()))
+            if key in warmed:
+                continue
+            warmed.add(key)
+            pl0, pr0 = make_pieces(r)
+            prewarm_packed_join(pl0, pr0, left_on, right_on, how, suffixes,
+                                allow_defer=(sink is not None))
+
+    outs = []
+    for r in live_ranges:
+        piece_l, piece_r = make_pieces(r)
+        with timing.region("pipe.piece_join"):
+            # packed pieces: slice + key unpack are fused into this
+            # dispatch; with a sink the counts stay on device, so piece
+            # r+1's programs enqueue before piece r's host sync (the
+            # one-deep software pipeline now spans the WHOLE piece chain)
+            res_r = join_tables(piece_l, piece_r, left_on, right_on,
+                                how=how, suffixes=suffixes,
+                                assume_colocated=True,
+                                allow_defer=(sink is not None))
         with timing.region("pipe.consume"):
             out_r = sink(res_r) if sink is not None else res_r
         outs.append(out_r)
@@ -627,8 +597,12 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
         # no range qualified (e.g. inner join, no overlapping keys at all):
         # one empty piece pair keeps the output schema path uniform
         zeros = np.zeros(w, np.int64)
-        piece_l = src_l.piece(zeros, zeros)
-        piece_r = src_r.piece(zeros, zeros)
+        if packed:
+            piece_l = src_l.packed(zeros, zeros, 1)
+            piece_r = src_r.packed(zeros, zeros, 1)
+        else:
+            piece_l = src_l.piece(zeros, zeros)
+            piece_r = src_r.piece(zeros, zeros)
         res_r = join_tables(piece_l, piece_r, left_on, right_on, how=how,
                             suffixes=suffixes, assume_colocated=True,
                             allow_defer=False)
@@ -641,3 +615,49 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
         # hash-colocated: the concatenation keeps the grouped contract
         out.grouped_by = tuple(left_on)
     return out
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the pipeline's
+# own programs are pure-local shard programs — slicing, key-operand
+# packing and prefix scans; the exchanges happen upstream in
+# parallel/shuffle.py.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_chunk(mesh):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    fn = _unwrap(_chunk_fn(mesh, 1024, 256))
+    datas = (S((w * 1024,), np.int64), S((w * 1024,), np.float64))
+    valids = (S((w * 1024,), np.bool_), None)
+    return jax.make_jaxpr(fn)(S((), np.int32), datas, valids)
+
+
+def _trace_range_bounds(mesh):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    n_ops = _n_key_ops(("int32",), (False,), (False,))
+    fn = _unwrap(_range_bounds_fn(mesh, 4, (False,), (False,), n_ops))
+    vc = S((w,), np.int32)
+    return jax.make_jaxpr(fn)(vc, (S((w * 1024,), np.int32),), (None,))
+
+
+def _trace_probe_targets(mesh):
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    n_ranges = 4
+    n_ops = _n_key_ops(("int32",), (False,), (False,))
+    fn = _unwrap(_probe_targets_fn(mesh, n_ranges, (False,), (False,),
+                                   n_ops))
+    vc = S((w,), np.int32)
+    sops = tuple(S((w * (n_ranges - 1),), np.int32) for _ in range(n_ops))
+    return jax.make_jaxpr(fn)(vc, (S((w * 1024,), np.int32),), (None,),
+                              *sops)
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._chunk_fn", _trace_chunk, tags=("pipeline",))
+declare_builder(f"{__name__}._range_bounds_fn", _trace_range_bounds,
+                tags=("pipeline",))
+declare_builder(f"{__name__}._probe_targets_fn", _trace_probe_targets,
+                tags=("pipeline",))

@@ -161,6 +161,31 @@ def unpack_lanes(spec: LaneSpec, mat):
     return tuple(datas), tuple(valids)
 
 
+def slice_lanes(spec: LaneSpec, mat, start, window: int):
+    """Contiguous window ``[start, start+window)`` of the lane matrix as a
+    dynamic slice (no gather).  The caller guarantees the matrix is padded
+    so the window never clamps (see exec/pipeline piece sources)."""
+    return jax.lax.dynamic_slice(mat, (start, jnp.int32(0)),
+                                 (window, spec.n_lanes))
+
+
+def unpack_column(spec: LaneSpec, mat, i: int):
+    """Lazily unpack ONE column ``i`` from the lane matrix: ``(data,
+    valid)``, either None when the column is laneless (f64 side channel) /
+    planned without validity.  The point versus :func:`unpack_lanes`: a
+    consumer that reads only the key columns of a packed piece touches
+    only their lanes — every other column's unpack never enters the
+    program (XLA sees no use of those lanes)."""
+    col = spec.cols[i]
+    d = _from_lanes([mat[:, li] for li in col.lanes], col.dtype,
+                    col.narrow) if col.lanes else None
+    v = None
+    if col.valid_bit >= 0:
+        vl = mat[:, spec.valid_lane0 + col.valid_bit // 32]
+        v = ((vl >> jnp.uint32(col.valid_bit % 32)) & 1) != 0
+    return d, v
+
+
 def gather_laneless(spec: LaneSpec, datas, take) -> dict:
     """{col_index: gathered data} for ONLY the laneless (f64) columns of
     ``spec`` — one batched (n, K) f64 matrix gather.  Used by the join's

@@ -59,7 +59,15 @@ _SEG_CACHE = BoundedCache()
 
 class JoinState(NamedTuple):
     """Pre-expansion inner-join state a DeferredTable carries for fused
-    consumers (built in relational/join.py; device arrays stay sharded)."""
+    consumers (built in relational/join.py; device arrays stay sharded).
+
+    Two producers emit this state: the monolithic deferred join (lane
+    specs over the output-plan column lists) and the PACKED-PIECE join
+    (relational/piece.py — lane specs are the piece sources' own specs
+    and ``pl_s`` holds the sorted WINDOW lanes, so the groupby pushdown
+    consumes range pieces without any piece ever materializing; columns
+    the aggregation never reads are never unpacked).  The fused kernel is
+    agnostic: ``plan``/``lspec``/``rspec`` are self-consistent in both."""
     vcl: np.ndarray      # left per-shard valid counts
     vcr: np.ndarray      # right per-shard valid counts
     idx_s: jax.Array     # (N,) concat-row index at each sorted position

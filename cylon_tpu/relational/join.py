@@ -44,6 +44,7 @@ from .common import (PAD_L, PAD_R, REP, ROW, BoundedCache, build_table,
                      check_same_env,
                      sample_positions,
                      col_arrays, live_mask, narrow32_flags, promote_key_pair)
+from .piece import PackedPiece
 from .repart import shuffle_table
 
 shard_map = jax.shard_map
@@ -466,36 +467,495 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                                               tk.r_take)
             r_ok = tk.r_take >= 0
 
-        def side_out(datas, vals, ok, i, needs_valid):
-            d = datas[i]
-            if not needs_valid:
-                return d, None
-            v = ok if vals[i] is None else (ok & vals[i])
-            return d, v
-
-        out_d, out_v = [], []
-        for entry in plan:
-            if entry[0] == "k":
-                _, i, j, needs_valid = entry
-                dl, vl = side_out(ldat, lval, l_ok, i, True)
-                dr, vr = side_out(rdat, rval, r_ok, j, True)
-                d = jnp.where(l_ok, dl, dr)
-                v = jnp.where(l_ok, vl, vr)
-                out_d.append(d)
-                out_v.append(v if needs_valid else None)
-            else:
-                side, i, needs_valid = entry
-                datas, vals, ok = ((ldat, lval, l_ok) if side == "l"
-                                   else (rdat, rval, r_ok))
-                d, v = side_out(datas, vals, ok, i, needs_valid)
-                out_d.append(d)
-                out_v.append(v)
-        return tuple(out_d), tuple(out_v)
+        return _plan_outputs(plan, ldat, lval, l_ok, rdat, rval, r_ok)
 
     return jax.jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(ROW, ROW, ROW, ROW, ROW, ROW),
         out_specs=(ROW, ROW)))
+
+
+def _plan_outputs(plan, ldat, lval, l_ok, rdat, rval, r_ok):
+    """Assemble the output (datas, valids) from per-side gathered columns
+    per the static ``plan`` (traced; shared by the materialize programs)."""
+
+    def side_out(datas, vals, ok, i, needs_valid):
+        d = datas[i]
+        if not needs_valid:
+            return d, None
+        v = ok if vals[i] is None else (ok & vals[i])
+        return d, v
+
+    out_d, out_v = [], []
+    for entry in plan:
+        if entry[0] == "k":
+            _, i, j, needs_valid = entry
+            dl, vl = side_out(ldat, lval, l_ok, i, True)
+            dr, vr = side_out(rdat, rval, r_ok, j, True)
+            d = jnp.where(l_ok, dl, dr)
+            v = jnp.where(l_ok, vl, vr)
+            out_d.append(d)
+            out_v.append(v if needs_valid else None)
+        else:
+            side, i, needs_valid = entry
+            datas, vals, ok = ((ldat, lval, l_ok) if side == "l"
+                               else (rdat, rval, r_ok))
+            d, v = side_out(datas, vals, ok, i, needs_valid)
+            out_d.append(d)
+            out_v.append(v)
+    return tuple(out_d), tuple(out_v)
+
+
+# ---------------------------------------------------------------------------
+# packed-piece entry: joins that consume PackedPiece window descriptors
+# (relational/piece.py) — the range-partitioned pipeline's fast path.  The
+# window slice and lane unpack happen INSIDE the jitted join program,
+# fused with key-operand construction: keys unpack first, payload lanes
+# ride the phase-1 sort and unpack lazily in the carry/materialize stage.
+# The seed's materialize-then-join path (PackedPiece.to_table + the normal
+# colocated join) is the reference these programs are exactly equal to.
+# ---------------------------------------------------------------------------
+
+def _window(spec: lanes.LaneSpec, arrs, s, cap: int):
+    """(lane-matrix window | None, tuple of f64 windows) of one side's
+    packed arrays at per-shard offset ``s`` — dynamic slices only; XLA
+    drops any window a consumer never reads."""
+    has_mat = spec.n_lanes > 0
+    mat = lanes.slice_lanes(spec, arrs[0], s, cap) if has_mat else None
+    f64w = tuple(jax.lax.dynamic_slice(a, (s,), (cap,))
+                 for a in arrs[int(has_mat):])
+    return mat, f64w
+
+
+def _window_keys(spec: lanes.LaneSpec, mat, f64w, key_idx: tuple):
+    """Unpack ONLY the key columns from a window — the fused half of the
+    seed's unpack-everything + re-pack-keys round trip."""
+    fpos = {i: j for j, i in enumerate(
+        i for i, c in enumerate(spec.cols) if not c.lanes)}
+    datas, valids = [], []
+    for i in key_idx:
+        if spec.cols[i].lanes:
+            d, v = lanes.unpack_column(spec, mat, i)
+        else:
+            d = f64w[fpos[i]]
+            v = lanes.unpack_column(spec, mat, i)[1] if spec.n_lanes \
+                else None
+        datas.append(d)
+        valids.append(v)
+    return datas, valids
+
+
+@program_cache()
+def _packed_count_fn(mesh: Mesh, how: str, narrow: tuple, need_nf: tuple,
+                     lspec: lanes.LaneSpec, rspec: lanes.LaneSpec,
+                     kil: tuple, kir: tuple, cap_l: int, cap_r: int,
+                     n_arrs_l: int, n_arrs_r: int, all_live: bool,
+                     carry_emit: bool, carry_match: bool,
+                     slim: bool = False):
+    """Phase 1 over packed windows: slice both windows, unpack only the
+    KEY columns, sort once, return per-shard exact counts + carried state.
+    With ``carry_emit``/``carry_match`` the window's OWN lanes ride the
+    sort as payload — there is no separate pack step at all (the windows
+    already are lane matrices)."""
+
+    def per_shard(vcl, vcr, sl, sr, *arrs):
+        arrs_l, arrs_r = arrs[:n_arrs_l], arrs[n_arrs_l:]
+        my = jax.lax.axis_index(ROW_AXIS)
+        mat_l, f64_l = _window(lspec, arrs_l, sl[my], cap_l)
+        mat_r, f64_r = _window(rspec, arrs_r, sr[my], cap_r)
+        l_datas, l_valids = _window_keys(lspec, mat_l, f64_l, kil)
+        r_datas, r_valids = _window_keys(rspec, mat_r, f64_r, kir)
+        mask_l = None if all_live else live_mask(vcl, cap_l)
+        mask_r = None if all_live else live_mask(vcr, cap_r)
+        ko_l = pack.key_operands(l_datas, l_valids, row_mask=mask_l,
+                                 pad_key=PAD_L, need_null_flags=need_nf,
+                                 narrow32=narrow)
+        ko_r = pack.key_operands(r_datas, r_valids, row_mask=mask_r,
+                                 pad_key=PAD_R, need_null_flags=need_nf,
+                                 narrow32=narrow)
+        payloads = ()
+        if carry_emit:
+            zr = jnp.zeros(cap_r, jnp.uint32)
+            payloads += tuple(jnp.concatenate([mat_l[:, j], zr])
+                              for j in range(lspec.n_lanes))
+        if carry_match:
+            zl = jnp.zeros(cap_l, jnp.uint32)
+            payloads += tuple(jnp.concatenate([zl, mat_r[:, j]])
+                              for j in range(rspec.n_lanes))
+        bnd, idx_s, pl_s = joink.join_sort_state(ko_l, ko_r, payloads)
+        live_cat = None if all_live else jnp.concatenate([mask_l, mask_r])
+        n, carry = joink.join_carry(bnd, idx_s, live_cat, cap_l, how)
+        if slim:
+            return (n.reshape(1), idx_s, bnd) + pl_s
+        return (n.reshape(1),) + tuple(carry) + pl_s
+
+    n_pl = (lspec.n_lanes if carry_emit else 0) + \
+        (rspec.n_lanes if carry_match else 0)
+    n_out = (3 + n_pl) if slim else (7 + n_pl)
+    in_specs = (REP, REP, REP, REP) + (ROW,) * (n_arrs_l + n_arrs_r)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                             out_specs=(ROW,) * n_out))
+
+
+@program_cache()
+def _packed_materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
+                           cap_r: int, plan: tuple,
+                           lspec: lanes.LaneSpec, rspec: lanes.LaneSpec,
+                           n_arrs_l: int, n_arrs_r: int,
+                           carry_emit: bool, carry_match: bool):
+    """Phase 2 over packed windows.  Carried sides unpack from the sorted
+    payload lanes exactly like :func:`_materialize_fn`; non-carried sides
+    gather whole rows from the WINDOW lane matrix (one (out, L) gather —
+    the matrix already exists, so there is no pack step) and unpack only
+    at the output rows.  f64 side columns slice their window and gather by
+    take index (carry-LITE, same as the monolith)."""
+
+    l_f64 = any(not c.lanes for c in lspec.cols)
+    r_f64 = any(not c.lanes for c in rspec.cols)
+
+    def f64_pick(spec, f64w, take):
+        # spread the compact window list back to spec column slots so the
+        # ONE laneless-gather implementation (lanes.gather_laneless)
+        # serves both the packed and the monolithic materialize paths
+        datas = [None] * len(spec.cols)
+        wins = iter(f64w)
+        for i, c in enumerate(spec.cols):
+            if not c.lanes:
+                datas[i] = next(wins)
+        return lanes.gather_laneless(spec, datas, take)
+
+    def per_shard(carry, pl_s, sl, sr, *arrs):
+        arrs_l, arrs_r = arrs[:n_arrs_l], arrs[n_arrs_l:]
+        my = jax.lax.axis_index(ROW_AXIS)
+        n_e = lspec.n_lanes if carry_emit else 0
+        pl_e, pl_m = pl_s[:n_e], pl_s[n_e:]
+        tk = joink.join_take(joink.JoinCarry(*carry), cap_l, how, out_cap,
+                             extra=pl_e, carry_emit=carry_emit,
+                             carry_match=carry_match,
+                             emit_idx=carry_emit and l_f64,
+                             match_idx=carry_match and r_f64)
+        mat_l, f64_l = _window(lspec, arrs_l, sl[my], cap_l)
+        mat_r, f64_r = _window(rspec, arrs_r, sr[my], cap_r)
+        if carry_emit:
+            emat = jnp.stack(tk.extra, axis=1)      # already at out slots
+            ldat, lval = lanes.unpack_lanes(lspec, emat)
+            l_ok = tk.valid
+            if l_f64:
+                ldat = list(ldat)
+                for i, d in f64_pick(lspec, f64_l, tk.l_take).items():
+                    ldat[i] = d
+        else:
+            l_ok = tk.l_take >= 0
+            if lspec.n_lanes:
+                lrows = mat_l[jnp.clip(tk.l_take, 0, cap_l - 1)]
+                ldat, lval = lanes.unpack_lanes(lspec, lrows)
+                ldat, lval = list(ldat), list(lval)
+            else:
+                ldat = [None] * len(lspec.cols)
+                lval = [None] * len(lspec.cols)
+            for i, d in f64_pick(lspec, f64_l, tk.l_take).items():
+                ldat[i] = d
+        if carry_match:
+            smat = jnp.stack(pl_m, axis=1)          # (N, Lr) sorted lanes
+            rrows = smat[jnp.clip(tk.mpos, 0, smat.shape[0] - 1)]
+            rdat, rval = lanes.unpack_lanes(rspec, rrows)
+            r_ok = tk.matched
+            if r_f64:
+                rdat = list(rdat)
+                for i, d in f64_pick(rspec, f64_r, tk.r_take).items():
+                    rdat[i] = d
+        else:
+            r_ok = tk.r_take >= 0
+            if rspec.n_lanes:
+                rrows = mat_r[jnp.clip(tk.r_take, 0, cap_r - 1)]
+                rdat, rval = lanes.unpack_lanes(rspec, rrows)
+                rdat, rval = list(rdat), list(rval)
+            else:
+                rdat = [None] * len(rspec.cols)
+                rval = [None] * len(rspec.cols)
+            for i, d in f64_pick(rspec, f64_r, tk.r_take).items():
+                rdat[i] = d
+        return _plan_outputs(plan, ldat, lval, l_ok, rdat, rval, r_ok)
+
+    in_specs = (ROW, ROW, REP, REP) + (ROW,) * (n_arrs_l + n_arrs_r)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                             out_specs=(ROW, ROW)))
+
+
+def _fits32_meta(dtype, bounds) -> bool:
+    """fits_int32 over piece metadata (physical dtype name + host bounds)."""
+    dt = np.dtype(dtype)
+    if dt.itemsize != 8 or dt.kind not in ("i", "u"):
+        return False
+    return bounds is not None and bounds[0] >= -(1 << 31) \
+        and bounds[1] <= (1 << 31) - 1
+
+
+def _same_dictionary(a, b) -> bool:
+    if a is b:
+        return True
+    if a is None or b is None:
+        return False
+    if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
+        return len(a) == len(b) and bool(np.array_equal(a, b))
+    try:
+        return bool(a == b)
+    except Exception:  # noqa: BLE001 — exotic dictionary types: identity only
+        return False
+
+
+def _packed_keys_compatible(pl: PackedPiece, pr: PackedPiece,
+                            left_on, right_on) -> bool:
+    """Packed joins cannot promote keys inside the lanes — the pipeline
+    promotes BEFORE packing, so pieces normally arrive aligned.  Any
+    residual mismatch (dtype, dictionary code space) bails to the
+    materialized path, which promotes like any other join."""
+    for ln, rn in zip(left_on, right_on):
+        i, j = pl.column_names.index(ln), pr.column_names.index(rn)
+        if pl.spec.cols[i].dtype != pr.spec.cols[j].dtype:
+            return False
+        tl, tr = pl.meta[i][1], pr.meta[j][1]
+        if tl != tr:
+            return False
+        dl, dr = pl.meta[i][2], pr.meta[j][2]
+        if (dl is not None or dr is not None) \
+                and not _same_dictionary(dl, dr):
+            return False
+    return True
+
+
+class _LazyCounts:
+    """A dispatched-but-not-pulled device count vector.  Sharing one
+    instance between a DeferredTable's ``counts_thunk`` and its
+    materialize thunk makes the host sync happen at most once, and only
+    when someone actually needs the counts — a fused consumer that drains
+    the join state never does (the piece loop's software pipeline)."""
+
+    __slots__ = ("_dev", "value")
+
+    def __init__(self, dev):
+        self._dev = dev
+        self.value = None
+
+    def __call__(self) -> np.ndarray:
+        if self.value is None:
+            self.value = host_array(self._dev).astype(np.int64)
+            self._dev = None
+        return self.value
+
+
+def _packed_statics(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
+                    how: str, suffixes, coalesce_keys: bool):
+    """Derive every static input of the packed join programs (shared by
+    the impl and the AOT prewarm)."""
+    names_l, names_r = pl.column_names, pr.column_names
+    kil = tuple(names_l.index(n) for n in left_on)
+    kir = tuple(names_r.index(n) for n in right_on)
+    need_nf = tuple((pl.spec.cols[i].valid_bit >= 0)
+                    or (pr.spec.cols[j].valid_bit >= 0)
+                    for i, j in zip(kil, kir))
+    narrow = tuple(_fits32_meta(pl.spec.cols[i].dtype, pl.meta[i][3])
+                   and _fits32_meta(pr.spec.cols[j].dtype, pr.meta[j][3])
+                   for i, j in zip(kil, kir))
+
+    coalesce = coalesce_keys and list(left_on) == list(right_on)
+    key_set_l, key_set_r = set(left_on), set(right_on)
+    overlap = (set(names_l) & set(names_r)) - (
+        key_set_l if coalesce else set())
+    plan, names, types, dicts, bounds = [], [], [], [], []
+    for i, (n, t, dc, nb) in enumerate(pl.meta):
+        has_v = pl.spec.cols[i].valid_bit >= 0
+        if coalesce and n in key_set_l:
+            j = kir[left_on.index(n)]
+            _rn, _rt, _rdc, rnb = pr.meta[j]
+            rv = pr.spec.cols[j].valid_bit >= 0
+            bounds.append(None if nb is None or rnb is None
+                          else (min(nb[0], rnb[0]), max(nb[1], rnb[1])))
+            if how in ("inner", "left"):
+                plan.append(("l", i, has_v))
+            elif how == "right":
+                plan.append(("r", j, rv))
+            else:
+                plan.append(("k", i, j, has_v or rv))
+        else:
+            plan.append(("l", i, has_v or how in ("right", "outer")))
+            bounds.append(nb)
+            n = n + suffixes[0] if n in overlap else n
+        names.append(n)
+        types.append(t)
+        dicts.append(dc)
+    for j, (n, t, dc, nb) in enumerate(pr.meta):
+        if coalesce and n in key_set_r:
+            continue
+        rv = pr.spec.cols[j].valid_bit >= 0
+        plan.append(("r", j, rv or how in ("left", "outer")))
+        names.append(n + suffixes[1] if n in overlap else n)
+        types.append(t)
+        dicts.append(dc)
+        bounds.append(nb)
+
+    def can_carry(spec) -> bool:
+        return bool(how in ("inner", "left")
+                    and any(c.lanes for c in spec.cols))
+
+    carry_emit = can_carry(pl.spec) and pl.spec.n_lanes <= 6
+    carry_match = can_carry(pr.spec) and pr.spec.n_lanes <= 8
+    all_live = bool((pl.lens == pl.piece_cap).all()
+                    and (pr.lens == pr.piece_cap).all())
+    return (kil, kir, need_nf, narrow, coalesce, tuple(plan), tuple(names),
+            tuple(types), tuple(dicts), tuple(bounds), carry_emit,
+            carry_match, all_live)
+
+
+def prewarm_packed_join(pl: PackedPiece, pr: PackedPiece, left_on,
+                        right_on, how: str, suffixes, allow_defer: bool,
+                        coalesce_keys: bool = True) -> None:
+    """AOT-compile the phase-1 program for this piece-pair SHAPE
+    (``.lower().compile()`` — nothing executes): with per-range piece
+    capacities precomputed, every distinct program can compile before the
+    range loop starts instead of stalling dispatch mid-stream.  The
+    executable lands in the persistent compile cache, where the in-process
+    jit call path picks it up; best-effort — any failure just means the
+    loop compiles lazily like the seed did."""
+    if not (config.PREWARM_PIECE_PROGRAMS and config.COMPILE_CACHE_ENABLED):
+        return
+    try:
+        (kil, kir, need_nf, narrow, coalesce, _plan, _names, _types,
+         _dicts, _bounds, carry_emit, carry_match,
+         all_live) = _packed_statics(pl, pr, left_on, right_on, how,
+                                     suffixes, coalesce_keys)
+        slim = (config.DEFER_JOIN and how == "inner" and carry_emit
+                and carry_match and coalesce and allow_defer)
+        fn = _packed_count_fn(
+            pl.env.mesh, how, narrow, need_nf, pl.spec, pr.spec, kil, kir,
+            pl.piece_cap, pr.piece_cap, len(pl.arrs), len(pr.arrs),
+            all_live, carry_emit, carry_match, slim)
+        vcl = np.asarray(pl.lens, np.int32)
+        vcr = np.asarray(pr.lens, np.int32)
+        fn.lower(vcl, vcr, pl.starts, pr.starts,
+                 *pl.arrs, *pr.arrs).compile()
+    except Exception:  # noqa: BLE001 — best-effort warm only
+        pass
+
+
+def _join_packed_impl(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
+                      how: str, suffixes, coalesce_keys: bool,
+                      allow_defer: bool) -> Table:
+    env = pl.env
+    if pr.env is not env and pr.env.mesh is not env.mesh:
+        raise InvalidError("pieces belong to different CylonEnvs")
+    (kil, kir, need_nf, narrow, coalesce, plan, names, types, dicts,
+     bounds, carry_emit, carry_match, all_live) = _packed_statics(
+        pl, pr, left_on, right_on, how, suffixes, coalesce_keys)
+    cap_l, cap_r = pl.piece_cap, pr.piece_cap
+    vcl = np.asarray(pl.lens, np.int32)
+    vcr = np.asarray(pr.lens, np.int32)
+
+    defer = (config.DEFER_JOIN and how == "inner" and carry_emit
+             and carry_match and coalesce and allow_defer)
+    fn = _packed_count_fn(env.mesh, how, narrow, need_nf, pl.spec, pr.spec,
+                          kil, kir, cap_l, cap_r, len(pl.arrs),
+                          len(pr.arrs), all_live, carry_emit, carry_match,
+                          defer)
+    args = (vcl, vcr, pl.starts, pr.starts) + pl.arrs + pr.arrs
+
+    if defer:
+        with timing.region("join.sort_count"):
+            res = fn(*args)
+        counts_dev, idx_s_s, bnd_s = res[0], res[1], res[2]
+        pl_s = tuple(res[3:])
+        # the counts stay ON DEVICE: the next piece's programs can be
+        # enqueued before this piece's host sync, and a fused consumer
+        # that drains the state never pulls them at all
+        holder = _LazyCounts(counts_dev)
+
+        def materialize_cols():
+            counts = holder()
+            out_cap = config.pow2ceil(int(counts.max())
+                                      if counts.size else 1)
+            with timing.region("join.materialize"):
+                carry = _carry_fn(env.mesh, how, cap_l, cap_r, all_live)(
+                    vcl, vcr, idx_s_s, bnd_s)
+                mfn = _packed_materialize_fn(
+                    env.mesh, how, out_cap, cap_l, cap_r, plan, pl.spec,
+                    pr.spec, len(pl.arrs), len(pr.arrs), True, True)
+                out_d, out_v = mfn(carry, pl_s, pl.starts, pr.starts,
+                                   *pl.arrs, *pr.arrs)
+            return {nme: Column(d, t, v, dc, bounds=b)
+                    for nme, d, v, t, dc, b in
+                    zip(names, out_d, out_v, types, dicts, bounds)}
+
+        from ..core.table import DeferredTable
+        from .fused import JoinState
+        state = JoinState(
+            vcl=vcl, vcr=vcr, idx_s=idx_s_s, bnd=bnd_s, pl_s=pl_s,
+            lspec=pl.spec, rspec=pr.spec, plan=plan, names=names,
+            types=types, dicts=dicts, key_names=tuple(left_on),
+            cap_l=cap_l, cap_r=cap_r, all_live=all_live)
+        out = DeferredTable(
+            env, None, None, materialize_cols,
+            (names, types, dicts, tuple(bool(e[-1]) for e in plan)),
+            op_state=state, counts_thunk=holder)
+        out.grouped_by = tuple(left_on)
+        return out
+
+    with timing.region("join.sort_count"):
+        res = fn(*args)
+        counts_dev, carry = res[0], res[1:7]
+        pl_s = tuple(res[7:])
+    cache_key = ("packed", env.serial, how, narrow, cap_l, cap_r,
+                 int(pl.lens.sum()), int(pr.lens.sum()), tuple(left_on),
+                 tuple(right_on), tuple(pl.column_names),
+                 tuple(pr.column_names))
+    predicted = _CAP_CACHE.get(cache_key)
+    mat_args = (carry, pl_s, pl.starts, pr.starts) + pl.arrs + pr.arrs
+
+    def mat_fn(cap):
+        return _packed_materialize_fn(
+            env.mesh, how, cap, cap_l, cap_r, plan, pl.spec, pr.spec,
+            len(pl.arrs), len(pr.arrs), carry_emit, carry_match)
+
+    with timing.region("join.materialize"):
+        out_d = out_v = None
+        if predicted is not None:
+            # speculative dispatch at the predicted capacity BEFORE the
+            # blocking count pull — the sync overlaps device work
+            out_d, out_v = mat_fn(predicted)(*mat_args)
+        counts = host_array(counts_dev).astype(np.int64)
+        out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+        _CAP_CACHE.put(cache_key, out_cap)
+        if out_d is None or out_cap > predicted:
+            out_d, out_v = mat_fn(out_cap)(*mat_args)
+    out = build_table(names, out_d, out_v, types, dicts, counts, env,
+                      bounds=bounds)
+    if coalesce:
+        # pieces are key-grouped (sorted windows) and hash-colocated —
+        # same grouped contract as the colocated monolith
+        out.grouped_by = tuple(left_on)
+    return out
+
+
+def _join_packed_entry(left, right, left_on, right_on, how, suffixes,
+                       coalesce_keys, allow_defer):
+    left_on = [left_on] if isinstance(left_on, str) else list(left_on)
+    right_on = [right_on] if isinstance(right_on, str) else list(right_on)
+    if len(left_on) != len(right_on) or not left_on:
+        raise InvalidError("left_on/right_on must be equal-length, non-empty")
+    pl = left if isinstance(left, PackedPiece) else None
+    pr = right if isinstance(right, PackedPiece) else None
+    use_packed = (config.PACKED_PIECES and pl is not None and pr is not None
+                  and how in ("inner", "left", "right", "outer")
+                  and _packed_keys_compatible(pl, pr, left_on, right_on))
+    if use_packed:
+        return _join_packed_impl(pl, pr, left_on, right_on, how, suffixes,
+                                 coalesce_keys, bool(allow_defer))
+    # no packed entry for this shape: materialize the window(s) and take
+    # the normal colocated path (the equivalence reference)
+    lt = pl.to_table() if pl is not None else left
+    rt = pr.to_table() if pr is not None else right
+    return join_tables(lt, rt, left_on, right_on, how=how,
+                       suffixes=suffixes, coalesce_keys=coalesce_keys,
+                       assume_colocated=True, allow_defer=allow_defer)
 
 
 def join_tables(left: Table, right: Table, left_on, right_on,
@@ -515,8 +975,20 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     (exec/pipeline.py — the reference's operator-DAG slot): the work tiles
     over key ranges so sort scratch and per-piece output each fit; retried
     at growing range counts.  Range disjointness makes the fallback valid
-    for all four join types."""
+    for all four join types.
+
+    ``left``/``right`` may be :class:`~cylon_tpu.relational.piece.
+    PackedPiece` window descriptors instead of Tables (the pipelined range
+    loop's fast path): the window slice + lane unpack then run INSIDE the
+    jitted join program, fused with key-operand construction — no
+    per-piece unpack→repack HBM round trip.  Packed inputs are colocated
+    by construction and have no streaming fallback (the pieces ARE the
+    streaming decomposition)."""
     from .common import run_with_oom_fallback
+
+    if isinstance(left, PackedPiece) or isinstance(right, PackedPiece):
+        return _join_packed_entry(left, right, left_on, right_on, how,
+                                  suffixes, coalesce_keys, allow_defer)
 
     def fallback(nc):
         from ..exec.pipeline import pipelined_join
@@ -924,6 +1396,39 @@ def _trace_carry(mesh):
     return jax.make_jaxpr(fn)(vc, vc, cat, cat)
 
 
+def _packed_decl_spec():
+    # two non-null int32 lane columns: exercises window slice + key unpack
+    # + payload carry without int64 lane reconstruction (which widens
+    # i32→i64 by design and would trip JX203 in the trace)
+    return lanes.plan_lanes(("int32", "int32"), (False, False))
+
+
+def _trace_packed_count(mesh):
+    w, S, vc, _keys, _valids = _decl_args(mesh)
+    spec = _packed_decl_spec()
+    cap = 512
+    fn = _unwrap(_packed_count_fn(mesh, "inner", (False,), (False,), spec,
+                                  spec, (0,), (0,), cap, cap, 1, 1, False,
+                                  True, True, False))
+    st = S((w,), np.int32)
+    mat = S((w * 1024, spec.n_lanes), np.uint32)
+    return jax.make_jaxpr(fn)(vc, vc, st, st, mat, mat)
+
+
+def _trace_packed_materialize(mesh):
+    w, S, vc, _keys, _valids = _decl_args(mesh)
+    spec = _packed_decl_spec()
+    cap = 512
+    plan = (("l", 0, False), ("l", 1, False), ("r", 1, False))
+    fn = _unwrap(_packed_materialize_fn(mesh, "inner", 1024, cap, cap,
+                                        plan, spec, spec, 1, 1, False,
+                                        False))
+    carry = tuple(S((w * 2 * cap,), np.int32) for _ in range(6))
+    st = S((w,), np.int32)
+    mat = S((w * 1024, spec.n_lanes), np.uint32)
+    return jax.make_jaxpr(fn)(carry, (), st, st, mat, mat)
+
+
 from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
 
 declare_builder(f"{__name__}._semi_flag_fn", _trace_semi_flag,
@@ -934,3 +1439,11 @@ declare_builder(f"{__name__}._semi_flag_fn", _trace_semi_flag,
 declare_builder(f"{__name__}._count_fn", _trace_count, tags=("join",),
                 retrace_budget=128)
 declare_builder(f"{__name__}._carry_fn", _trace_carry, tags=("join",))
+# the packed-window programs span the same (how x narrow x lane-spec x
+# liveness x slim) static family as _count_fn PLUS the per-range capacity
+# pair — same widened session budget
+declare_builder(f"{__name__}._packed_count_fn", _trace_packed_count,
+                tags=("join", "pipeline"), retrace_budget=128)
+declare_builder(f"{__name__}._packed_materialize_fn",
+                _trace_packed_materialize, tags=("join", "pipeline"),
+                retrace_budget=128)

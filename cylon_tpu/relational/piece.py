@@ -1,0 +1,252 @@
+"""Packed piece handles: window views over a lane-packed resident table.
+
+The range-partitioned pipeline (exec/pipeline.py) packs each resident
+sorted table into ONE u32 lane matrix (+ f64 side arrays) up front; every
+range piece is then a contiguous per-shard window of that matrix.  The
+seed materialized each window back into a full Table — dynamic-slice,
+unpack EVERY column to full-width HBM arrays — only for the join to
+immediately re-pack the keys into sort operands and the payloads into a
+lane matrix.  That unpack→repack round trip was the single largest phase
+of the pipelined join at the 125M-row operating point (BENCH_r05:
+``pipe.piece_slice`` 3.74 s of 12.75 s).
+
+:class:`PackedPiece` removes the wall: it is a pure HOST-SIDE descriptor
+``(LaneSpec, lane matrix + f64 side arrays, per-shard starts/lens)`` —
+producing one costs no device work at all.  ``join_tables`` /
+``try_begin_join_groupby`` accept it in place of a materialized Table
+(relational/join.py packed entry): the window slice and the lane unpack
+happen *inside* the jitted join program, fused with key-operand
+construction — keys unpack first, payload lanes ride the phase-1 sort and
+unpack lazily in the carry/materialize stage, and columns the consumer
+never reads are never unpacked (ops/lanes.unpack_column).
+
+Ownership contract: the SOURCE (:class:`PieceSource`) owns the lane
+matrix; every piece aliases it.  Pieces stay valid as long as the source's
+arrays are alive — the pipeline holds the source for the whole range loop
+and pieces never outlive it.  ``to_table()`` is the materialized escape
+hatch (and the reference semantics the packed path is tested against).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import config
+from ..core.column import Column
+from ..core.table import Table
+from ..ctx.context import ROW_AXIS
+from ..utils.cache import program_cache
+from .common import REP, ROW
+
+shard_map = jax.shard_map
+
+
+@program_cache()
+def _piece_pack_fn(mesh: Mesh, spec, pad: int):
+    from ..ops import lanes
+
+    def per_shard(datas, valids):
+        mat = lanes.pack_lanes(spec, list(datas), list(valids))
+        if pad:
+            mat = jnp.concatenate(
+                [mat, jnp.zeros((pad, mat.shape[1]), mat.dtype)])
+        return mat
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW, ROW),
+                             out_specs=ROW))
+
+
+@program_cache()
+def _pad_rows_fn(mesh: Mesh, pad: int):
+    def per_shard(d):
+        return jnp.concatenate([d, jnp.zeros((pad,), d.dtype)]) if pad else d
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+                             out_specs=ROW))
+
+
+@program_cache()
+def _piece_slice_fn(mesh: Mesh, spec, piece_cap: int):
+    """Each shard's contiguous window [start, start+piece_cap) of the
+    once-packed lane matrix (+f64 side arrays): dynamic slices, no gathers.
+    The matrix is padded by the max piece capacity, so slices never clamp."""
+    from ..ops import lanes
+
+    has_mat = spec.n_lanes > 0
+    n_f64 = sum(1 for cl in spec.cols if not cl.lanes)
+
+    def per_shard(starts, *arrs):
+        my = jax.lax.axis_index(ROW_AXIS)
+        s = starts[my]
+        if has_mat:
+            mat, f64s = arrs[0], arrs[1:]
+            sub = lanes.slice_lanes(spec, mat, s, piece_cap)
+            datas, valids = lanes.unpack_lanes(spec, sub)
+            datas, valids = list(datas), list(valids)
+        else:
+            f64s = arrs
+            datas = [None] * len(spec.cols)
+            valids = [None] * len(spec.cols)
+        j = 0
+        for i, cl in enumerate(spec.cols):
+            if not cl.lanes:
+                datas[i] = jax.lax.dynamic_slice(f64s[j], (s,), (piece_cap,))
+                j += 1
+        return tuple(datas), tuple(valids)
+
+    in_specs = (REP,) + (ROW,) * (int(has_mat) + n_f64)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+                             out_specs=(ROW, ROW)))
+
+
+class PackedPiece:
+    """A per-shard window ``[starts[s], starts[s]+piece_cap)`` over a
+    :class:`PieceSource`'s packed arrays, of which the first ``lens[s]``
+    rows are live.  Pure descriptor: holds references to the SOURCE's
+    device arrays (no slice is dispatched until a consumer runs).
+
+    ``meta`` entries are ``(name, LogicalType, dictionary, bounds)``
+    parallel to ``spec.cols``."""
+
+    __slots__ = ("env", "spec", "meta", "arrs", "starts", "lens",
+                 "piece_cap")
+
+    def __init__(self, env, spec, meta, arrs, starts: np.ndarray,
+                 lens: np.ndarray, piece_cap: int):
+        self.env = env
+        self.spec = spec
+        self.meta = meta
+        self.arrs = arrs
+        self.starts = np.asarray(starts, np.int32)
+        self.lens = np.asarray(lens, np.int64)
+        self.piece_cap = int(piece_cap)
+
+    @property
+    def column_names(self) -> list[str]:
+        return [n for n, _, _, _ in self.meta]
+
+    @property
+    def valid_counts(self) -> np.ndarray:
+        return self.lens
+
+    @property
+    def row_count(self) -> int:
+        return int(self.lens.sum())
+
+    @property
+    def capacity(self) -> int:
+        return self.piece_cap
+
+    def to_table(self) -> Table:
+        """Materialize the window into a plain Table (dynamic slice + full
+        unpack) — the reference path the packed consumers are exactly
+        equal to, and the fallback when a consumer has no packed entry."""
+        fn = _piece_slice_fn(self.env.mesh, self.spec, self.piece_cap)
+        out_d, out_v = fn(self.starts, *self.arrs)
+        cols = {}
+        for (n, t, dc, nb), d, v in zip(self.meta, out_d, out_v):
+            cols[n] = Column(d, t, v, dc, bounds=nb)
+        return Table(cols, self.env, self.lens)
+
+
+class PieceSource:
+    """Range-piece provider over a resident sorted table: the table's
+    columns pack into ONE u32 lane matrix up front (padded by the largest
+    piece capacity so windows never clamp); each piece is then a pure
+    host-side :class:`PackedPiece` window descriptor — producing a piece
+    costs NO device work; the window slice runs inside whatever jitted
+    program consumes it.  The caller should drop its reference to the
+    source table: the matrix (plus f64 side arrays) carries everything."""
+
+    def __init__(self, table: Table, pad: int, drop: tuple = ()):
+        from .common import table_lane_spec
+        self.env = table.env
+        items = [(n, c) for n, c in table.columns.items() if n not in drop]
+        cols = [c for _, c in items]
+        self.spec = table_lane_spec(cols)
+        self.meta = tuple(
+            (n, c.type, c.dictionary,
+             (min(c.bounds[0], 0), max(c.bounds[1], 0))
+             if c.bounds is not None else None)
+            for n, c in items)
+        mesh = self.env.mesh
+        arrs = []
+        if self.spec.n_lanes:
+            arrs.append(_piece_pack_fn(mesh, self.spec, pad)(
+                tuple(c.data for c in cols),
+                tuple(c.validity for c in cols)))
+        for c, cl in zip(cols, self.spec.cols):
+            if not cl.lanes:
+                arrs.append(_pad_rows_fn(mesh, pad)(c.data))
+        self.arrs = tuple(arrs)
+
+    def packed(self, starts: np.ndarray, lens: np.ndarray,
+               piece_cap: int | None = None) -> PackedPiece:
+        if piece_cap is None:
+            piece_cap = config.pow2ceil(max(int(lens.max(initial=0)), 1))
+        return PackedPiece(self.env, self.spec, self.meta, self.arrs,
+                           starts, lens, piece_cap)
+
+    def piece(self, starts: np.ndarray, lens: np.ndarray) -> Table:
+        """Materialized window (seed behavior): slice + full unpack."""
+        return self.packed(starts, lens).to_table()
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the piece
+# programs are pure-local shard programs — slices and lane (un)packing
+# only, no collectives, no host callbacks.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _decl_spec():
+    from ..ops import lanes
+    # one nullable int32 lane column + one f64 side column: exercises the
+    # matrix, the validity lane, and the side-array path without any
+    # int64 lane reconstruction (which would trip JX203 by design)
+    return lanes.plan_lanes(("int32", "float64"), (True, False))
+
+
+def _trace_piece_pack(mesh):
+    import jax as _jax
+    w = int(mesh.devices.size)
+    cap, S = 1024, _jax.ShapeDtypeStruct
+    spec = _decl_spec()
+    fn = _unwrap(_piece_pack_fn(mesh, spec, 8))
+    datas = (S((w * cap,), np.int32), S((w * cap,), np.float64))
+    valids = (S((w * cap,), np.bool_), None)
+    return _jax.make_jaxpr(fn)(datas, valids)
+
+
+def _trace_pad_rows(mesh):
+    import jax as _jax
+    w = int(mesh.devices.size)
+    cap, S = 1024, _jax.ShapeDtypeStruct
+    fn = _unwrap(_pad_rows_fn(mesh, 8))
+    return _jax.make_jaxpr(fn)(S((w * cap,), np.float64))
+
+
+def _trace_piece_slice(mesh):
+    import jax as _jax
+    w = int(mesh.devices.size)
+    cap, S = 1024, _jax.ShapeDtypeStruct
+    spec = _decl_spec()
+    fn = _unwrap(_piece_slice_fn(mesh, spec, 256))
+    starts = S((w,), np.int32)
+    mat = S((w * (cap + 8), spec.n_lanes), np.uint32)
+    f64 = S((w * (cap + 8),), np.float64)
+    return _jax.make_jaxpr(fn)(starts, mat, f64)
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._piece_pack_fn", _trace_piece_pack,
+                tags=("pipeline",))
+declare_builder(f"{__name__}._pad_rows_fn", _trace_pad_rows,
+                tags=("pipeline",))
+# keyed on (lane spec x pow2 piece capacity) — a wider legitimate family
+# than the mesh-keyed builders, like join._count_fn
+declare_builder(f"{__name__}._piece_slice_fn", _trace_piece_slice,
+                tags=("pipeline",), retrace_budget=64)

@@ -13,6 +13,18 @@ dispatch, not the execution.  Regions are therefore placed around phases
 that end in a host synchronization (count-matrix pulls, ``np.asarray`` of
 sidecars); purely-async phases are flushed explicitly by the caller
 (``block=`` argument) when exact attribution matters.
+
+**The observer effect, and the async mode.**  Those explicit flushes
+(:func:`maybe_block`) SERIALIZE piece production against piece compute —
+exactly the overlap the pipelined operators exist for — so blocking
+attribution both slows the profiled iteration and HIDES overlap wins in
+the phase numbers.  ``CYLON_TPU_TIMING=async`` (config.TIMING_ASYNC)
+keeps the regions as dispatch-only markers: ``maybe_block`` becomes a
+no-op, each region records only the host time it took to ENQUEUE its
+work, and the caller blocks once at iteration end (bench.py's final
+output sync).  Phase numbers then read as "host time to dispatch": a
+phase that stops dominating dispatch has genuinely left the critical
+path.  Exact per-phase device attribution still needs ``block`` mode.
 """
 
 from __future__ import annotations
@@ -38,7 +50,7 @@ def region(name: str, block=None):
     try:
         yield
     finally:
-        if block is not None:
+        if block is not None and not config.TIMING_ASYNC:
             import jax
             jax.block_until_ready(block)
         dt = time.perf_counter() - t0
@@ -48,10 +60,14 @@ def region(name: str, block=None):
 
 
 def maybe_block(x) -> None:
-    """block_until_ready(x) ONLY when bench timings are on — lets a region
-    charge async device work to itself for attribution without serializing
-    dispatch in production runs."""
-    if config.BENCH_TIMINGS:
+    """block_until_ready(x) ONLY when bench timings are on AND the timing
+    mode is blocking — lets a region charge async device work to itself
+    for attribution without serializing dispatch in production runs.  In
+    async mode (``CYLON_TPU_TIMING=async``) this is a no-op even while
+    timing: regions become dispatch-only markers and the caller blocks
+    once at iteration end, so the measurement no longer perturbs the
+    dispatch/compute overlap it measures."""
+    if config.BENCH_TIMINGS and not config.TIMING_ASYNC:
         import jax
         jax.block_until_ready(x)
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Tiny-shape smoke run of the pipelined join+groupby dispatch path.
+
+``bench.py``'s north-star configuration (125M rows/chip through the
+range-partitioned pipeline with a fused GroupBySink) only runs on
+accelerator rigs — a dispatch-path regression there (a phase silently
+dropped, the sink no longer engaging, the packed-piece path bailing to
+materialize) would otherwise surface first in a slow TPU bench round.
+This script runs the SAME code path at <= 64k rows on whatever devices
+exist (CPU mesh included), asserts the expected phase markers were
+recorded, and checks the streamed result equals the monolithic
+join+groupby bit-for-bit on the integer sums.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_smoke.py [--rows=N]
+
+Exit status 0 and one JSON line on success; wired as a ``slow``-marked
+tier-1 test in tests/test_pipeline.py (TestBenchSmoke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: phase keys the pipelined sink path must record (dispatch markers)
+EXPECTED_PHASES = (
+    "pipe.build_sort", "pipe.bounds", "pipe.targets", "pipe.probe_sort",
+    "pipe.pack", "pipe.piece_join", "pipe.consume",
+)
+
+
+def run_smoke(env=None, rows: int = 65536, n_chunks: int = 4) -> dict:
+    """Run the pipelined join+groupby at a tiny shape and verify the
+    dispatch path: phase keys present, sink result == monolith.  Returns
+    the phase snapshot dict.  Raises AssertionError on any regression."""
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu import config
+    from cylon_tpu.exec import GroupBySink, pipelined_join
+    from cylon_tpu.relational import groupby_aggregate, join_tables
+    from cylon_tpu.utils import timing
+
+    assert rows <= 65536, "smoke stays tiny: <= 64k rows"
+    if env is None:
+        from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+        import jax
+        cfg = TPUConfig() if jax.devices()[0].platform != "cpu" \
+            else CPUMeshConfig()
+        env = ct.CylonEnv(config=cfg)
+
+    rng = np.random.default_rng(7)
+    max_val = max(int(rows * 0.9), 1)
+    lt = ct.Table.from_pydict(
+        {"k": rng.integers(0, max_val, rows).astype(np.int64),
+         "a": rng.integers(0, 1000, rows).astype(np.int64)}, env)
+    rt = ct.Table.from_pydict(
+        {"k": rng.integers(0, max_val, rows).astype(np.int64),
+         "b": rng.integers(0, 1000, rows).astype(np.int64)}, env)
+
+    prev_bench, prev_async = config.BENCH_TIMINGS, config.TIMING_ASYNC
+    try:
+        config.BENCH_TIMINGS = True
+        config.TIMING_ASYNC = True      # dispatch-only markers (bench mode)
+        timing.reset()
+        sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
+        pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=n_chunks,
+                       sink=sink)
+        got = sink.finalize()
+        snap = timing.snapshot()
+    finally:
+        config.BENCH_TIMINGS = prev_bench
+        config.TIMING_ASYNC = prev_async
+        timing.reset()
+
+    missing = [p for p in EXPECTED_PHASES if p not in snap]
+    assert not missing, f"pipelined phases missing from profile: {missing}"
+
+    mono = groupby_aggregate(join_tables(lt, rt, "k", "k", how="inner"),
+                             "k", [("a", "sum"), ("b", "sum")])
+    gp = got.to_pandas().sort_values("k").reset_index(drop=True)
+    mp = mono.to_pandas().sort_values("k").reset_index(drop=True)
+    assert len(gp) == len(mp), (len(gp), len(mp))
+    for col in ("k", "a_sum", "b_sum"):
+        # integer sums: the streamed decomposition must be EXACT
+        assert (gp[col].to_numpy() == mp[col].to_numpy()).all(), col
+    return snap
+
+
+def main() -> int:
+    rows = 65536
+    for a in sys.argv[1:]:
+        if a.startswith("--rows="):
+            rows = int(a.split("=", 1)[1])
+    snap = run_smoke(rows=rows)
+    print(json.dumps({"metric": "pipelined smoke", "rows": rows,
+                      "ok": True, "phases_s":
+                      {k: v["s"] for k, v in snap.items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
