@@ -61,6 +61,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     import cylon_tpu as ct
     from cylon_tpu import config
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+    from cylon_tpu.exec import recovery
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.utils import timing
 
@@ -130,6 +131,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     prev_flag = config.BENCH_TIMINGS
     prev_async = config.TIMING_ASYNC
     config.BENCH_TIMINGS = False
+    recovery.reset_events()  # detail reports THIS workload's recoveries
     try:
         step()  # warmup + compile
         times = []
@@ -168,6 +170,9 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             "timing_mode": "async" if timing_async else "block",
             "profiled_iter_s": round(profiled_s, 4),
             "phases_s": {k: v["s"] for k, v in timing.snapshot().items()},
+            # (site, kind, action) per recovery: was the number achieved
+            # on the happy path or after degradation? (docs/robustness.md)
+            "recovery_events": recovery.drain_events(),
         },
     }
 
@@ -208,9 +213,8 @@ def main() -> dict:
             return run(rows_per_chip=rows, unique=unique, iters=iters,
                        skew=skew)
         except Exception as e:  # noqa: BLE001
-            msg = str(e)
-            if ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                    or "out of memory" in msg) and rows > 1_000_000:
+            from cylon_tpu.exec import recovery
+            if recovery.is_oom(e) and rows > 1_000_000:
                 rows //= 2
                 continue
             raise

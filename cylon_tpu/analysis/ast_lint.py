@@ -24,7 +24,13 @@ function within the same module) — and flags:
   ``Mesh`` parameter — the global cache pins the mesh (and its
   executables) forever; use
   :func:`cylon_tpu.utils.cache.program_cache`, which scopes the entry to
-  the mesh's lifetime.
+  the mesh's lifetime;
+* **TS105** ``except`` handlers that classify OOM by string-matching
+  (``"RESOURCE_EXHAUSTED" in str(e)`` and friends) outside
+  ``exec/recovery.py`` — the typed fault taxonomy
+  (:mod:`cylon_tpu.status`, ``exec/recovery.classify``) is the sanctioned
+  classification boundary; ad-hoc matching forks the recovery decision
+  away from the rank-coherent consensus ladder.
 
 The pass is heuristic by design (a linter, not a verifier): it
 under-approximates taint (module-local call graph only) and exempts
@@ -45,6 +51,12 @@ _NUMPY_MODULES = {"np", "numpy", "onp"}
 _NUMPY_SYNC_ATTRS = {"asarray", "array", "ascontiguousarray"}
 _METHOD_SYNCS = {"item", "tolist"}
 _CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+#: OOM message fragments whose use in an except handler is a TS105 finding
+#: (keep in sync with exec/recovery._OOM_MARKERS — the sanctioned site)
+_OOM_TEXT_MARKERS = ("resource_exhausted", "out of memory")
+#: the one module allowed to string-match OOM text (path suffix)
+_RECOVERY_MODULE = "exec/recovery.py"
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -302,6 +314,7 @@ class _ModuleLint:
             if fn.name in traced:
                 self._check_traced_body(fn, fn.name in roots)
         self._check_jit_sites()
+        self._check_oom_stringmatch()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -374,6 +387,39 @@ class _ModuleLint:
                 "TS101", node,
                 f"`{node.func.id}()` on a tracer inside '{fn.name}' — "
                 "concretizes the value (host sync or trace error)")
+
+    def _check_oom_stringmatch(self) -> None:
+        """TS105: OOM classification by message text inside an ``except``
+        handler — sanctioned only in the recovery module, which owns the
+        typed fault taxonomy and the consensus retry ladder."""
+        if self.path.replace(os.sep, "/").endswith(_RECOVERY_MODULE):
+            return
+        seen: set[tuple[int, int]] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Compare) and len(sub.ops) == 1
+                        and isinstance(sub.ops[0], (ast.In, ast.NotIn))):
+                    continue
+                left = sub.left
+                if (isinstance(left, ast.Constant)
+                        and isinstance(left.value, str)
+                        and any(m in left.value.lower()
+                                for m in _OOM_TEXT_MARKERS)):
+                    # nested handlers re-walk inner trees: one finding
+                    # per Compare node, not per enclosing handler
+                    key = (sub.lineno, sub.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    self._emit(
+                        "TS105", sub,
+                        f"except handler classifies OOM by string-matching "
+                        f"({left.value!r}) — use the typed fault taxonomy "
+                        "(cylon_tpu.exec.recovery.classify / is_oom); "
+                        "ad-hoc matching bypasses the rank-coherent "
+                        "recovery ladder")
 
     def _check_jit_sites(self) -> None:
         for node in ast.walk(self.tree):

@@ -31,6 +31,7 @@ BUILDER_MODULES = (
     "cylon_tpu.relational.setops",
     "cylon_tpu.relational.repart",
     "cylon_tpu.exec.pipeline",
+    "cylon_tpu.exec.recovery",
 )
 
 #: default bound on distinct compiled programs per builder per session

@@ -28,6 +28,9 @@ RULES = {
     "TS102": "Python if/while on a tracer-derived value in a traced body",
     "TS103": "jax.jit wrapper missing static_argnums for a control param",
     "TS104": "lru_cache'd program builder keyed on a live Mesh object",
+    "TS105": "except handler classifies OOM by string-matching outside the "
+             "recovery module (the fault taxonomy is the sanctioned "
+             "boundary)",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

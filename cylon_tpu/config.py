@@ -158,6 +158,14 @@ EXCHANGE_RECV_BUDGET_BYTES = int(os.environ.get(
 #: normally far above HBM-sized budgets, so default off)
 EXCHANGE_RECV_GUARD_CPU = _env_flag("CYLON_TPU_EXCHANGE_GUARD_CPU", False)
 
+#: Exchange watchdog deadline in seconds (0 = off, the default): blocking
+#: multihost exchange host-syncs run under this timeout and a peer hang
+#: surfaces as a typed RankDesyncError (site + last-known phase attached)
+#: instead of an infinite block.  See exec/recovery.exchange_watchdog and
+#: docs/robustness.md.  Fault injection (CYLON_TPU_FAULTS, same doc) is
+#: parsed by exec/recovery directly.
+EXCHANGE_WATCHDOG_S = float(os.environ.get("CYLON_TPU_WATCHDOG_S", "0"))
+
 #: A join side at or below this row count is REPLICATED (allgather)
 #: instead of shuffling both sides — the broadcast-hash-join cutover.
 BROADCAST_JOIN_ROWS = int(os.environ.get("CYLON_TPU_BROADCAST_JOIN_ROWS",

@@ -1,0 +1,520 @@
+"""Rank-coherent failure recovery: classification, consensus, injection.
+
+Cylon's distributed operators are ``local partition → all-to-all shuffle →
+local op`` (SURVEY §0), which on TPU makes every failure-recovery decision
+a COLLECTIVE decision: if one rank's receive-budget guard fires and it
+retries at a different chunk count while its peers proceed, the next
+collective deadlocks the whole mesh.  This module is the one place those
+decisions are made, built on four pillars (docs/robustness.md):
+
+1. **Typed fault taxonomy** (classes live in :mod:`cylon_tpu.status`):
+   :class:`~cylon_tpu.status.PredictedResourceExhausted` (guard fired
+   pre-allocation, HBM not poisoned — safe in-process retry),
+   :class:`~cylon_tpu.status.DeviceOOMError` (real XLA
+   RESOURCE_EXHAUSTED), :class:`~cylon_tpu.status.CapacityOverflowError`
+   (pow2 piece/output cap exceeded) and
+   :class:`~cylon_tpu.status.RankDesyncError` (peer hang / structural
+   divergence).  :func:`classify` is the ONLY sanctioned place that
+   string-matches runtime OOM text (lint rule TS105 enforces this).
+
+2. **Rank-coherent retry ladder** (:func:`run_with_recovery`): in a
+   multiprocess (``jax.distributed``) session, ranks all-reduce a small
+   status code — max over :class:`~cylon_tpu.status.Code` values via a
+   one-element ``pmax`` shard_map program — after every guarded attempt,
+   so every rank takes the IDENTICAL branch: same fallback chunk count,
+   same cap-halving step, or same typed abort.  Escalation is bounded and
+   deterministic (OOM: chunks 4 → 16; capacity overflow: one cap-halving
+   step at 8 chunks), nested ladders never re-escalate (the outer ladder
+   owns the rungs), and every recovery event is logged and counted in
+   :mod:`cylon_tpu.utils.timing` phase stats.
+
+3. **Fault injection** (``CYLON_TPU_FAULTS="site[:rank][:nth]=kind"``):
+   each typed fault is constructible at its named site on the CPU rig, so
+   the whole ladder is testable without a real device OOM.  Sites:
+   ``shuffle.recv_guard``, ``join.piece_cap``, ``groupby.device_oom``,
+   ``exchange.stall``.  Kinds: ``predicted``, ``device_oom``,
+   ``capacity``, ``desync``, ``stall`` (stall only fires inside the
+   watchdog).  ``rank`` defaults to every rank (``*``); ``nth`` is the
+   1-based occurrence to fire on (default 1; ``*`` = every occurrence).
+
+4. **Exchange watchdog** (:func:`exchange_watchdog`): an optional timeout
+   (``CYLON_TPU_WATCHDOG_S``) around multihost exchange host-syncs that
+   converts a peer hang into a typed
+   :class:`~cylon_tpu.status.RankDesyncError` carrying the site and the
+   last-known timing phase, instead of an infinite block.
+
+The rank-coherence invariant underlying all of this: **no rank-local
+control flow after a collective has been entered** — any guard that can
+abort an exchange must take its raise/proceed decision through
+:func:`guard_consensus` BEFORE the first collective of that exchange is
+dispatched.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import config
+from ..ctx.context import ROW_AXIS
+from ..status import (CapacityOverflowError, Code, CylonError,
+                      DeviceOOMError, FAULT_TYPES,
+                      PredictedResourceExhausted, RankDesyncError)
+from ..utils.cache import program_cache
+
+shard_map = jax.shard_map
+
+#: injection site names (docs/robustness.md spec grammar)
+SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
+         "exchange.stall")
+
+#: fault kinds accepted by the injection grammar
+KINDS = ("predicted", "device_oom", "capacity", "desync", "stall")
+
+
+# ---------------------------------------------------------------------------
+# classification — the sanctioned string-matching boundary (TS105)
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_oom(e: Exception) -> bool:
+    """Device out-of-memory, as surfaced by XLA/PJRT (either a typed
+    taxonomy OOM or a foreign runtime error carrying the XLA text)."""
+    if isinstance(e, (PredictedResourceExhausted, DeviceOOMError)):
+        return True
+    s = str(e)
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def classify(e: Exception) -> CylonError | None:
+    """Map an exception onto the typed fault taxonomy.
+
+    Typed faults pass through unchanged.  Foreign exceptions carrying XLA
+    OOM text become :class:`PredictedResourceExhausted` (when the message
+    says ``(predicted)`` — the pre-allocation guard shape) or
+    :class:`DeviceOOMError`, with the original on ``__cause__``.  Returns
+    ``None`` for everything else (not a recovery fault: re-raise it)."""
+    if isinstance(e, FAULT_TYPES):
+        return e
+    if isinstance(e, CylonError):
+        return None  # typed engine errors (Invalid/Type/...) are not faults
+    s = str(e)
+    if any(m in s for m in _OOM_MARKERS):
+        cls = (PredictedResourceExhausted if "(predicted)" in s
+               else DeviceOOMError)
+        fault = cls(s)
+        fault.__cause__ = e
+        return fault
+    return None
+
+
+# ---------------------------------------------------------------------------
+# fault injection harness
+# ---------------------------------------------------------------------------
+
+class _FaultSpec:
+    __slots__ = ("site", "rank", "nth", "kind", "fired")
+
+    def __init__(self, site: str, rank, nth, kind: str):
+        self.site = site
+        self.rank = rank      # int or None (= every rank)
+        self.nth = nth        # int (1-based) or None (= every occurrence)
+        self.kind = kind
+        self.fired = False
+
+
+_FAULTS: list[_FaultSpec] | None = None   # None = parse env on first probe
+_HITS: dict[str, int] = {}                # per-site occurrence counters
+
+
+def _parse_faults(spec: str) -> list[_FaultSpec]:
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        lhs, _, kind = entry.partition("=")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"CYLON_TPU_FAULTS: unknown kind {kind!r} in {entry!r}; "
+                f"kinds: {KINDS}")
+        parts = lhs.strip().split(":")
+        site = parts[0]
+        if site not in SITES:
+            raise ValueError(
+                f"CYLON_TPU_FAULTS: unknown site {site!r} in {entry!r}; "
+                f"sites: {SITES}")
+        rank = None
+        nth: int | None = 1
+        if len(parts) > 1 and parts[1] not in ("", "*"):
+            rank = int(parts[1])
+        if len(parts) > 2:
+            nth = None if parts[2] == "*" else int(parts[2])
+        if len(parts) > 3:
+            raise ValueError(f"CYLON_TPU_FAULTS: bad entry {entry!r} "
+                             "(grammar: site[:rank][:nth]=kind)")
+        out.append(_FaultSpec(site, rank, nth, kind))
+    return out
+
+
+def install_faults(spec: str | None) -> None:
+    """(Re)program the injector: ``spec`` in the env-var grammar, ``""``
+    to disarm, ``None`` to re-read ``CYLON_TPU_FAULTS`` from the
+    environment.  Resets occurrence counters either way."""
+    global _FAULTS
+    _HITS.clear()
+    if spec is None:
+        spec = os.environ.get("CYLON_TPU_FAULTS", "")
+    _FAULTS = _parse_faults(spec)
+
+
+def probe(site: str) -> tuple[str | None, bool]:
+    """Probe the injector at a named site → ``(kind, armed)``.
+
+    ``kind`` is the fault kind firing on THIS rank at this occurrence
+    (consuming one-shot specs), or None.  ``armed`` is True while ANY
+    spec could still fire at this site on ANY rank — computed from the
+    spec list and the per-site hit counter only, both of which advance
+    identically on every rank of an SPMD session (same env var / same
+    ``install_faults`` call, probes at the same program points), so
+    ``armed`` is rank-UNIFORM even when ``kind`` is rank-selective.
+    Guards use it to decide — coherently — whether a consensus poll is
+    needed at all."""
+    global _FAULTS
+    if _FAULTS is None:
+        install_faults(None)
+    if not _FAULTS:
+        return None, False
+    _HITS[site] = hit = _HITS.get(site, 0) + 1
+    rank = jax.process_index()
+    kind = None
+    for f in _FAULTS:
+        if f.site != site or f.fired:
+            continue
+        if f.rank is not None and f.rank != rank:
+            continue
+        if f.nth is not None and f.nth != hit:
+            continue
+        f.fired = f.nth is not None
+        kind = f.kind
+        break
+    armed = any(f.site == site and (f.nth is None or f.nth >= hit)
+                for f in _FAULTS)
+    return kind, armed
+
+
+def injected(site: str) -> str | None:
+    """Probe the injector at a named site: counts the occurrence and
+    returns the armed fault kind (consuming one-shot specs), or None."""
+    return probe(site)[0]
+
+
+def make_fault(kind: str, site: str) -> Exception:
+    """The typed (or deliberately foreign) exception for an injected
+    fault.  ``device_oom`` returns a FOREIGN RuntimeError carrying the
+    XLA message shape so the injection also exercises :func:`classify`."""
+    if kind == "predicted":
+        return PredictedResourceExhausted(
+            f"RESOURCE_EXHAUSTED (predicted): injected fault at {site}",
+            site=site)
+    if kind == "device_oom":
+        return RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected device OOM at {site}")
+    if kind == "capacity":
+        return CapacityOverflowError(f"injected capacity overflow at {site}",
+                                     site=site)
+    return RankDesyncError(f"injected rank desync at {site}", site=site,
+                           phase=_last_phase())
+
+
+def maybe_inject(site: str) -> None:
+    """Raise the armed fault for ``site`` (no-op when nothing is armed).
+    Call at each named injection point."""
+    kind = injected(site)
+    if kind is not None:
+        _record(site, kind, "injected")
+        raise make_fault(kind, site)
+
+
+# ---------------------------------------------------------------------------
+# recovery-event log
+# ---------------------------------------------------------------------------
+
+_EVENTS: list[dict] = []
+
+
+def _last_phase() -> str:
+    from ..utils import timing
+    return timing.last_region()
+
+
+def _record(site: str, kind: str, action: str) -> None:
+    from ..utils import timing
+    from ..utils.logging import log
+    _EVENTS.append({"site": site, "kind": kind, "action": action})
+    timing.bump(f"recovery.{site}.{kind}.{action}")
+    log.warning("recovery: %s fault at %s -> %s", kind, site, action)
+
+
+def recovery_events() -> list[dict]:
+    """Events recorded since the last :func:`reset_events`/:func:`drain_events`
+    (each ``{"site", "kind", "action"}``), oldest first."""
+    return list(_EVENTS)
+
+
+def drain_events() -> list[dict]:
+    out = list(_EVENTS)
+    _EVENTS.clear()
+    return out
+
+
+def reset_events() -> None:
+    _EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# SPMD consensus: all-reduce (max) one status code across ranks
+# ---------------------------------------------------------------------------
+
+@program_cache()
+def _consensus_fn(mesh: Mesh, w: int):
+    """One int32 status code per shard → the elementwise pmax, replicated.
+    The whole program is one unconditional collective — the minimal
+    rank-coherence primitive (docs/robustness.md)."""
+
+    def per_shard(code):
+        return jax.lax.pmax(code, ROW_AXIS)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
+                             out_specs=P()))
+
+
+def _consensus_wire(mesh: Mesh | None, wire: int) -> int:
+    """Max-reduce one raw int32 across ranks — the transport for both
+    :func:`consensus_code` (plain Code) and the ladder's type-carrying
+    wire encoding (:func:`_wire_code`).  Single-controller sessions have
+    no rank-divergent control flow by construction, so the local value
+    IS the consensus; multiprocess sessions run the one-element pmax
+    program — every rank must call this at the same point (it is a
+    collective), and the result pull runs under the exchange watchdog."""
+    if mesh is None or jax.process_count() == 1:
+        return int(wire)
+    w = int(mesh.devices.size)
+    sharding = NamedSharding(mesh, P(ROW_AXIS))
+    arr = jax.make_array_from_callback(
+        (w,), sharding, lambda idx: np.full((1,), int(wire), np.int32))
+    res = _consensus_fn(mesh, w)(arr)
+    return exchange_watchdog("exchange.consensus",
+                             lambda: int(np.asarray(res)[0]))
+
+
+def consensus_code(mesh: Mesh | None, code: Code | int) -> Code:
+    """The agreed (max) status code across every rank of the session."""
+    return Code(_consensus_wire(mesh, int(Code(int(code)))))
+
+
+def _wire_code(fault: CylonError | None) -> int:
+    """Ladder consensus encoding: ``Code*4 + sub`` where the predicted
+    OOM shape sorts BELOW a real device OOM within the same Code.  The
+    max then agrees not just on the retry rung but on the fault TYPE
+    every rank must raise on abort — callers above the ladder (e.g.
+    ``bench_tpch``) dispatch on the class, and a rank aborting with
+    `predicted` while a peer aborts with `device_oom` would take
+    divergent abort-vs-retry branches."""
+    if fault is None:
+        return 0
+    sub = 0 if isinstance(fault, PredictedResourceExhausted) else 1
+    return int(fault.code) * 4 + sub
+
+
+def _unwire(wire: int) -> Code:
+    return Code(int(wire) // 4)
+
+
+def _fault_from_wire(wire: int, msg: str) -> CylonError:
+    """The typed taxonomy fault every rank must raise for an agreed wire
+    value — identical class on every rank by construction."""
+    code = _unwire(wire)
+    if code == Code.OutOfMemory:
+        return (PredictedResourceExhausted(msg) if wire % 4 == 0
+                else DeviceOOMError(msg))
+    if code == Code.CapacityError:
+        return CapacityOverflowError(msg)
+    return RankDesyncError(msg, phase=_last_phase())
+
+
+def guard_consensus(mesh: Mesh | None, local_fault: bool) -> bool:
+    """Pre-collective raise/proceed agreement for capacity guards: True
+    when ANY rank's guard fired — then every rank raises the identical
+    typed fault BEFORE the exchange's first collective is dispatched (the
+    rank-coherence invariant).  Runs unconditionally on every rank in a
+    multiprocess session (it is itself a tiny collective)."""
+    local = Code.OutOfMemory if local_fault else Code.OK
+    return consensus_code(mesh, local) != Code.OK
+
+
+# ---------------------------------------------------------------------------
+# exchange watchdog
+# ---------------------------------------------------------------------------
+
+def exchange_watchdog(site: str, thunk, timeout_s: float | None = None):
+    """Run a blocking exchange host-sync under an optional deadline.
+
+    With ``CYLON_TPU_WATCHDOG_S`` unset/0 this is a plain call.  With a
+    deadline, the sync runs in a worker thread; if it does not complete in
+    time the hang is converted into a typed :class:`RankDesyncError`
+    carrying the site and the last-known timing phase.  The injector kind
+    ``stall`` (site ``exchange.stall``) simulates the peer hang."""
+    t = config.EXCHANGE_WATCHDOG_S if timeout_s is None else float(timeout_s)
+    if t <= 0:
+        return thunk()
+    stalled = injected("exchange.stall")
+    box: dict = {}
+
+    def run():
+        if stalled is not None:
+            # simulated peer hang: the data never arrives
+            import time
+            time.sleep(4 * t)
+            return
+        try:
+            box["value"] = thunk()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            box["error"] = e
+
+    th = threading.Thread(target=run, daemon=True,
+                          name=f"cylon-watchdog-{site}")
+    th.start()
+    th.join(t)
+    if "error" in box:
+        raise box["error"]
+    if "value" not in box:
+        _record(site, "desync", "watchdog")
+        raise RankDesyncError(
+            f"exchange watchdog: no progress at {site} within {t:g}s — a "
+            "peer rank hung in (or never entered) the exchange",
+            site=site, phase=_last_phase())
+    return box["value"]
+
+
+# ---------------------------------------------------------------------------
+# the rank-coherent retry ladder
+# ---------------------------------------------------------------------------
+
+#: bounded deterministic escalation per agreed fault code: device/predicted
+#: OOM retries the streaming fallback at growing chunk counts; a capacity
+#: overflow takes exactly one cap-halving step (pieces are ~1/n_chunks
+#: sized, so 8 chunks halves the 4-chunk default's piece cap)
+RETRY_RUNGS = {Code.OutOfMemory: (4, 16), Code.CapacityError: (8,)}
+
+_tls = threading.local()
+
+
+def _attempt(fn):
+    """(result, fault) — non-fault exceptions propagate."""
+    try:
+        return fn(), None
+    except Exception as e:  # noqa: BLE001 — classify filters
+        fault = classify(e)
+        if fault is None:
+            raise
+        return None, fault
+
+
+def run_with_recovery(primary, can_fallback: bool, fallback, label: str,
+                      env=None):
+    """``primary()`` under the consensus retry ladder: classify any fault,
+    agree on ONE status code across ranks, and either return, retry
+    ``fallback(n_chunks)`` on the deterministic rung schedule
+    (:data:`RETRY_RUNGS`), or raise the typed fault — identically on every
+    rank.  ``env`` (a CylonEnv) supplies the mesh for the consensus
+    all-reduce; without it (or single-process) consensus is local.
+
+    Nested invocations (a fallback re-entering a guarded operator) never
+    re-escalate: the outer ladder owns the rung schedule, so the total
+    number of retries stays bounded.
+
+    Protocol cost, stated plainly: in a MULTIPROCESS session every
+    guarded operator call ends in one tiny pmax + host pull even on the
+    happy path — that pull drains previously dispatched device work, so
+    cross-operator dispatch overlap (deferred counts) is traded for the
+    guarantee that a rank-local fault on any peer is seen by every rank
+    before anyone commits to a result.  Single-controller sessions (the
+    benched configurations) skip consensus entirely and keep full
+    overlap."""
+    mesh = getattr(env, "mesh", None)
+    multi = mesh is not None and jax.process_count() > 1
+    nested = getattr(_tls, "depth", 0) > 0
+
+    def agree(fault):
+        """(agreed Code, rank-coherent fault|None): consensus over the
+        wire encoding, so ranks agree on the fault TYPE, not just the
+        rung — a rank whose local fault differs from (or lacks) the
+        agreed one adopts a synthesized fault of the agreed class
+        (classify() passes typed faults through, keeping ENCLOSING
+        ladders and type-dispatching callers coherent too)."""
+        wire = _wire_code(fault)
+        agreed_w = _consensus_wire(mesh, wire) if multi else wire
+        if agreed_w == 0:
+            return Code.OK, None
+        if fault is None or _wire_code(fault) != agreed_w:
+            fault = _fault_from_wire(
+                agreed_w, f"peer rank fault during {label} "
+                          f"(consensus {_unwire(agreed_w).name})")
+        return _unwire(agreed_w), fault
+
+    result, fault = _attempt(primary)
+    agreed, fault = agree(fault)
+    if agreed == Code.OK:
+        return result
+    kind = getattr(fault, "kind", "fault")
+    rungs = RETRY_RUNGS.get(agreed, ())
+    if not rungs or not can_fallback or nested:
+        _record(label, kind, "abort")
+        raise fault
+
+    from ..utils.logging import log
+    last = fault
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        for nc in rungs:
+            _record(label, kind, f"retry_chunks_{nc}")
+            log.warning("%s %s fault (%s); rank-coherent retry via "
+                        "streaming fallback with %d chunks", label, kind,
+                        type(last).__name__, nc)
+            result, fault = _attempt(lambda: fallback(nc))
+            agreed, fault = agree(fault)
+            if agreed == Code.OK:
+                return result
+            last, kind = fault, getattr(fault, "kind", kind)
+            if agreed not in RETRY_RUNGS:
+                break
+    finally:
+        _tls.depth -= 1
+    _record(label, kind, "abort")
+    raise last
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declaration (cylon_tpu.analysis.registry): the consensus
+# program is ONE unconditional pmax — the jaxpr pass verifies exactly that
+# (a conditional consensus would be the deadlock it exists to prevent).
+# ---------------------------------------------------------------------------
+
+def _trace_consensus(mesh):
+    w = int(mesh.devices.size)
+    fn = _unwrap(_consensus_fn(mesh, w))
+    return jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((w,), np.int32))
+
+
+from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
+
+declare_builder(f"{__name__}._consensus_fn", _trace_consensus,
+                collectives={"pmax"}, tags=("recovery",))

@@ -90,10 +90,17 @@ def _count_fn(mesh: Mesh, w: int):
 
 
 def count_targets(mesh: Mesh, tgt) -> np.ndarray:
-    """(W, W) host count matrix: C[s, d] = rows rank s sends to rank d."""
+    """(W, W) host count matrix: C[s, d] = rows rank s sends to rank d.
+    The host pull is the exchange's first cross-rank synchronization
+    point, so it runs under the exchange watchdog: a peer that never
+    produces its counts surfaces as a typed RankDesyncError instead of an
+    infinite block (exec/recovery, ``CYLON_TPU_WATCHDOG_S``)."""
     w = mesh.devices.size
+    from ..exec.recovery import exchange_watchdog
     from ..utils.host import host_array
-    return host_array(_count_fn(mesh, w)(tgt))
+    counts_dev = _count_fn(mesh, w)(tgt)
+    return exchange_watchdog("exchange.counts",
+                             lambda: host_array(counts_dev))
 
 
 @program_cache()
@@ -293,14 +300,41 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     row_bytes = sum(int(np.dtype(c.dtype).itemsize)
                     * int(np.prod(c.shape[1:], dtype=np.int64))
                     for c in cols)
-    if (guard and on_accel
-            and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES):
-        raise MemoryError(
-            f"RESOURCE_EXHAUSTED (predicted): exchange receive allocation "
-            f"{out_cap} rows x {row_bytes} B/row exceeds "
-            f"CYLON_TPU_EXCHANGE_RECV_BUDGET "
-            f"({config.EXCHANGE_RECV_BUDGET_BYTES} B); one destination "
-            "shard would materialize the bulk of the table")
+    if guard:
+        # The raise/proceed decision is itself rank-coherent: every rank
+        # evaluates its local predicate (deterministic from the replicated
+        # count sidecar, OR a rank-selective injected fault) and any
+        # consensus runs BEFORE phase B's first collective is dispatched —
+        # "no rank-local control flow after a collective has been
+        # entered" (docs/robustness.md).  A rank whose guard did not fire
+        # still raises when any peer's did, so no rank ever enters the
+        # exchange alone.  The consensus poll itself runs ONLY when the
+        # predicate can differ from OK somewhere — over_budget is
+        # rank-uniform (replicated counts) and `armed` is rank-uniform by
+        # construction (recovery.probe) — so the un-injected happy path
+        # adds no collective and no host sync to the exchange.
+        from ..exec import recovery
+        over_budget = bool(
+            on_accel
+            and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES)
+        kind, armed = recovery.probe("shuffle.recv_guard")
+        local_fault = over_budget or kind is not None
+        if ((over_budget or armed)
+                and recovery.guard_consensus(mesh, local_fault)):
+            from ..status import PredictedResourceExhausted
+            if kind is not None and kind != "predicted":
+                # rank-selective simulation of a non-guard fault at this
+                # site (e.g. device_oom): raise the REQUESTED kind; peer
+                # ranks raise the predicted shape below and the ladder's
+                # code consensus re-aligns the branches
+                raise recovery.make_fault(kind, "shuffle.recv_guard")
+            raise PredictedResourceExhausted(
+                f"RESOURCE_EXHAUSTED (predicted): exchange receive "
+                f"allocation {out_cap} rows x {row_bytes} B/row exceeds "
+                f"CYLON_TPU_EXCHANGE_RECV_BUDGET "
+                f"({config.EXCHANGE_RECV_BUDGET_BYTES} B); one destination "
+                "shard would materialize the bulk of the table",
+                site="shuffle.recv_guard")
 
     counts_i = np.asarray(counts, np.int32)
     tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)

@@ -44,34 +44,27 @@ class BoundedCache(dict):
 
 
 def is_oom(e: Exception) -> bool:
-    """Device out-of-memory, as surfaced by XLA/PJRT."""
-    s = str(e)
-    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
-            or "out of memory" in s)
+    """Device out-of-memory, as surfaced by XLA/PJRT.  Delegates to the
+    fault-taxonomy boundary (exec/recovery — the ONE sanctioned place that
+    string-matches runtime OOM text, lint rule TS105)."""
+    from ..exec.recovery import is_oom as _is_oom
+    return _is_oom(e)
 
 
-def run_with_oom_fallback(primary, can_fallback: bool, fallback, label: str):
-    """``primary()`` with chunked-streaming OOM retries: on device OOM
-    (and ``can_fallback``) run ``fallback(n_chunks)`` at growing chunk
-    counts; non-OOM errors always propagate.  Shared by join_tables and
-    groupby_aggregate — one retry policy, two operators."""
-    try:
-        return primary()
-    except Exception as e:  # noqa: BLE001
-        if not is_oom(e) or not can_fallback:
-            raise
-        from ..utils.logging import log
-        last = e
-        for nc in (4, 16):
-            log.warning("%s OOM (%s); retrying via streaming fallback "
-                        "with %d chunks", label, type(e).__name__, nc)
-            try:
-                return fallback(nc)
-            except Exception as e2:  # noqa: BLE001
-                if not is_oom(e2):
-                    raise
-                last = e2
-        raise last
+def run_with_oom_fallback(primary, can_fallback: bool, fallback, label: str,
+                          env=None):
+    """``primary()`` with chunked-streaming capacity retries, routed
+    through the rank-coherent consensus ladder
+    (exec/recovery.run_with_recovery): faults are classified onto the
+    typed taxonomy, multiprocess sessions agree on ONE status code before
+    any retry/abort branch, and escalation is bounded and deterministic
+    (OOM: ``fallback(4)`` then ``fallback(16)``; capacity overflow: one
+    cap-halving step).  Non-fault errors always propagate.  Shared by
+    join_tables, groupby_aggregate and set_operation — one retry policy,
+    one coherence protocol.  Pass ``env`` so multiprocess sessions can
+    run the consensus all-reduce over its mesh."""
+    from ..exec.recovery import run_with_recovery
+    return run_with_recovery(primary, can_fallback, fallback, label, env=env)
 
 
 def sample_positions(n, m: int, cap: int) -> jax.Array:

@@ -582,10 +582,12 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     return run_with_oom_fallback(
         lambda: _groupby_aggregate_impl(table, by, aggs, ddof),
         can_fallback=all(a[1] in GroupBySink._DECOMP for a in aggs),
-        fallback=fallback, label="groupby")
+        fallback=fallback, label="groupby", env=table.env)
 
 
 def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
+    from ..exec.recovery import maybe_inject
+    maybe_inject("groupby.device_oom")  # device-OOM ladder test point
     env = table.env
     by = [by] if isinstance(by, str) else list(by)
     specs = _normalize_aggs(aggs)

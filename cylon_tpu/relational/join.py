@@ -947,6 +947,8 @@ def _join_packed_entry(left, right, left_on, right_on, how, suffixes,
                   and how in ("inner", "left", "right", "outer")
                   and _packed_keys_compatible(pl, pr, left_on, right_on))
     if use_packed:
+        from ..exec.recovery import maybe_inject
+        maybe_inject("join.piece_cap")  # CapacityOverflowError test point
         return _join_packed_impl(pl, pr, left_on, right_on, how, suffixes,
                                  coalesce_keys, bool(allow_defer))
     # no packed entry for this shape: materialize the window(s) and take
@@ -1001,7 +1003,7 @@ def join_tables(left: Table, right: Table, left_on, right_on,
                                   allow_defer),
         can_fallback=(not assume_colocated and coalesce_keys
                       and how not in ("semi", "anti")),
-        fallback=fallback, label="join")
+        fallback=fallback, label="join", env=left.env)
 
 
 def join_tables_multi(tables: list, ons: list, how: str = "inner",
@@ -1058,14 +1060,28 @@ def join_tables_multi(tables: list, ons: list, how: str = "inner",
         else:
             shuffled.append(shuffle_table(t, on))
     acc = shuffled[0]
-    acc_on = ons[0]
+    acc_on = list(ons[0])
     for t, on in zip(shuffled[1:], ons[1:]):
+        # Post-suffix tracking of the ACCUMULATED left key names (ADVICE
+        # r5): when the key name sets are equal the keys coalesce onto the
+        # left names; otherwise a left key colliding with a right column
+        # is renamed with suffixes[0] (mirror of _join_tables_impl's
+        # output plan).  The seed's fallback silently switched to the
+        # RIGHT table's key names here — null for unmatched rows in a
+        # `how='left'` chain, fabricating null-key matches downstream.
+        coalesce = acc_on == on
+        overlap = (set(acc.column_names) & set(t.column_names)) \
+            - (set(acc_on) if coalesce else set())
         acc = join_tables(acc, t, acc_on, on, how=how, suffixes=suffixes,
                           assume_colocated=True, allow_defer=False)
-        # keys coalesce onto the left names when equal; otherwise the
-        # accumulated left key names survive
-        acc_on = acc_on if all(n in acc.column_names for n in acc_on) \
-            else on
+        acc_on = [n if (coalesce or n not in overlap) else n + suffixes[0]
+                  for n in acc_on]
+        missing = [n for n in acc_on if n not in acc.column_names]
+        if missing:
+            raise InvalidError(
+                f"accumulated join key column(s) {missing} disappeared "
+                "after suffix renaming — choose non-colliding suffixes "
+                "or rename the payload columns before join_tables_multi")
     acc.grouped_by = None
     return acc
 
@@ -1304,7 +1320,8 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
                                       suffixes=suffixes)
 
             return run_with_oom_fallback(materialize_cols, True, fb,
-                                         "deferred-join materialize")
+                                         "deferred-join materialize",
+                                         env=env)
 
         from ..core.table import DeferredTable
         from .fused import JoinState

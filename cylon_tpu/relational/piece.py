@@ -123,6 +123,15 @@ class PackedPiece:
         self.starts = np.asarray(starts, np.int32)
         self.lens = np.asarray(lens, np.int64)
         self.piece_cap = int(piece_cap)
+        if int(self.lens.max(initial=0)) > self.piece_cap:
+            # a window wider than its static cap would silently truncate
+            # live rows inside the jitted slice — typed so the consensus
+            # ladder can take its deterministic cap-halving step
+            from ..status import CapacityOverflowError
+            raise CapacityOverflowError(
+                f"piece window of {int(self.lens.max())} live rows exceeds "
+                f"the pow2 piece cap {self.piece_cap}",
+                site="join.piece_cap")
 
     @property
     def column_names(self) -> list[str]:

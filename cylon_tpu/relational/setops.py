@@ -205,7 +205,8 @@ def set_operation(a: Table, b: Table, op: str,
 
     return run_with_oom_fallback(
         lambda: _set_operation_impl(a, b, op, assume_colocated),
-        can_fallback=not assume_colocated, fallback=fb, label="set_op")
+        can_fallback=not assume_colocated, fallback=fb, label="set_op",
+        env=a.env)
 
 
 def _set_operation_impl(a: Table, b: Table, op: str,

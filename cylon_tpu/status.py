@@ -52,6 +52,80 @@ class InvalidError(CylonError):
     code = Code.Invalid
 
 
+# ---------------------------------------------------------------------------
+# Fault taxonomy (docs/robustness.md).  Every recoverable capacity/comms
+# failure in the engine is one of these four types; the consensus retry
+# ladder (cylon_tpu.exec.recovery) dispatches on them, and string-matching
+# XLA messages outside recovery.py is a lint finding (TS105).  Each class
+# carries a short ``kind`` tag used by recovery-event logs and the
+# fault-injection grammar.
+# ---------------------------------------------------------------------------
+
+class PredictedResourceExhausted(CylonError, MemoryError):
+    """A capacity guard fired BEFORE any device allocation (e.g. the
+    exchange receive-budget guard, parallel/shuffle.py): HBM is NOT
+    poisoned, so an in-process retry at a degraded configuration is safe.
+    Subclasses MemoryError and keeps ``RESOURCE_EXHAUSTED (predicted)`` in
+    the message so pre-taxonomy callers keep classifying it as OOM."""
+
+    code = Code.OutOfMemory
+    kind = "predicted"
+
+    def __init__(self, msg: str = "", site: str | None = None):
+        super().__init__(msg)
+        self.site = site
+
+
+class DeviceOOMError(CylonError):
+    """A real XLA/PJRT RESOURCE_EXHAUSTED surfaced by the runtime: device
+    memory was actually exhausted (and on some rigs the process's HBM is
+    poisoned).  Foreign runtime errors are wrapped into this type by
+    ``cylon_tpu.exec.recovery.classify`` (the one sanctioned
+    string-matching site); the original exception rides ``__cause__``."""
+
+    code = Code.OutOfMemory
+    kind = "device_oom"
+
+    def __init__(self, msg: str = "", site: str | None = None):
+        super().__init__(msg)
+        self.site = site
+
+
+class CapacityOverflowError(CylonError):
+    """A pow2-bucketed static capacity (piece cap, output cap) was
+    exceeded by the actual row counts — the planned shape family cannot
+    hold the data; the remedy is a deterministic re-plan at a smaller
+    piece size (cap halving), not a memory retry."""
+
+    code = Code.CapacityError
+    kind = "capacity"
+
+    def __init__(self, msg: str = "", site: str | None = None):
+        super().__init__(msg)
+        self.site = site
+
+
+class RankDesyncError(CylonError):
+    """Ranks stopped advancing together: a peer hung in (or never
+    entered) a collective, detected by the exchange watchdog, or a
+    consensus poll disagreed structurally.  Carries the site and the
+    last-known timing phase for postmortems."""
+
+    code = Code.ExecutionError
+    kind = "desync"
+
+    def __init__(self, msg: str = "", site: str | None = None,
+                 phase: str | None = None):
+        super().__init__(msg)
+        self.site = site
+        self.phase = phase
+
+
+#: the four recovery-fault types, in one tuple for isinstance dispatch
+FAULT_TYPES = (PredictedResourceExhausted, DeviceOOMError,
+               CapacityOverflowError, RankDesyncError)
+
+
 class CylonTypeError(CylonError):
     code = Code.TypeError
 

@@ -831,19 +831,29 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
     deploy story for SF10+ is a pod slice, deploy/README.md)."""
     import jax
 
-    from cylon_tpu.relational.common import is_oom
+    from cylon_tpu.exec import recovery
+    from cylon_tpu.status import Code, PredictedResourceExhausted
+    # the detail block reports THIS bench invocation's recoveries only
+    # (including failed-attempt events from the halving loop below)
+    recovery.reset_events()
     while True:
         try:
             return _bench_tpch_once(scale, iters)
         except Exception as e:  # noqa: BLE001
-            if not is_oom(e) or scale <= 0.02:
+            # classify() is the taxonomy boundary — it also shims foreign
+            # exceptions that carry the XLA OOM message shape (ADVICE r5)
+            fault = recovery.classify(e)
+            if fault is None or fault.code != Code.OutOfMemory \
+                    or scale <= 0.02:
                 raise
-            if jax.devices()[0].platform != "cpu":
-                # measured (round 5): a device OOM on the axon TPU rig
-                # POISONS the process — the leaked HBM never returns and
-                # every later allocation fails, so in-process retries are
-                # doomed.  Surface the real remedy instead of burning
-                # minutes per shrinking attempt.
+            predicted = isinstance(fault, PredictedResourceExhausted)
+            if jax.devices()[0].platform != "cpu" and not predicted:
+                # measured (round 5): a REAL device OOM on the axon TPU
+                # rig POISONS the process — the leaked HBM never returns
+                # and every later allocation fails, so in-process retries
+                # are doomed.  A PREDICTED guard error is different: it
+                # fired before any allocation, HBM is untouched, and the
+                # in-process scale-halving retry below is safe.
                 raise RuntimeError(
                     f"TPC-H SF{scale:g} exceeded device memory and "
                     "this rig does not recover HBM after an OOM in the "
@@ -851,7 +861,8 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
                     "process, or use scripts/bench_tpch_q3q5.py "
                     "(column-projected ingest) for large scales") from e
             scale = scale / 2
-            print(f"# TPC-H OOM; retrying at SF{scale:g}", flush=True)
+            print(f"# TPC-H {fault.kind} OOM; retrying at SF{scale:g}",
+                  flush=True)
             # the failed attempt's tables/intermediates sit in REFERENCE
             # CYCLES (DeferredTable thunks close over their tables): the
             # retry must not inherit their device buffers
@@ -901,5 +912,13 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         "vs_baseline": 0.0,
         "detail": {"world": env.world_size, "platform": devs[0].platform,
                    "scale": scale,
+                   # was this number achieved on the happy path or after
+                   # in-run degradation (docs/robustness.md)?
+                   "recovery_events": _recovery_events(),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
+
+
+def _recovery_events() -> list:
+    from cylon_tpu.exec import recovery
+    return recovery.drain_events()

@@ -37,12 +37,18 @@ from .. import config
 #: name -> [total_seconds, call_count]
 _ACCUM: dict[str, list] = {}
 
+#: most recently entered region name — the exchange watchdog attaches it
+#: to RankDesyncError as the last-known phase (always tracked, even with
+#: timings off: one list-slot store per region)
+_LAST_REGION = [""]
+
 
 @contextlib.contextmanager
 def region(name: str, block=None):
     """Time a named region (when ``config.BENCH_TIMINGS``).  ``block`` may be
     a jax array (or pytree leaf list) to block_until_ready before stopping
     the clock, charging async device work to this region."""
+    _LAST_REGION[0] = name
     if not config.BENCH_TIMINGS:
         yield
         return
@@ -70,6 +76,21 @@ def maybe_block(x) -> None:
     if config.BENCH_TIMINGS and not config.TIMING_ASYNC:
         import jax
         jax.block_until_ready(x)
+
+
+def last_region() -> str:
+    """Name of the most recently entered region ("" before the first) —
+    the failure-recovery watchdog's last-known-phase breadcrumb."""
+    return _LAST_REGION[0]
+
+
+def bump(name: str) -> None:
+    """Count an event in the phase table without timing it (recovery
+    events, exec/recovery): shows up in :func:`snapshot` with s=0 and the
+    occurrence count.  Unconditional — recovery events are rare and must
+    be countable even without ``CYLON_TPU_BENCH``."""
+    acc = _ACCUM.setdefault(name, [0.0, 0])
+    acc[1] += 1
 
 
 def reset() -> None:

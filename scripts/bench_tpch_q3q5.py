@@ -40,6 +40,9 @@ def main():
     import cylon_tpu as ct
     from cylon_tpu import tpch
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+    from cylon_tpu.exec import recovery
+
+    recovery.reset_events()
 
     devs = jax.devices()
     on_accel = devs[0].platform != "cpu"
@@ -71,6 +74,8 @@ def main():
         "unit": "seconds",
         "detail": {"world": env.world_size, "platform": devs[0].platform,
                    "scale": scale,
+                   # happy path vs post-degradation (docs/robustness.md)
+                   "recovery_events": recovery.drain_events(),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

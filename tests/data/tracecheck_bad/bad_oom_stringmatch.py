@@ -1,0 +1,36 @@
+"""TS105 fixture: except handlers classifying OOM by message text — the
+typed fault taxonomy (cylon_tpu.status + cylon_tpu.exec.recovery.classify)
+is the sanctioned boundary; ad-hoc string matching forks the recovery
+decision away from the rank-coherent consensus ladder."""
+
+
+def retry_on_oom(op, fallback):
+    try:
+        return op()
+    except Exception as e:  # noqa: BLE001
+        if "RESOURCE_EXHAUSTED" in str(e):     # TS105: string-matched OOM
+            return fallback()
+        raise
+
+
+def swallow_oom(op):
+    try:
+        return op()
+    except RuntimeError as e:
+        msg = str(e)
+        if "out of memory" in msg.lower():     # TS105: same hazard, lowercase
+            return None
+        raise
+
+
+def nested_retry(op, fb):
+    try:
+        return op()
+    except Exception as e:  # noqa: BLE001
+        try:
+            return fb()
+        except Exception as e2:  # noqa: BLE001
+            # ONE finding despite two enclosing handlers
+            if "Out of memory" in str(e2):     # TS105
+                return None
+            raise e from e2

@@ -67,6 +67,29 @@ m = ldf["k"].isin(set(rdf["k"]))
 assert semi.row_count == int(m.sum()), (semi.row_count, int(m.sum()))
 assert anti.row_count == int((~m).sum())
 
+# Rank-coherent failure recovery (docs/robustness.md): inject a predicted
+# receive-budget fault on RANK 0 ONLY.  The guard consensus must make
+# every rank raise (and retry) identically — same streaming-fallback
+# branch, no deadlock, exactly one logged recovery event per rank — and
+# the recovered join must equal the un-injected run exactly.
+from cylon_tpu.exec import recovery
+
+baseline = (join_tables(lt, rt, "k", "k", how="inner").to_pandas()
+            .sort_values(["k", "a", "b"]).reset_index(drop=True))
+env.barrier()
+recovery.install_faults("shuffle.recv_guard:0:1=predicted")
+recovery.reset_events()
+j_inj = join_tables(lt, rt, "k", "k", how="inner")
+got_inj = (j_inj.to_pandas().sort_values(["k", "a", "b"])
+           .reset_index(drop=True))
+pd.testing.assert_frame_equal(got_inj, baseline, check_dtype=False)
+evs = recovery.recovery_events()
+assert len(evs) == 1, evs
+assert evs[0] == {"site": "join", "kind": "predicted",
+                  "action": "retry_chunks_4"}, evs
+recovery.install_faults("")
+print(f"RECOVERY_OK pid={pid} events={len(evs)}", flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

@@ -366,3 +366,53 @@ class TestMixedDecimalIngest:
             _df({"m": np.asarray([decimal.Decimal("1.5"),
                                   decimal.Decimal("Infinity")], object)},
                 env1)
+
+
+class TestMultiJoinKeyTracking:
+    """Round-5 advisor: join_tables_multi's accumulated key names must
+    survive suffix renaming.  The seed's fallback silently switched to the
+    RIGHT table's key names when a collision renamed the left keys —
+    null-valued for unmatched rows in a `how='left'` chain, fabricating
+    null-key matches against any null-keyed row downstream."""
+
+    def _frames(self):
+        # t2 carries a NON-key payload column named "k" (collides with
+        # t1's key -> k_x/k_y suffixes); t3 holds a null-keyed row.  The
+        # buggy right-key fallback joins step 2 on "j" — null for the
+        # unmatched "9" row — and nulls compare equal in this engine's
+        # joins (reference comparator semantics), so it FABRICATES the
+        # z=999 match (verified live against the seed logic); the fix
+        # joins on the renamed left key "k_x" instead.
+        t1 = pd.DataFrame({"k": ["1", "2", "3", "9"],
+                           "x": [10, 20, 30, 90]})
+        t2 = pd.DataFrame({"j": ["1", "2", "3"],
+                           "k": ["a", "b", "c"],
+                           "y": [7, 8, 9]})
+        t3 = pd.DataFrame({"m": ["1", None], "z": [111, 999]})
+        return t1, t2, t3
+
+    def _expected(self, t1, t2, t3):
+        p12 = t1.merge(t2, left_on="k", right_on="j", how="left",
+                       suffixes=("_x", "_y"))
+        return p12.merge(t3, left_on="k_x", right_on="m", how="left")
+
+    @pytest.mark.parametrize("world", ["env1", "env4"])
+    def test_colliding_left_chain_keeps_left_keys(self, world, request):
+        from cylon_tpu.relational import join_tables_multi
+        env = request.getfixturevalue(world)
+        t1, t2, t3 = self._frames()
+        out = join_tables_multi(
+            [ct.Table.from_pandas(t1, env), ct.Table.from_pandas(t2, env),
+             ct.Table.from_pandas(t3, env)],
+            ons=["k", "j", "m"], how="left")
+        exp = self._expected(t1, t2, t3)
+        got = out.to_pandas()
+        assert sorted(got.columns) == sorted(exp.columns)
+        got = got.sort_values(["k_x", "x"]).reset_index(drop=True)
+        exp = exp.sort_values(["k_x", "x"]).reset_index(drop=True)
+        # the unmatched-left row must NOT pick up t3's null-keyed payload
+        row9 = got[got["k_x"] == "9"]
+        assert row9["z"].isna().all(), row9
+        for c in exp.columns:
+            assert (got[c].fillna("<null>").tolist()
+                    == exp[c].fillna("<null>").tolist()), c

@@ -63,3 +63,6 @@ def test_multi_process_join_groupby_sort(nproc):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"MULTIHOST_OK pid={i} world={4 * nproc}" in out, out[-2000:]
+        # rank-coherent recovery: only rank 0 was injected, yet every
+        # process converged on the same retry branch without deadlock
+        assert f"RECOVERY_OK pid={i} events=1" in out, out[-2000:]

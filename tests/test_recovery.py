@@ -1,0 +1,454 @@
+"""Rank-coherent failure recovery (cylon_tpu.exec.recovery +
+cylon_tpu.status fault taxonomy): classification, the fault-injection
+harness (``CYLON_TPU_FAULTS``), every consensus-ladder branch, and the
+exchange watchdog — all exercised on the CPU rig, no real device OOM
+needed.  docs/robustness.md."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.exec import recovery
+from cylon_tpu.status import (CapacityOverflowError, Code, CylonError,
+                              DeviceOOMError, InvalidError,
+                              PredictedResourceExhausted, RankDesyncError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test starts disarmed with empty event/occurrence state."""
+    recovery.install_faults("")
+    recovery.reset_events()
+    yield
+    recovery.install_faults("")
+    recovery.reset_events()
+
+
+def _tables(env, rng, n=4000):
+    ldf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                        "a": rng.integers(0, 50, n).astype(np.int64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                        "b": rng.integers(0, 50, n).astype(np.int64)})
+    return (ldf, rdf, ct.Table.from_pandas(ldf, env),
+            ct.Table.from_pandas(rdf, env))
+
+
+# ---------------------------------------------------------------------------
+# taxonomy + classification
+# ---------------------------------------------------------------------------
+
+class TestTaxonomy:
+    def test_codes_and_kinds(self):
+        assert PredictedResourceExhausted().code == Code.OutOfMemory
+        assert DeviceOOMError().code == Code.OutOfMemory
+        assert CapacityOverflowError().code == Code.CapacityError
+        assert RankDesyncError().code == Code.ExecutionError
+        assert PredictedResourceExhausted.kind == "predicted"
+        assert DeviceOOMError.kind == "device_oom"
+        assert CapacityOverflowError.kind == "capacity"
+        assert RankDesyncError.kind == "desync"
+
+    def test_predicted_is_memoryerror(self):
+        # pre-taxonomy compat: foreign callers may catch MemoryError
+        assert isinstance(PredictedResourceExhausted(), MemoryError)
+
+    def test_classify_passthrough(self):
+        for f in (PredictedResourceExhausted("x"), DeviceOOMError("x"),
+                  CapacityOverflowError("x"), RankDesyncError("x")):
+            assert recovery.classify(f) is f
+
+    def test_classify_foreign_oom(self):
+        e = RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+        f = recovery.classify(e)
+        assert isinstance(f, DeviceOOMError) and f.__cause__ is e
+
+    def test_classify_foreign_predicted(self):
+        e = MemoryError("RESOURCE_EXHAUSTED (predicted): receive budget")
+        f = recovery.classify(e)
+        assert isinstance(f, PredictedResourceExhausted)
+
+    def test_classify_non_faults(self):
+        assert recovery.classify(ValueError("boom")) is None
+        # typed engine errors are not recovery faults
+        assert recovery.classify(InvalidError("bad arg")) is None
+
+    def test_is_oom_shim(self):
+        from cylon_tpu.relational.common import is_oom
+        assert is_oom(RuntimeError("Out of memory while trying"))
+        assert is_oom(PredictedResourceExhausted("anything"))
+        assert not is_oom(ValueError("fine"))
+
+
+# ---------------------------------------------------------------------------
+# injection harness: grammar, rank/nth selectivity
+# ---------------------------------------------------------------------------
+
+class TestInjector:
+    def test_grammar_rejects_unknown(self):
+        with pytest.raises(ValueError):
+            recovery.install_faults("nope.site=predicted")
+        with pytest.raises(ValueError):
+            recovery.install_faults("shuffle.recv_guard=nope")
+        with pytest.raises(ValueError):
+            recovery.install_faults("shuffle.recv_guard:0:1:9=predicted")
+
+    def test_nth_selectivity(self):
+        recovery.install_faults("groupby.device_oom::2=device_oom")
+        assert recovery.injected("groupby.device_oom") is None   # 1st
+        assert recovery.injected("groupby.device_oom") == "device_oom"
+        assert recovery.injected("groupby.device_oom") is None   # consumed
+
+    def test_every_occurrence(self):
+        recovery.install_faults("groupby.device_oom::*=device_oom")
+        assert all(recovery.injected("groupby.device_oom") == "device_oom"
+                   for _ in range(3))
+
+    def test_rank_selectivity(self):
+        # this controller is process 0: a rank-1 spec never fires here
+        recovery.install_faults("shuffle.recv_guard:1=predicted")
+        assert recovery.injected("shuffle.recv_guard") is None
+        recovery.install_faults("shuffle.recv_guard:0=predicted")
+        assert recovery.injected("shuffle.recv_guard") == "predicted"
+
+    def test_probe_armed_is_rank_uniform(self):
+        """`armed` must depend only on the spec list and the per-site hit
+        counter (both identical across ranks), never on whether THIS rank
+        fired — a rank-0 spec keeps every rank's guard consensus engaged
+        until its occurrence passes, then disengages everywhere."""
+        recovery.install_faults("shuffle.recv_guard:1:2=predicted")
+        # this controller is rank 0: the spec never fires here, but the
+        # site stays armed through occurrence 2 and disarms after
+        assert recovery.probe("shuffle.recv_guard") == (None, True)   # hit 1
+        assert recovery.probe("shuffle.recv_guard") == (None, True)   # hit 2
+        assert recovery.probe("shuffle.recv_guard") == (None, False)  # hit 3
+        recovery.install_faults("shuffle.recv_guard::*=predicted")
+        assert recovery.probe("shuffle.recv_guard")[1] is True
+        assert recovery.probe("shuffle.recv_guard")[1] is True
+
+    def test_unarmed_probe_is_silent(self):
+        assert recovery.probe("shuffle.recv_guard") == (None, False)
+
+    def test_all_four_kinds_constructible(self):
+        """Acceptance: every typed fault kind is constructible via
+        injection on the CPU rig."""
+        recovery.install_faults("join.piece_cap=capacity")
+        with pytest.raises(CapacityOverflowError):
+            recovery.maybe_inject("join.piece_cap")
+        recovery.install_faults("shuffle.recv_guard=predicted")
+        with pytest.raises(PredictedResourceExhausted):
+            recovery.maybe_inject("shuffle.recv_guard")
+        recovery.install_faults("groupby.device_oom=device_oom")
+        with pytest.raises(RuntimeError) as ei:  # foreign-shaped on purpose
+            recovery.maybe_inject("groupby.device_oom")
+        assert isinstance(recovery.classify(ei.value), DeviceOOMError)
+        recovery.install_faults("exchange.stall=desync")
+        with pytest.raises(RankDesyncError):
+            recovery.maybe_inject("exchange.stall")
+
+
+# ---------------------------------------------------------------------------
+# ladder branches (unit level)
+# ---------------------------------------------------------------------------
+
+class TestLadder:
+    def test_ok_passthrough(self):
+        assert recovery.run_with_recovery(
+            lambda: 42, True, lambda nc: None, "t") == 42
+        assert recovery.recovery_events() == []
+
+    def test_oom_rungs_4_then_16(self):
+        seen = []
+
+        def fb(nc):
+            seen.append(nc)
+            if nc == 4:
+                raise RuntimeError("RESOURCE_EXHAUSTED again")
+            return "ok"
+
+        def boom():
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        assert recovery.run_with_recovery(boom, True, fb, "t") == "ok"
+        assert seen == [4, 16]
+        acts = [e["action"] for e in recovery.recovery_events()]
+        assert acts == ["retry_chunks_4", "retry_chunks_16"]
+
+    def test_capacity_single_halving_rung(self):
+        seen = []
+
+        def boom():
+            raise CapacityOverflowError("cap", site="join.piece_cap")
+
+        assert recovery.run_with_recovery(
+            boom, True, lambda nc: seen.append(nc) or "ok", "t") == "ok"
+        assert seen == [8]  # exactly one cap-halving step
+
+    def test_exhaustion_raises_typed(self):
+        def boom():
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        def fb(nc):
+            raise RuntimeError("RESOURCE_EXHAUSTED still")
+
+        with pytest.raises(DeviceOOMError):
+            recovery.run_with_recovery(boom, True, fb, "t")
+        acts = [e["action"] for e in recovery.recovery_events()]
+        assert acts == ["retry_chunks_4", "retry_chunks_16", "abort"]
+
+    def test_non_fault_propagates_untouched(self):
+        def boom():
+            raise ValueError("not a fault")
+
+        with pytest.raises(ValueError):
+            recovery.run_with_recovery(boom, True, lambda nc: "ok", "t")
+        assert recovery.recovery_events() == []
+
+    def test_desync_never_retries(self):
+        def boom():
+            raise RankDesyncError("peer hung", site="exchange.stall")
+
+        with pytest.raises(RankDesyncError):
+            recovery.run_with_recovery(boom, True, lambda nc: "ok", "t")
+        assert [e["action"] for e in recovery.recovery_events()] == ["abort"]
+
+    def test_nested_ladder_never_reescalates(self):
+        """A fallback re-entering a guarded op gets NO rungs of its own —
+        the outer ladder owns the bounded escalation."""
+        inner_fallback_calls = []
+
+        def inner():
+            def boom():
+                raise RuntimeError("RESOURCE_EXHAUSTED inner")
+            return recovery.run_with_recovery(
+                boom, True, lambda nc: inner_fallback_calls.append(nc),
+                "inner")
+
+        def fb(nc):
+            if nc == 4:
+                inner()  # typed DeviceOOMError escalates the OUTER ladder
+            return "ok"
+
+        def boom():
+            raise RuntimeError("RESOURCE_EXHAUSTED outer")
+
+        assert recovery.run_with_recovery(boom, True, fb, "outer") == "ok"
+        assert inner_fallback_calls == []
+
+    def test_counted_in_timing_stats(self):
+        from cylon_tpu.utils import timing
+
+        def boom():
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        recovery.run_with_recovery(boom, True, lambda nc: "ok", "t")
+        snap = timing.snapshot()
+        assert any(k.startswith("recovery.t.device_oom.retry")
+                   for k in snap), snap
+
+
+# ---------------------------------------------------------------------------
+# ladder branches through the real operators (injection-driven)
+# ---------------------------------------------------------------------------
+
+class TestInjectedOperators:
+    def test_predicted_guard_retry_join(self, env4, rng):
+        """The acceptance scenario, single-controller edition: a predicted
+        receive-budget fault at the shuffle guard reroutes the join through
+        the streaming pipeline with ONE logged recovery event, and the
+        result is identical to the un-injected run."""
+        from cylon_tpu.relational import join_tables
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        recovery.install_faults("shuffle.recv_guard:0:1=predicted")
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        got = j.to_pandas().sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        exp = ldf.merge(rdf, on="k").sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                      check_dtype=False)
+        evs = recovery.recovery_events()
+        assert len(evs) == 1, evs
+        assert evs[0] == {"site": "join", "kind": "predicted",
+                          "action": "retry_chunks_4"}
+
+    def test_device_oom_escalates_to_16(self, env4, rng):
+        """4 → 16 chunk escalation: the first fallback rung hits the
+        (still-armed) injected fault, the second succeeds."""
+        from cylon_tpu.relational import groupby_aggregate
+        ldf, _, _, _ = _tables(env4, rng)
+        t = ct.Table.from_pandas(ldf, env4)
+        recovery.install_faults(
+            "groupby.device_oom::1=device_oom,"
+            "groupby.device_oom::2=device_oom")
+        g = groupby_aggregate(t, "k", [("a", "sum")])
+        got = g.to_pandas().sort_values("k").reset_index(drop=True)
+        exp = (ldf.groupby("k", as_index=False).agg(a_sum=("a", "sum")))
+        exp.columns = got.columns
+        pd.testing.assert_frame_equal(got, exp.sort_values("k")
+                                      .reset_index(drop=True),
+                                      check_dtype=False)
+        acts = [e["action"] for e in recovery.recovery_events()]
+        assert "retry_chunks_4" in acts and "retry_chunks_16" in acts
+
+    def test_device_oom_exhaustion_typed_raise(self, env4, rng):
+        """4 → 16 → typed DeviceOOMError when the fault never clears."""
+        from cylon_tpu.relational import groupby_aggregate
+        ldf, _, _, _ = _tables(env4, rng, n=1200)
+        t = ct.Table.from_pandas(ldf, env4)
+        recovery.install_faults("groupby.device_oom::*=device_oom")
+        with pytest.raises(DeviceOOMError):
+            groupby_aggregate(t, "k", [("a", "sum")])
+        acts = [e["action"] for e in recovery.recovery_events()
+                if e["site"] == "groupby"]
+        assert acts[0] == "retry_chunks_4"
+        assert "retry_chunks_16" in acts
+        assert acts[-1] == "abort"
+
+    def test_capacity_overflow_escalates_ladder(self, env4, rng):
+        """An injected CapacityOverflowError on the first packed-piece
+        join (inside the 4-chunk fallback) moves the outer ladder to its
+        next rung (halving the piece caps) and still completes
+        correctly."""
+        from cylon_tpu.relational import join_tables
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        recovery.install_faults(
+            "shuffle.recv_guard:0:1=predicted,join.piece_cap::1=capacity")
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        got = j.to_pandas().sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        exp = ldf.merge(rdf, on="k").sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                      check_dtype=False)
+        acts = [e["action"] for e in recovery.recovery_events()
+                if e["site"] == "join"]
+        # predicted -> 4-chunk rung (hits capacity fault) -> 16-chunk rung
+        assert acts[0] == "retry_chunks_4"
+        assert "retry_chunks_16" in acts
+
+    def test_packed_piece_cap_check_is_typed(self, env4, rng):
+        from cylon_tpu.relational.piece import PieceSource
+        ldf, _, _, _ = _tables(env4, rng, n=800)
+        t = ct.Table.from_pandas(ldf, env4)
+        src = PieceSource(t, pad=8)
+        w = env4.world_size
+        with pytest.raises(CapacityOverflowError):
+            src.packed(np.zeros(w, np.int64), np.full(w, 64, np.int64),
+                       piece_cap=32)
+
+
+# ---------------------------------------------------------------------------
+# consensus + watchdog
+# ---------------------------------------------------------------------------
+
+class TestConsensusAndWatchdog:
+    def test_consensus_single_controller_is_local(self, env4):
+        # one process drives the whole mesh: the local code IS the vote
+        assert recovery.consensus_code(env4.mesh, Code.OK) == Code.OK
+        assert recovery.consensus_code(
+            env4.mesh, Code.OutOfMemory) == Code.OutOfMemory
+        assert recovery.consensus_code(None, Code.CapacityError) \
+            == Code.CapacityError
+
+    def test_consensus_program_is_one_pmax(self, env8):
+        """The consensus builder's program: a single unconditional pmax —
+        verified the same way the trace-safety gate does."""
+        from cylon_tpu.analysis import jaxpr_check, registry
+        registry.collect()
+        decl = registry.get("cylon_tpu.exec.recovery._consensus_fn")
+        assert decl is not None and decl.collectives == {"pmax"}
+        assert jaxpr_check.verify_builder(decl, env8.mesh) == []
+
+    def test_guard_consensus_local(self, env4):
+        assert recovery.guard_consensus(env4.mesh, True)
+        assert not recovery.guard_consensus(env4.mesh, False)
+
+    def test_watchdog_passthrough_when_off(self):
+        assert recovery.exchange_watchdog("exchange.counts",
+                                          lambda: 7, timeout_s=0) == 7
+
+    def test_watchdog_completes_within_deadline(self):
+        assert recovery.exchange_watchdog("exchange.counts",
+                                          lambda: 7, timeout_s=5.0) == 7
+
+    def test_watchdog_propagates_thunk_error(self):
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError):
+            recovery.exchange_watchdog("exchange.counts", boom,
+                                       timeout_s=5.0)
+
+    def test_watchdog_converts_stall_to_desync(self):
+        """An injected peer stall becomes a typed RankDesyncError carrying
+        the site and the last-known timing phase."""
+        from cylon_tpu.utils import timing
+        with timing.region("pipe.unit_test_phase"):
+            pass
+        recovery.install_faults("exchange.stall=stall")
+        with pytest.raises(RankDesyncError) as ei:
+            recovery.exchange_watchdog("exchange.counts",
+                                       lambda: 7, timeout_s=0.2)
+        assert ei.value.site == "exchange.counts"
+        assert ei.value.phase == "pipe.unit_test_phase"
+
+    def test_watchdog_stall_through_shuffle(self, env4, rng, monkeypatch):
+        """End to end: a stalled exchange count pull surfaces as a typed
+        RankDesyncError from shuffle_table (no infinite block), and the
+        ladder refuses to retry it."""
+        from cylon_tpu import config
+        from cylon_tpu.relational.repart import shuffle_table
+        monkeypatch.setattr(config, "EXCHANGE_WATCHDOG_S", 0.2)
+        ldf, _, lt, _ = _tables(env4, rng, n=800)
+        recovery.install_faults("exchange.stall=stall")
+        with pytest.raises(RankDesyncError):
+            shuffle_table(lt, ["k"])
+
+
+# ---------------------------------------------------------------------------
+# taxonomy at the real guard site
+# ---------------------------------------------------------------------------
+
+class TestGuardSiteTyped:
+    def test_peer_fault_placeholder_is_typed(self):
+        """Ranks following a peer's agreed fault must synthesize a TYPED
+        taxonomy fault of the SAME class (the wire encoding separates
+        predicted from device OOM) — classify() passes it through,
+        keeping enclosing ladders and type-dispatching callers (e.g.
+        bench_tpch's abort-vs-halve) on the same branch on every rank."""
+        from cylon_tpu.exec.recovery import _fault_from_wire, _wire_code
+        for local in (PredictedResourceExhausted("x"), DeviceOOMError("x"),
+                      CapacityOverflowError("x"), RankDesyncError("x")):
+            synth = _fault_from_wire(_wire_code(local), "peer")
+            assert type(synth) is type(local), (local, synth)
+            assert recovery.classify(synth) is synth
+        # predicted sorts BELOW a real device OOM within Code.OutOfMemory:
+        # mixed ranks coherently agree on the device_oom interpretation
+        assert _wire_code(PredictedResourceExhausted("x")) \
+            < _wire_code(DeviceOOMError("x"))
+        assert _wire_code(None) == 0
+
+    def test_recv_guard_honors_injected_kind(self, env4, rng):
+        """A non-predicted kind injected at the guard site raises THAT
+        kind (not the predicted shape), so simulations of real device
+        OOMs at the exchange behave like real device OOMs."""
+        from cylon_tpu.relational.repart import shuffle_table
+        ldf, _, lt, _ = _tables(env4, rng, n=800)
+        recovery.install_faults("shuffle.recv_guard::1=capacity")
+        with pytest.raises(CapacityOverflowError):
+            shuffle_table(lt, ["k"])
+
+    def test_recv_guard_raises_typed(self, env8, rng, monkeypatch):
+        from cylon_tpu import config
+        from cylon_tpu.relational.repart import shuffle_table
+        monkeypatch.setattr(config, "EXCHANGE_RECV_BUDGET_BYTES", 4096)
+        monkeypatch.setattr(config, "EXCHANGE_RECV_GUARD_CPU", True)
+        n = 4000
+        t = ct.Table.from_pandas(
+            pd.DataFrame({"k": np.full(n, 7, np.int64),
+                          "v": rng.random(n)}), env8)
+        with pytest.raises(PredictedResourceExhausted) as ei:
+            shuffle_table(t, ["k"])
+        assert ei.value.site == "shuffle.recv_guard"
+        assert "RESOURCE_EXHAUSTED (predicted)" in str(ei.value)

@@ -62,6 +62,31 @@ def test_ts104_lru_mesh_fixture():
     assert "_builder_fn" in found[0].message
 
 
+def test_ts105_oom_stringmatch_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_oom_stringmatch.py")) if f.rule == "TS105"]
+    # one finding PER STRING MATCH — the nested-handler case must not
+    # double-report its single match through both enclosing handlers
+    assert len(found) == 3
+    assert len({(f.line,) for f in found}) == 3
+    assert all("recovery" in f.message for f in found)
+
+
+def test_ts105_sanctioned_in_recovery_module():
+    # the identical pattern inside exec/recovery.py is the sanctioned
+    # classification boundary and must NOT be flagged
+    src = ("def f(op):\n"
+           "    try:\n"
+           "        return op()\n"
+           "    except Exception as e:\n"
+           "        if 'RESOURCE_EXHAUSTED' in str(e):\n"
+           "            return None\n"
+           "        raise\n")
+    assert ast_lint.lint_source("cylon_tpu/exec/recovery.py", src) == []
+    assert any(f.rule == "TS105"
+               for f in ast_lint.lint_source("cylon_tpu/other.py", src))
+
+
 def test_suppression_silences_everything():
     assert ast_lint.lint_file(os.path.join(BAD, "suppressed.py")) == []
 
@@ -85,7 +110,8 @@ def test_package_lints_clean():
 
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
-    assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104"}
+    assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
+                                       "TS105"}
 
 
 # ---------------------------------------------------------------------------
