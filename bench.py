@@ -61,7 +61,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     import cylon_tpu as ct
     from cylon_tpu import config
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
-    from cylon_tpu.exec import recovery
+    from cylon_tpu.exec import memory, recovery
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.utils import timing
 
@@ -97,7 +97,12 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     # rows/chip in 16 GB HBM; the north-star config (125M rows/chip = 1B
     # rows on v5e-8, BASELINE.json) runs through the range-partitioned
     # pipeline (exec/pipeline.py), whose per-piece working set is 1/R.
-    pipelined = rows_per_chip > 48_000_000
+    # CYLON_TPU_BENCH_PIPELINE=1 forces the pipelined route at any size —
+    # e.g. to demonstrate the HBM-budget spill tier on a CPU rig
+    # (CYLON_TPU_HBM_BUDGET below the resident working set makes the
+    # detail's spill_events go positive; docs/robustness.md).
+    pipelined = (rows_per_chip > 48_000_000
+                 or os.environ.get("CYLON_TPU_BENCH_PIPELINE") == "1")
     n_chunks = max(2, -(-rows_per_chip // 21_000_000)) if pipelined else 1
 
     if pipelined:
@@ -132,6 +137,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     prev_async = config.TIMING_ASYNC
     config.BENCH_TIMINGS = False
     recovery.reset_events()  # detail reports THIS workload's recoveries
+    memory.reset_stats()     # ... and THIS workload's spill traffic
     try:
         step()  # warmup + compile
         times = []
@@ -173,6 +179,11 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # (site, kind, action) per recovery: was the number achieved
             # on the happy path or after degradation? (docs/robustness.md)
             "recovery_events": recovery.drain_events(),
+            # spill-tier traffic (exec/memory): resident vs host-spilled
+            # state — a throughput number with spill_events > 0 was
+            # PCIe-assisted, not HBM-resident
+            **{k: v for k, v in memory.stats().items() if k in
+               ("spill_events", "bytes_spilled", "peak_ledger_bytes")},
         },
     }
 

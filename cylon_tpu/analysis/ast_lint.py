@@ -30,7 +30,13 @@ function within the same module) — and flags:
   ``exec/recovery.py`` — the typed fault taxonomy
   (:mod:`cylon_tpu.status`, ``exec/recovery.classify``) is the sanctioned
   classification boundary; ad-hoc matching forks the recovery decision
-  away from the rank-coherent consensus ladder.
+  away from the rank-coherent consensus ladder;
+* **TS106** bare ``jax.device_put``/``jax.device_get`` of (lane-sized)
+  arrays in ``relational/`` or ``parallel/`` modules — residency changes
+  of operator state must go through the HBM ledger
+  (:mod:`cylon_tpu.exec.memory`): an unaccounted upload skews every
+  budget decision, and an unaccounted pull bypasses the spill tier's
+  eviction bookkeeping AND the ``utils.host`` transfer funnel.
 
 The pass is heuristic by design (a linter, not a verifier): it
 under-approximates taint (module-local call graph only) and exempts
@@ -57,6 +63,12 @@ _CAST_BUILTINS = {"float", "int", "bool", "complex"}
 _OOM_TEXT_MARKERS = ("resource_exhausted", "out of memory")
 #: the one module allowed to string-match OOM text (path suffix)
 _RECOVERY_MODULE = "exec/recovery.py"
+
+#: directories whose modules may not change array residency directly
+#: (TS106): all device_put/device_get of operator state goes through the
+#: exec/memory HBM ledger
+_RESIDENCY_DIRS = ("relational", "parallel")
+_RESIDENCY_FUNCS = {"device_put", "device_get"}
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -315,6 +327,7 @@ class _ModuleLint:
                 self._check_traced_body(fn, fn.name in roots)
         self._check_jit_sites()
         self._check_oom_stringmatch()
+        self._check_device_residency()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -420,6 +433,31 @@ class _ModuleLint:
                         "(cylon_tpu.exec.recovery.classify / is_oom); "
                         "ad-hoc matching bypasses the rank-coherent "
                         "recovery ladder")
+
+    def _check_device_residency(self) -> None:
+        """TS106: a bare ``jax.device_put``/``jax.device_get`` (or the
+        bare imported name) inside a ``relational/`` or ``parallel/``
+        module changes array residency behind the HBM ledger's back —
+        every upload/eviction of operator state must go through
+        :mod:`cylon_tpu.exec.memory` (which is outside these directories
+        and therefore exempt by construction)."""
+        parts = self.path.replace(os.sep, "/").split("/")
+        if not any(d in parts for d in _RESIDENCY_DIRS):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name(node.func)
+            leaf = fname.split(".")[-1]
+            if leaf in _RESIDENCY_FUNCS and fname in (
+                    leaf, f"jax.{leaf}", f"_jax.{leaf}"):
+                self._emit(
+                    "TS106", node,
+                    f"`{fname}` changes array residency outside the HBM "
+                    "ledger — route uploads/evictions through "
+                    "cylon_tpu.exec.memory (register/evict/"
+                    "upload_window) so budget and spill decisions stay "
+                    "accounted and rank-coherent")
 
     def _check_jit_sites(self) -> None:
         for node in ast.walk(self.tree):

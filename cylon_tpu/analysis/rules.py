@@ -31,6 +31,9 @@ RULES = {
     "TS105": "except handler classifies OOM by string-matching outside the "
              "recovery module (the fault taxonomy is the sanctioned "
              "boundary)",
+    "TS106": "bare jax.device_put/device_get in relational/ or parallel/ "
+             "(residency changes must go through the exec/memory HBM "
+             "ledger)",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -158,6 +158,20 @@ EXCHANGE_RECV_BUDGET_BYTES = int(os.environ.get(
 #: normally far above HBM-sized budgets, so default off)
 EXCHANGE_RECV_GUARD_CPU = _env_flag("CYLON_TPU_EXCHANGE_GUARD_CPU", False)
 
+#: HBM budget for the resident-allocation ledger (exec/memory), in TOTAL
+#: bytes across the mesh.  0 (default) = platform-detected: per-chip
+#: ``bytes_limit`` × device count on accelerators, unlimited on CPU rigs
+#: (host RAM, not HBM, is the ceiling there).  Set it below the resident
+#: working set to force the host spill tier — cold packed sources evict
+#: to host RAM and re-upload per piece window (docs/robustness.md).
+HBM_BUDGET_BYTES = int(os.environ.get("CYLON_TPU_HBM_BUDGET", "0"))
+
+#: Host spill tier switch (``CYLON_TPU_SPILL=0`` disables eviction; the
+#: ledger keeps accounting either way).  With spill off, memory pressure
+#: degrades through the pre-existing rungs only (chunk escalation /
+#: typed abort).
+SPILL_ENABLED = _env_flag("CYLON_TPU_SPILL", True)
+
 #: Exchange watchdog deadline in seconds (0 = off, the default): blocking
 #: multihost exchange host-syncs run under this timeout and a peer hang
 #: surfaces as a typed RankDesyncError (site + last-known phase attached)

@@ -41,7 +41,9 @@ def default_env() -> CylonEnv:
 
 
 class Table:
-    __slots__ = ("_cols", "_env", "_valid", "grouped_by")
+    # __weakref__: the HBM ledger (exec/memory.register_table) anchors
+    # byte registrations to table lifetime via weakref.finalize
+    __slots__ = ("_cols", "_env", "_valid", "grouped_by", "__weakref__")
 
     def __init__(self, cols: Mapping[str, Column], env: CylonEnv | None,
                  valid_counts: np.ndarray | None = None):

@@ -1,0 +1,658 @@
+"""HBM budget ledger + host spill tier — graceful degradation under
+memory pressure.
+
+The paper's answer to a distributed operator outgrowing device memory is
+abort-and-rerun; PR 3's consensus retry ladder improved that to
+*recompute at higher chunk counts* or *halve piece caps* — both throw
+away completed device work, and neither knows how much HBM is actually
+held by resident state.  This module closes that gap with the same
+mechanism a training stack uses for activation offload:
+
+1. **HBM budget ledger** (:class:`Ledger`): every long-lived resident
+   allocation — packed lane matrices and f64 side arrays
+   (:class:`~cylon_tpu.relational.piece.PieceSource`), GroupBySink
+   partials, exchange receive buffers — registers its byte count under a
+   deterministic owner name.  The ledger is consulted by the exchange
+   receive-budget guard (:mod:`cylon_tpu.parallel.shuffle`) and by the
+   pipelined join's piece working-set sizing, against a budget from
+   ``CYLON_TPU_HBM_BUDGET`` (total bytes across the mesh) with a
+   platform-detected default (per-chip ``bytes_limit`` × device count on
+   accelerators; unlimited on CPU).
+
+2. **Host spill tier**: cold spillable registrations evict to host RAM
+   — LRU by last piece-loop access (:func:`touch`), per-shard pulls
+   through the sanctioned :mod:`cylon_tpu.utils.host` funnel
+   (``host_shard_blocks``: each process reads only its addressable
+   shards, so the transport is collective-free) — and re-enter the
+   device *per window*
+   (:func:`upload_window`): a host-resident
+   :class:`~cylon_tpu.relational.piece.PieceSource` uploads only the
+   current range piece's rows, and the pipelined join's range loop
+   double-buffers so piece r+1's upload overlaps piece r's compute.
+   Spill round-trips are bit-exact (u32/f64 arrays move unchanged).
+
+3. **Collective coherence**: eviction is a COLLECTIVE decision.  A
+   rank-local eviction would change that rank's guard predicates and
+   retry branches while its peers proceed — the same desync a
+   rank-local retry causes — and the eviction's own host pulls are
+   collectives in a multiprocess session.  Registrations and LRU order
+   advance at uniform program points, but a raw balance READ is uniform
+   only up to GC release timing, so no multiprocess decision gates on
+   it: admission polls whenever a budget is configured, agrees on the
+   eviction COUNT (max of each rank's deterministic
+   :meth:`Ledger.evict_count_for`) over the PR 3 consensus wire
+   (:func:`cylon_tpu.exec.recovery.count_consensus`), and every rank
+   then evicts that many oldest owners — same owners, same order
+   (asserted cross-rank by ``tests/multihost_driver.py``).  The
+   ladder's spill rung agrees its take-the-rung decision the same way
+   (:func:`~cylon_tpu.exec.recovery.spill_consensus`), and rank-local
+   shortcuts (:func:`try_free`) are single-controller only.
+
+4. **Ladder integration**: ``run_with_recovery`` gains a new FIRST rung
+   — *spill-then-retry at the same chunk count*
+   (:func:`spill_for_retry`) — so a
+   :class:`~cylon_tpu.status.PredictedResourceExhausted` first tries to
+   free resident bytes without discarding any completed work; chunk
+   escalation remains the backstop (docs/robustness.md).
+
+Escape hatches: ``CYLON_TPU_SPILL=0`` disables eviction entirely (the
+ledger keeps accounting); ``CYLON_TPU_HBM_BUDGET`` overrides the
+detected budget.  With spill disabled and no faults armed, the happy
+path through :func:`ensure_headroom` is a couple of dict lookups — no
+collectives, no host syncs.
+
+Trace-safety note (TS106): this module is the ONE sanctioned place that
+changes residency of lane-sized arrays — a bare
+``jax.device_put``/``jax.device_get`` in ``relational/`` or
+``parallel/`` bypasses the ledger and is a lint finding.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+
+from .. import config
+from ..utils import timing
+
+#: injector kinds at the spill sites that RAISE as typed faults (the
+#: rest — ``predicted`` = simulated pressure, ``spill_stall``/``stall``
+#: = simulated transfer hang — steer the spill machinery instead)
+_RAISE_KINDS = ("device_oom", "capacity", "desync")
+
+
+def _spill_enabled() -> bool:
+    return config.SPILL_ENABLED
+
+
+# ---------------------------------------------------------------------------
+# budget
+# ---------------------------------------------------------------------------
+
+_BUDGET_CACHE: list = []  # [int] once detected; empty = not yet probed
+
+
+def budget_bytes() -> int:
+    """The ledger's budget in TOTAL bytes across the mesh: the
+    ``CYLON_TPU_HBM_BUDGET`` override when set, else per-chip
+    ``bytes_limit`` × device count on accelerators, else 0 (unlimited —
+    CPU rigs where host RAM, not HBM, is the ceiling).  Detected lazily
+    (the backend must already be initialized) and cached."""
+    if config.HBM_BUDGET_BYTES > 0:
+        return config.HBM_BUDGET_BYTES
+    if _BUDGET_CACHE:
+        return _BUDGET_CACHE[0]
+    import jax
+    total = 0
+    try:
+        devs = jax.devices()
+        if devs and devs[0].platform != "cpu":
+            per = 0
+            try:
+                per = int((devs[0].memory_stats() or {}).get(
+                    "bytes_limit", 0))
+            except Exception:  # noqa: BLE001 — backend without stats
+                per = 0
+            total = (per or 16 * 1024**3) * len(devs)
+    except Exception:  # noqa: BLE001 — no backend yet: stay unlimited
+        return 0
+    _BUDGET_CACHE.append(total)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# registrations + ledger
+# ---------------------------------------------------------------------------
+
+def _nbytes(arrays) -> int:
+    return sum(int(np.prod(a.shape, dtype=np.int64))
+               * int(np.dtype(a.dtype).itemsize) for a in arrays
+               if a is not None)
+
+
+class Registration:
+    """One resident allocation's ledger entry — also the owner's HANDLE
+    to its arrays: spillable owners read their device arrays through
+    :attr:`arrays` (None while spilled) so eviction can actually drop
+    the device references.  ``host`` (while spilled) is a tuple of
+    PER-SHARD host block lists (``utils.host.host_shard_blocks``): each
+    process holds only its addressable shards, which keeps both the
+    eviction pull and the re-upload collective-free."""
+
+    __slots__ = ("owner", "nbytes", "spillable", "seq", "arrays", "host",
+                 "sharding", "world", "live", "__weakref__")
+
+    def __init__(self, owner: str, arrays, spillable: bool, sharding,
+                 seq: int):
+        self.owner = owner
+        self.nbytes = _nbytes(arrays)
+        self.spillable = bool(spillable)
+        # only a SPILLABLE entry holds its arrays (it must be able to
+        # drop the device references on eviction); a bookkeeping-only
+        # entry keeping them would pin its own anchor and never drain
+        self.arrays = tuple(arrays) if spillable else ()
+        self.sharding = sharding
+        self.world = (int(sharding.mesh.devices.size)
+                      if sharding is not None else 1)
+        self.seq = seq
+        self.host: tuple | None = None
+        self.live = True
+
+    @property
+    def spilled(self) -> bool:
+        return self.host is not None
+
+
+class Ledger:
+    """Owner-named byte accounting for resident device allocations, with
+    LRU host eviction of spillable entries.  All state transitions are
+    deterministic functions of the (rank-uniform) registration and
+    access sequence, so a multiprocess session's ledgers stay identical
+    across ranks by construction."""
+
+    def __init__(self):
+        self._live: dict[str, Registration] = {}
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._names = 0
+        self.peak = 0
+
+    # -- accounting --------------------------------------------------------
+    def balance(self) -> int:
+        with self._lock:
+            return sum(r.nbytes for r in self._live.values()
+                       if not r.spilled)
+
+    def spillable_bytes(self) -> int:
+        with self._lock:
+            return sum(r.nbytes for r in self._live.values()
+                       if r.spillable and not r.spilled)
+
+    def owners(self) -> list[str]:
+        with self._lock:
+            return sorted(self._live, key=lambda o: self._live[o].seq)
+
+    # -- registration lifecycle --------------------------------------------
+    def register(self, base: str, arrays, spillable: bool = False,
+                 sharding=None, anchor=None) -> Registration:
+        """Register a resident allocation under a deterministic owner
+        name ``base#<n>`` (the counter advances identically on every
+        rank).  ``anchor``: auto-release when this object is collected
+        (the registration must not outlive — or leak past — its owner)."""
+        with self._lock:
+            self._names += 1
+            self._seq += 1
+            reg = Registration(f"{base}#{self._names}", arrays, spillable,
+                               sharding, self._seq)
+            self._live[reg.owner] = reg
+            self.peak = max(self.peak, self.balance())
+        if anchor is not None:
+            try:
+                weakref.finalize(anchor, self.release, reg)
+            except TypeError:
+                pass  # not weakrefable: caller releases explicitly
+        return reg
+
+    def touch(self, reg: Registration | None) -> None:
+        """LRU bump: record a piece-loop access of this registration."""
+        if reg is None or not reg.live:
+            return
+        with self._lock:
+            self._seq += 1
+            reg.seq = self._seq
+
+    def release(self, reg: Registration | None) -> None:
+        """Drop a registration (idempotent): device and host copies are
+        unpinned and the balance drains — never below zero."""
+        if reg is None or not reg.live:
+            return
+        with self._lock:
+            reg.live = False
+            self._live.pop(reg.owner, None)
+            reg.arrays = ()
+            reg.host = None
+
+    # -- spill tier --------------------------------------------------------
+    def evict(self, reg: Registration, stall: bool = False) -> int:
+        """Move one spillable registration's arrays to host RAM — a
+        PER-SHARD, collective-free pull (each process reads only its
+        addressable shards; ``utils.host.host_shard_blocks``) under the
+        exchange watchdog — and drop the device references.  Returns the
+        bytes freed (0 if not evictable).  Bit-exact: the arrays are raw
+        u32 lane matrices / f64 side channels."""
+        if not (reg.live and reg.spillable and not reg.spilled
+                and reg.arrays):
+            return 0
+        from . import recovery
+        from ..utils.host import host_shard_blocks
+        devs, w = list(reg.arrays), reg.world
+        with timing.region("spill.evict"):
+            # stalled is passed explicitly (never probed): a spill-site
+            # eviction must not consume `exchange.stall` injections meant
+            # for the exchange path
+            host = recovery.exchange_watchdog(
+                "spill.evict",
+                lambda: tuple(host_shard_blocks(a, w) for a in devs),
+                timeout_s=_stall_timeout(stall), stalled=stall)
+        with self._lock:
+            reg.host = host
+            reg.arrays = ()
+        _note_spill("spill.evict", reg)
+        return reg.nbytes
+
+    def readmit(self, reg: Registration, stall: bool = False) -> tuple:
+        """Re-upload a spilled registration's FULL arrays to the device
+        (the whole-matrix complement of the per-window
+        :func:`upload_window` path) and return them.  Not on the
+        overlap-critical path, so with ``CYLON_TPU_WATCHDOG_S`` armed
+        the readiness check blocks under the watchdog — a hung transfer
+        surfaces typed at ``spill.upload``."""
+        if not (reg.live and reg.spilled):
+            return reg.arrays
+        arrs = _upload(list(reg.host), reg.sharding, stall=stall)
+        if config.EXCHANGE_WATCHDOG_S > 0 and not stall:
+            import jax
+            from . import recovery
+            recovery.exchange_watchdog(
+                "spill.upload", lambda: jax.block_until_ready(list(arrs)),
+                stalled=False)
+        with self._lock:
+            reg.arrays = tuple(arrs)
+            reg.host = None
+            self._seq += 1
+            reg.seq = self._seq
+            self.peak = max(self.peak, self.balance())
+        _STATS["readmit_events"] += 1
+        _STATS["bytes_readmitted"] += reg.nbytes
+        timing.add_bytes("spill.upload", reg.nbytes)
+        return reg.arrays
+
+    def _spill_cands(self) -> list[Registration]:
+        """Spillable, still-resident entries, oldest ``seq`` first — the
+        deterministic LRU eviction order."""
+        with self._lock:
+            return sorted((r for r in self._live.values()
+                           if r.spillable and not r.spilled),
+                          key=lambda r: r.seq)
+
+    def evict_count_for(self, need: int, budget: int) -> int:
+        """How many LRU evictions bring ``balance + need`` under the
+        budget (0 when already under or no budget; all candidates when
+        even that is insufficient).  A pure function of the ledger — the
+        number, not the balance, is what multiprocess sessions agree on
+        (max across ranks) before anyone evicts."""
+        if budget <= 0:
+            return 0
+        bal = self.balance()
+        if bal + need <= budget:
+            return 0
+        n = 0
+        for r in self._spill_cands():
+            n += 1
+            bal -= r.nbytes
+            if bal + need <= budget:
+                break
+        return n
+
+    def evict_n(self, n: int, stall: bool = False) -> list[str]:
+        """Evict the ``n`` oldest spillable entries (fewer if the ledger
+        has fewer candidates).  Returns the evicted owner names in
+        eviction order — identical on every rank by construction."""
+        evicted: list[str] = []
+        for reg in self._spill_cands()[:max(int(n), 0)]:
+            if self.evict(reg, stall=stall):
+                evicted.append(reg.owner)
+        return evicted
+
+    def evict_until(self, need: int, budget: int,
+                    stall: bool = False) -> list[str]:
+        """Deterministic LRU eviction until ``balance + need`` fits the
+        budget (single-controller convenience for
+        :func:`evict_count_for` + :func:`evict_n`)."""
+        return self.evict_n(self.evict_count_for(need, budget),
+                            stall=stall)
+
+
+_LEDGER = Ledger()
+
+
+def ledger() -> Ledger:
+    return _LEDGER
+
+
+# ---------------------------------------------------------------------------
+# module-level conveniences (the public surface operators use)
+# ---------------------------------------------------------------------------
+
+def register(base: str, arrays, spillable: bool = False, sharding=None,
+             anchor=None) -> Registration:
+    return _LEDGER.register(base, arrays, spillable=spillable,
+                            sharding=sharding, anchor=anchor)
+
+
+def register_table(base: str, table, anchor=None) -> Registration | None:
+    """Account a materialized Table's columns (data + validity) under one
+    owner; ``anchor`` defaults to the table itself so GC drains the
+    ledger (tests assert balance returns to zero after release).
+    Unmaterialized DeferredTables are skipped — forcing their thunk here
+    would defeat the fused pushdown they exist for."""
+    from ..core.table import DeferredTable
+    if isinstance(table, DeferredTable) and not table.materialized:
+        return None
+    arrays = []
+    for c in table.columns.values():
+        arrays.append(c.data)
+        if c.validity is not None:
+            arrays.append(c.validity)
+    return _LEDGER.register(base, arrays,
+                            anchor=table if anchor is None else anchor)
+
+
+def release(reg) -> None:
+    _LEDGER.release(reg)
+
+
+def touch(reg) -> None:
+    _LEDGER.touch(reg)
+
+
+def device_arrays(reg: Registration) -> tuple | None:
+    """The registration's device arrays, or None while spilled."""
+    return reg.arrays if not reg.spilled else None
+
+
+def evict(reg) -> int:
+    return _LEDGER.evict(reg)
+
+
+def readmit(reg) -> tuple:
+    return _LEDGER.readmit(reg)
+
+
+def balance() -> int:
+    return _LEDGER.balance()
+
+
+def over_budget(need: int) -> bool:
+    """Would admitting ``need`` more resident bytes exceed the budget?
+    Rank-uniform: balance, need and budget are identical across ranks."""
+    b = budget_bytes()
+    return b > 0 and _LEDGER.balance() + int(need) > b
+
+
+def try_free(need: int) -> int:
+    """Best-effort eviction of ``need`` bytes of headroom at a guard
+    call site.  SINGLE-CONTROLLER only: a multiprocess session returns 0
+    and defers all eviction to the consensus'd admission path
+    (:func:`ensure_headroom`) — the local balance read that would gate a
+    rank-local eviction here is only uniform up to GC timing, and the
+    eviction's host pulls are themselves collectives, so a rank evicting
+    alone would hang its peers.  Returns bytes freed."""
+    if not _spill_enabled():
+        return 0
+    import jax
+    if jax.process_count() > 1:
+        return 0
+    before = _LEDGER.balance()
+    _LEDGER.evict_until(int(need), budget_bytes())
+    return before - _LEDGER.balance()
+
+
+def spillable_bytes() -> int:
+    return _LEDGER.spillable_bytes()
+
+
+def ensure_headroom(env, need: int, scratch: int = 0,
+                    site: str = "spill.evict") -> None:
+    """Admission control for a new resident allocation of ``need`` bytes
+    (plus ``scratch`` transient working-set bytes — e.g. the piece
+    join's sort-operand footprint, :func:`cylon_tpu.ops.pack.
+    sort_operand_nbytes`): when the ledger would exceed the budget, cold
+    spillable owners evict (LRU) first.
+
+    Coherence protocol (docs/robustness.md "why eviction is
+    collective"): what multiprocess ranks agree on is the eviction
+    COUNT — the max over each rank's deterministic
+    :meth:`Ledger.evict_count_for` — through the one-int32 consensus
+    wire, and every rank then evicts that many oldest candidates.  The
+    poll's gating inputs are rank-uniform BY CONSTRUCTION (the armed
+    flag and the configured budget; never a raw balance read, whose
+    release timing is only uniform up to GC), so in a multiprocess
+    session the poll runs whenever a budget is configured at all —
+    admissions are rare (per packed source), and a 1-int pmax is noise
+    next to the pack it guards.  Single-controller sessions (and any
+    session with no budget and no armed injector) skip consensus
+    entirely: no collective, no host sync."""
+    from . import recovery
+    kind, armed = recovery.probe(site)
+    if kind in _RAISE_KINDS:
+        raise recovery.make_fault(kind, site)
+    if not _spill_enabled():
+        return
+    need = int(need) + int(scratch)
+    b = budget_bytes()
+    import jax
+    multi = jax.process_count() > 1
+    # rank-uniform poll gate: armed / budget-configured only
+    if not (armed or b > 0):
+        return
+    want = _LEDGER.evict_count_for(need, b)
+    if kind is not None and want == 0:
+        want = 1  # injected pressure with no real deficit: probe one LRU
+    if multi:
+        mesh = getattr(env, "mesh", env)
+        want = recovery.count_consensus(mesh, want)
+    if want <= 0:
+        return
+    stall = kind in ("stall", "spill_stall")
+    evicted = _LEDGER.evict_n(want, stall=stall)
+    if evicted:
+        from ..utils.logging import log
+        log.warning("memory: evicted %s to host under pressure "
+                    "(balance %d B, budget %d B)", evicted,
+                    _LEDGER.balance(), b)
+
+
+def spill_for_retry() -> int:
+    """The retry ladder's spill rung (docs/robustness.md): evict EVERY
+    spillable resident registration to host, freeing the maximum bytes
+    without discarding completed work, and report the total freed.  The
+    caller (``run_with_recovery``) takes the rung only after BOTH the
+    fault type and the spill decision itself have been agreed across
+    ranks (``spill_consensus``), so every rank spills the same owners in
+    the same order — up to entries a straggling GC already released on
+    one rank, which is harmless: the spill transport is collective-free
+    (per-shard pulls), so a missing candidate shortens that rank's loop
+    without desyncing any collective."""
+    if not _spill_enabled():
+        return 0
+    freed = 0
+    with _LEDGER._lock:
+        cands = sorted((r for r in _LEDGER._live.values()
+                        if r.spillable and not r.spilled),
+                       key=lambda r: r.seq)
+    for reg in cands:
+        freed += _LEDGER.evict(reg)
+    return freed
+
+
+def prefetch_depth(window_pair_bytes: int) -> int:
+    """Double-buffer depth for the pipelined join's spilled-window
+    uploads: 2 (upload piece r+1 while piece r computes) when the
+    budget has headroom for a second window pair, else 1.  Deterministic
+    from rank-uniform inputs."""
+    b = budget_bytes()
+    if b <= 0 or _LEDGER.balance() + 2 * int(window_pair_bytes) <= b:
+        return 2
+    return 1
+
+
+def spec_row_bytes(spec) -> int:
+    """Resident bytes per row of a packed source: 4 per u32 lane plus 8
+    per laneless f64 side column (ops/lanes layout)."""
+    n_f64 = sum(1 for c in spec.cols if not c.lanes)
+    return 4 * int(spec.n_lanes) + 8 * n_f64
+
+
+# ---------------------------------------------------------------------------
+# host <-> device movement (the TS106-sanctioned residency boundary)
+# ---------------------------------------------------------------------------
+
+def _stall_timeout(stall: bool) -> float | None:
+    """Watchdog deadline for a spill transfer: the configured exchange
+    watchdog, or a short synthetic one when a stall is injected with the
+    watchdog off (so the injected hang still surfaces typed)."""
+    if stall:
+        return config.EXCHANGE_WATCHDOG_S or 0.2
+    return None  # exchange_watchdog falls back to the config value
+
+
+def _put_blocks(blocks: list, sharding):
+    """Per-shard host blocks -> one row-sharded device array, the
+    TS106-sanctioned upload boundary of the spill tier.  Collective-free
+    in multiprocess sessions: ``make_array_from_callback`` asks each
+    process only for its ADDRESSABLE shards, which are exactly the
+    blocks this process holds (remote entries are None and never
+    touched).  Unsharded (test) registrations device_put directly."""
+    import jax
+    have = [b for b in blocks if b is not None]
+    n = have[0].shape[0]
+    if sharding is None:
+        return jax.device_put(np.concatenate(have))
+    if jax.process_count() > 1:
+        shape = (len(blocks) * n,) + have[0].shape[1:]
+
+        def cb(idx):
+            start = idx[0].start or 0
+            i = start // n
+            stop = shape[0] if idx[0].stop is None else idx[0].stop
+            return blocks[i][start - i * n: stop - i * n]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+    return jax.device_put(np.concatenate(blocks), sharding)
+
+
+def _upload(hosts, sharding, stall: bool = False):
+    """Per-array host shard-block lists -> device (:func:`_put_blocks`).
+    The dispatch stays ASYNC — blocking every upload would serialize
+    exactly the double-buffered overlap the pipelined loop exists for —
+    except under an injected ``spill_stall``, where the readiness check
+    runs inside the exchange watchdog so the simulated hang surfaces as
+    a typed RankDesyncError at site ``spill.upload``.  (A real upload
+    hang surfaces at the consumer's next watchdogged host sync;
+    :func:`Ledger.readmit` — the whole-matrix, non-overlapped path —
+    additionally blocks under the watchdog when
+    ``CYLON_TPU_WATCHDOG_S`` is armed.)"""
+    from . import recovery
+    kind = recovery.injected("spill.upload")
+    if kind in _RAISE_KINDS:
+        raise recovery.make_fault(kind, "spill.upload")
+    stall = stall or kind in ("stall", "spill_stall")
+    devs = tuple(_put_blocks(blocks, sharding) for blocks in hosts)
+    if stall:
+        import jax
+        recovery.exchange_watchdog(
+            "spill.upload", lambda: jax.block_until_ready(list(devs)),
+            timeout_s=_stall_timeout(True), stalled=True)
+    return devs
+
+
+def upload_window(reg: Registration, starts, window: int):
+    """Upload ONE per-shard window ``[starts[i], starts[i]+window)`` of a
+    spilled registration's host arrays back to the device (row-sharded)
+    — the host-resident PieceSource's piece materialization.  Window
+    content is byte-identical to the resident path's dynamic slice, so
+    packed joins over uploaded windows are bit-equal to unspilled runs.
+    Uploads are async dispatches: the pipelined range loop prefetches
+    piece r+1's windows so this overlaps piece r's compute."""
+    if not reg.spilled:
+        raise ValueError(f"{reg.owner} is device-resident; slice in-program")
+    _LEDGER.touch(reg)
+    starts = np.asarray(starts, np.int64)
+    window = int(window)
+    outs = []
+    with timing.region("spill.upload"):
+        for blocks in reg.host:
+            wins: list = [None] * len(blocks)
+            for i, blk in enumerate(blocks):
+                if blk is None:     # remote shard: another process's block
+                    continue
+                s = int(starts[i])
+                win = np.zeros((window,) + blk.shape[1:], blk.dtype)
+                m = min(window, blk.shape[0] - s)
+                if m > 0:
+                    win[:m] = blk[s:s + m]
+                wins[i] = win
+            outs.append(wins)
+        devs = _upload(outs, reg.sharding)
+    moved = _nbytes(devs)
+    _STATS["readmit_events"] += 1
+    _STATS["bytes_readmitted"] += moved
+    timing.add_bytes("spill.upload", moved)
+    return devs
+
+
+# ---------------------------------------------------------------------------
+# stats + eviction log (bench detail; cross-rank coherence assertions)
+# ---------------------------------------------------------------------------
+
+_STATS = {"spill_events": 0, "bytes_spilled": 0,
+          "readmit_events": 0, "bytes_readmitted": 0}
+
+#: owners in eviction order since the last reset — the multihost driver
+#: asserts this sequence is IDENTICAL across ranks
+_EVICTION_LOG: list[str] = []
+
+
+def _note_spill(site: str, reg: Registration) -> None:
+    _STATS["spill_events"] += 1
+    _STATS["bytes_spilled"] += reg.nbytes
+    _EVICTION_LOG.append(reg.owner)
+    timing.add_bytes(site, reg.nbytes)
+    timing.bump(f"memory.{site}")
+    from ..utils.logging import log
+    log.info("memory: %s -> host (%d B)", reg.owner, reg.nbytes)
+
+
+def stats() -> dict:
+    """Spill counters for bench JSON detail (alongside recovery_events):
+    ``spill_events``/``bytes_spilled`` (device→host evictions),
+    ``readmit_events``/``bytes_readmitted`` (host→device re-entries) and
+    ``peak_ledger_bytes`` (high-water resident balance)."""
+    return dict(_STATS, peak_ledger_bytes=_LEDGER.peak,
+                ledger_bytes=_LEDGER.balance())
+
+
+def eviction_log() -> list[str]:
+    return list(_EVICTION_LOG)
+
+
+def reset_stats() -> None:
+    """Zero the counters, the eviction log and the peak high-water mark
+    (live registrations are untouched — their handles stay valid)."""
+    for k in _STATS:
+        _STATS[k] = 0
+    _EVICTION_LOG.clear()
+    _LEDGER.peak = _LEDGER.balance()

@@ -202,8 +202,18 @@ class GroupBySink:
         self._chunk_aggs = sorted({(c, i) for c, op, *_ in self.aggs
                                    for i in self._DECOMP[op]})
         self._parts: list[Table] = []
+        self._regs: list = []  # HBM-ledger registrations of the partials
         self._pending = []   # in-flight fused dispatches (see __call__)
         self._disjoint = False
+
+    def _adopt(self, part: Table) -> None:
+        """Keep one chunk's partial aggregate, accounted in the HBM
+        ledger (exec/memory): sink state is resident across the whole
+        piece loop, so budget decisions must see it.  Released (and the
+        balance drained) at finalize."""
+        from . import memory
+        self._parts.append(part)
+        self._regs.append(memory.register_table("sink_part", part))
 
     def mark_key_disjoint(self) -> None:
         """Caller guarantee: no group key occurs in more than one consumed
@@ -239,7 +249,7 @@ class GroupBySink:
             # re-run the identical (uncached) compile ladder — force the
             # materialize path first, exactly like _settle
             chunk.columns  # noqa: B018 — triggers DeferredTable thunk
-            self._parts.append(
+            self._adopt(
                 groupby_aggregate(chunk, self.by, list(self._chunk_aggs)))
         return None
 
@@ -252,7 +262,7 @@ class GroupBySink:
             # the identical (crash-exhausted, uncached) pushdown ladder
             chunk.columns  # noqa: B018 — triggers DeferredTable thunk
             out = groupby_aggregate(chunk, self.by, list(self._chunk_aggs))
-        self._parts.append(out)
+        self._adopt(out)
 
     def finalize(self) -> Table:
         from ..relational.groupby import groupby_aggregate
@@ -263,6 +273,10 @@ class GroupBySink:
         partial = concat_tables(self._parts) if len(self._parts) > 1 \
             else self._parts[0]
         self._parts = []
+        from . import memory
+        for reg in self._regs:
+            memory.release(reg)
+        self._regs = []
         if self._disjoint:
             # key-disjoint chunks: the partials are already the final
             # groups; intermediate column names carry no combine suffix
@@ -528,9 +542,19 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     caps_r = [config.pow2ceil(max(int(r_lens[:, r].max()), 1))
               for r in range(n_ranges)]
 
+    # piece-cap-sizing consult of the HBM ledger (exec/memory): admission
+    # of the packed sources accounts for the transient sort-operand set
+    # the largest piece pair will materialize on top of the resident
+    # matrices; under budget pressure, COLD spillable owners evict first
+    # (collectively — docs/robustness.md) before the pack allocates
+    from ..ops.pack import sort_operand_nbytes
+    scratch = sort_operand_nbytes(
+        tuple(str(c.data.dtype) for c in r_keys), need_nf, narrow,
+        (max(caps_l) + max(caps_r)) * w)
     with timing.region("pipe.pack"):
-        src_l = PieceSource(lsorted, max(caps_l), drop=(tmp,))
-        src_r = PieceSource(rsorted, max(caps_r))
+        src_l = PieceSource(lsorted, max(caps_l), drop=(tmp,),
+                            scratch_bytes=scratch)
+        src_r = PieceSource(rsorted, max(caps_r), scratch_bytes=scratch)
         timing.maybe_block(src_r.arrs)
     del lsorted, rsorted
 
@@ -578,9 +602,30 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             prewarm_packed_join(pl0, pr0, left_on, right_on, how, suffixes,
                                 allow_defer=(sink is not None))
 
+    def _prefetch_ok(r) -> bool:
+        """Double-buffer the NEXT piece's host→device uploads against
+        this piece's compute when a source is host-resident (spilled):
+        upload of piece r+1's window overlaps compute of piece r.  The
+        prefetch depth consults the ledger — a budget too tight for two
+        window pairs falls back to single-buffering (exec/memory).
+        Resident sources skip the prefetch: descriptors are free, and
+        creating them early would only reorder CapacityOverflow checks."""
+        if not (packed and (src_l.spilled or src_r.spilled)):
+            return False
+        from . import memory
+        pair = w * (caps_l[r] * memory.spec_row_bytes(src_l.spec)
+                    + caps_r[r] * memory.spec_row_bytes(src_r.spec))
+        return memory.prefetch_depth(pair) > 1
+
     outs = []
-    for r in live_ranges:
-        piece_l, piece_r = make_pieces(r)
+    nxt = make_pieces(live_ranges[0]) if live_ranges else None
+    for i, r in enumerate(live_ranges):
+        piece_l, piece_r = nxt
+        nxt = None
+        if i + 1 < len(live_ranges) and _prefetch_ok(live_ranges[i + 1]):
+            # async upload dispatch for piece r+1 (spilled sources) —
+            # overlaps the join compute of piece r below
+            nxt = make_pieces(live_ranges[i + 1])
         with timing.region("pipe.piece_join"):
             # packed pieces: slice + key unpack are fused into this
             # dispatch; with a sink the counts stay on device, so piece
@@ -593,6 +638,8 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
         with timing.region("pipe.consume"):
             out_r = sink(res_r) if sink is not None else res_r
         outs.append(out_r)
+        if nxt is None and i + 1 < len(live_ranges):
+            nxt = make_pieces(live_ranges[i + 1])
     if not outs:
         # no range qualified (e.g. inner join, no overlapping keys at all):
         # one empty piece pair keeps the output schema path uniform

@@ -23,19 +23,26 @@ decisions are made, built on four pillars (docs/robustness.md):
    one-element ``pmax`` shard_map program — after every guarded attempt,
    so every rank takes the IDENTICAL branch: same fallback chunk count,
    same cap-halving step, or same typed abort.  Escalation is bounded and
-   deterministic (OOM: chunks 4 → 16; capacity overflow: one cap-halving
-   step at 8 chunks), nested ladders never re-escalate (the outer ladder
-   owns the rungs), and every recovery event is logged and counted in
+   deterministic (predicted OOM: spill-then-retry at the SAME chunk
+   count first — the host spill tier, :mod:`cylon_tpu.exec.memory`,
+   frees resident bytes without discarding completed work — then chunks
+   4 → 16; capacity overflow: one cap-halving step at 8 chunks), nested
+   ladders never re-escalate (the outer ladder owns the rungs), and
+   every recovery event is logged and counted in
    :mod:`cylon_tpu.utils.timing` phase stats.
 
 3. **Fault injection** (``CYLON_TPU_FAULTS="site[:rank][:nth]=kind"``):
    each typed fault is constructible at its named site on the CPU rig, so
    the whole ladder is testable without a real device OOM.  Sites:
    ``shuffle.recv_guard``, ``join.piece_cap``, ``groupby.device_oom``,
-   ``exchange.stall``.  Kinds: ``predicted``, ``device_oom``,
-   ``capacity``, ``desync``, ``stall`` (stall only fires inside the
-   watchdog).  ``rank`` defaults to every rank (``*``); ``nth`` is the
-   1-based occurrence to fire on (default 1; ``*`` = every occurrence).
+   ``exchange.stall``, ``spill.evict``, ``spill.upload``.  Kinds:
+   ``predicted``, ``device_oom``, ``capacity``, ``desync``, ``stall``
+   (fires inside the watchdog) and ``spill_stall`` (hangs a spill-tier
+   host↔device transfer; at ``spill.evict`` the ``predicted`` kind
+   simulates rank-local memory PRESSURE — consensus'd, then evicted —
+   rather than raising).  ``rank`` defaults to every rank (``*``);
+   ``nth`` is the 1-based occurrence to fire on (default 1; ``*`` =
+   every occurrence).
 
 4. **Exchange watchdog** (:func:`exchange_watchdog`): an optional timeout
    (``CYLON_TPU_WATCHDOG_S``) around multihost exchange host-syncs that
@@ -68,12 +75,20 @@ from ..utils.cache import program_cache
 
 shard_map = jax.shard_map
 
-#: injection site names (docs/robustness.md spec grammar)
+#: injection site names (docs/robustness.md spec grammar).  The spill
+#: sites (exec/memory): ``spill.evict`` is probed by the ledger's
+#: admission path — kind ``predicted`` there simulates rank-local
+#: memory PRESSURE (consensus'd, then evicted) rather than raising —
+#: and ``spill.upload`` guards the host→device re-entry of spilled
+#: windows.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
-         "exchange.stall")
+         "exchange.stall", "spill.evict", "spill.upload")
 
-#: fault kinds accepted by the injection grammar
-KINDS = ("predicted", "device_oom", "capacity", "desync", "stall")
+#: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
+#: a spill-tier host↔device transfer inside the watchdog (the spill
+#: analog of ``stall``)
+KINDS = ("predicted", "device_oom", "capacity", "desync", "stall",
+         "spill_stall")
 
 
 # ---------------------------------------------------------------------------
@@ -360,26 +375,55 @@ def guard_consensus(mesh: Mesh | None, local_fault: bool) -> bool:
     return consensus_code(mesh, local) != Code.OK
 
 
+def spill_consensus(mesh: Mesh | None, local_need: bool) -> bool:
+    """Evict/re-admit agreement for the spill tier (exec/memory): True
+    when ANY rank is under memory pressure — then every rank runs the
+    identical deterministic LRU eviction, because a rank-local eviction
+    would desync the next collective exactly like a rank-local retry
+    (docs/robustness.md).  Rides the same one-int32 pmax wire as the
+    fault codes, with the dedicated :class:`Code.SpillRequired` vote.
+    Callers poll only when the pressure predicate or an armed injector
+    can be non-OK somewhere — the under-budget happy path stays
+    collective-free."""
+    local = Code.SpillRequired if local_need else Code.OK
+    return consensus_code(mesh, local) == Code.SpillRequired
+
+
+def count_consensus(mesh: Mesh | None, n: int) -> int:
+    """Max-agree a small non-negative count across ranks — the spill
+    tier's eviction-COUNT wire (exec/memory.ensure_headroom): every rank
+    then evicts that many oldest candidates, so the eviction sequence is
+    identical even when a straggling GC leaves one rank's balance
+    momentarily higher.  Same transport as the ladder's code wire."""
+    return int(_consensus_wire(mesh, max(int(n), 0)))
+
+
 # ---------------------------------------------------------------------------
 # exchange watchdog
 # ---------------------------------------------------------------------------
 
-def exchange_watchdog(site: str, thunk, timeout_s: float | None = None):
+def exchange_watchdog(site: str, thunk, timeout_s: float | None = None,
+                      stalled: bool | None = None):
     """Run a blocking exchange host-sync under an optional deadline.
 
     With ``CYLON_TPU_WATCHDOG_S`` unset/0 this is a plain call.  With a
     deadline, the sync runs in a worker thread; if it does not complete in
     time the hang is converted into a typed :class:`RankDesyncError`
     carrying the site and the last-known timing phase.  The injector kind
-    ``stall`` (site ``exchange.stall``) simulates the peer hang."""
+    ``stall`` (site ``exchange.stall``) simulates the peer hang;
+    ``stalled=True`` forces the simulated hang directly (the spill tier
+    routes its site-local ``spill_stall`` injections through this — a
+    hung host↔device transfer then surfaces typed at ``spill.evict`` /
+    ``spill.upload`` instead of silently blocking)."""
     t = config.EXCHANGE_WATCHDOG_S if timeout_s is None else float(timeout_s)
     if t <= 0:
         return thunk()
-    stalled = injected("exchange.stall")
+    if stalled is None:
+        stalled = injected("exchange.stall")
     box: dict = {}
 
     def run():
-        if stalled is not None:
+        if stalled:
             # simulated peer hang: the data never arrives
             import time
             time.sleep(4 * t)
@@ -475,6 +519,43 @@ def run_with_recovery(primary, can_fallback: bool, fallback, label: str,
     if agreed == Code.OK:
         return result
     kind = getattr(fault, "kind", "fault")
+
+    # ---- spill rung: free resident bytes, retry the SAME configuration --
+    # A predicted fault fired BEFORE any allocation (HBM clean), so if the
+    # host spill tier can free resident bytes, the cheapest recovery is to
+    # evict and re-run at the same chunk count — no completed device work
+    # is discarded (exec/memory, docs/robustness.md).  Rank-coherent by
+    # construction: the fault TYPE is post-consensus (the wire encoding
+    # separates predicted from device OOM), and spill_for_retry's eviction
+    # set/order is a pure function of the rank-uniform ledger.  Chunk
+    # escalation below remains the backstop when spilling is insufficient
+    # (or there is nothing to spill).
+    if not nested and isinstance(fault, PredictedResourceExhausted):
+        from . import memory
+        # the TAKE-THE-RUNG decision is agreed, not balance-gated: a
+        # straggling GC could leave spillable bytes visible on one rank
+        # only, and a rank retrying while its peers escalate is the
+        # desync this module exists to prevent.  (The gate itself runs
+        # on every rank: fault type and nesting depth are uniform.)
+        local_can = config.SPILL_ENABLED and memory.spillable_bytes() > 0
+        do_spill = spill_consensus(mesh, local_can) if multi else local_can
+        if do_spill:
+            memory.spill_for_retry()
+            from ..utils.logging import log as _log
+            _record(label, kind, "spill_retry")
+            _log.warning("%s %s fault; spill rung: resident state evicted "
+                         "to host, retrying at the same configuration",
+                         label, kind)
+            _tls.depth = getattr(_tls, "depth", 0) + 1
+            try:
+                result, fault = _attempt(primary)
+            finally:
+                _tls.depth -= 1
+            agreed, fault = agree(fault)
+            if agreed == Code.OK:
+                return result
+            kind = getattr(fault, "kind", kind)
+
     rungs = RETRY_RUNGS.get(agreed, ())
     if not rungs or not can_fallback or nested:
         _record(label, kind, "abort")

@@ -45,9 +45,36 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 NULL_FIRST = 0
 NULL_LAST = 2
+
+
+def sort_operand_nbytes(dtypes, need_nf, narrow, rows: int,
+                        row_mask: bool = True) -> int:
+    """Host-side static size of the operand set :func:`key_operands`
+    materializes for ``rows`` rows — the per-piece sort scratch a join
+    over this key structure will hold resident while it runs.  Mirrors
+    the packing rules above (liveness flag + per-column null flag + one
+    or two native value lanes; f64 stays a single 8-byte operand).
+
+    This is the "registration at pack time" half of the HBM ledger
+    (:mod:`cylon_tpu.exec.memory`): piece working-set sizing consults it
+    so admission of a new packed source accounts for the transient
+    operands its consumer will add on top of the resident matrices."""
+    per_row = 4 if row_mask else 0
+    for dt, nf, nw in zip(dtypes, need_nf, narrow):
+        if nf:
+            per_row += 4
+        d = np.dtype(dt)
+        if d.kind == "f" and d.itemsize == 8:
+            per_row += 8          # f64 keys stay one emulated-compare operand
+        elif d.itemsize == 8 and d.kind in ("i", "u") and not nw:
+            per_row += 8          # (hi, lo) native lane pair
+        else:
+            per_row += 4          # one native 32-bit operand
+    return per_row * int(rows)
 
 
 class KeyOps(NamedTuple):

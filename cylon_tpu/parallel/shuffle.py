@@ -313,10 +313,25 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
         # rank-uniform (replicated counts) and `armed` is rank-uniform by
         # construction (recovery.probe) — so the un-injected happy path
         # adds no collective and no host sync to the exchange.
-        from ..exec import recovery
+        from ..exec import memory, recovery
+        need = out_cap * row_bytes
+        # HBM-ledger consult (exec/memory): the predicted receive is an
+        # allocation ON TOP of the resident balance the ledger tracks —
+        # and unlike the static receive budget, ledger pressure is
+        # CURABLE: cold spillable owners (packed piece sources — sink
+        # partials and receive buffers are accounting-only) evict to
+        # host BEFORE the allocation.  Single-controller only
+        # (try_free no-ops in multiprocess sessions, where eviction is
+        # taken exclusively on the consensus'd admission path), and the
+        # raise/consensus predicate below stays EXACTLY the replicated
+        # count-sidecar one: a ledger balance read is rank-uniform only
+        # up to GC release timing, so gating the consensus poll on it
+        # would risk the very desync this guard exists to prevent.
+        if memory.over_budget(need):
+            memory.try_free(need)
         over_budget = bool(
             on_accel
-            and out_cap * row_bytes > config.EXCHANGE_RECV_BUDGET_BYTES)
+            and need > config.EXCHANGE_RECV_BUDGET_BYTES)
         kind, armed = recovery.probe("shuffle.recv_guard")
         local_fault = over_budget or kind is not None
         if ((over_budget or armed)
@@ -343,6 +358,16 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     # all rounds run in ONE compiled program (fori_loop when rounds > 1)
     fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
     outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
+    if guard:
+        # HBM-ledger accounting of the receive allocation (exec/memory):
+        # one registration PER buffer, each anchored to its own array, so
+        # the balance tracks exactly the buffers still alive (the lane
+        # matrix usually dies at rebuild; f64 side arrays live on as the
+        # table's columns).  Non-spillable — an exchange output has no
+        # cheap re-entry path.
+        from ..exec import memory
+        for arr in outs:
+            memory.register("shuffle.recv", (arr,), anchor=arr)
     return outs, per_dest.astype(np.int64)
 
 

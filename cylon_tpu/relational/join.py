@@ -844,6 +844,12 @@ def _join_packed_impl(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
     env = pl.env
     if pr.env is not env and pr.env.mesh is not env.mesh:
         raise InvalidError("pieces belong to different CylonEnvs")
+    # LRU bump for the HBM ledger: the spill tier's eviction order is
+    # "cold first", measured by last piece-loop CONSUMPTION, not just
+    # descriptor creation (exec/memory)
+    from ..exec import memory
+    memory.touch(pl.reg)
+    memory.touch(pr.reg)
     (kil, kir, need_nf, narrow, coalesce, plan, names, types, dicts,
      bounds, carry_emit, carry_match, all_live) = _packed_statics(
         pl, pr, left_on, right_on, how, suffixes, coalesce_keys)

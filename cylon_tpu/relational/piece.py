@@ -25,6 +25,15 @@ matrix; every piece aliases it.  Pieces stay valid as long as the source's
 arrays are alive — the pipeline holds the source for the whole range loop
 and pieces never outlive it.  ``to_table()`` is the materialized escape
 hatch (and the reference semantics the packed path is tested against).
+
+Memory-pressure contract (exec/memory, docs/robustness.md): the packed
+arrays register with the HBM ledger at pack time (spillable, LRU-touched
+on every piece access).  A source whose registration has been EVICTED is
+host-resident: ``packed()`` then uploads just the requested window back
+to the device (``memory.upload_window`` — byte-identical to the resident
+path's in-program dynamic slice, so results stay bit-equal) and the
+pipelined range loop double-buffers those uploads against piece compute.
+All residency changes go through the ledger (lint rule TS106).
 """
 
 from __future__ import annotations
@@ -109,17 +118,20 @@ class PackedPiece:
     device arrays (no slice is dispatched until a consumer runs).
 
     ``meta`` entries are ``(name, LogicalType, dictionary, bounds)``
-    parallel to ``spec.cols``."""
+    parallel to ``spec.cols``.  ``reg`` (optional) is the source's HBM
+    ledger registration — consumers LRU-touch it on access so eviction
+    order tracks the piece loop (exec/memory)."""
 
     __slots__ = ("env", "spec", "meta", "arrs", "starts", "lens",
-                 "piece_cap")
+                 "piece_cap", "reg")
 
     def __init__(self, env, spec, meta, arrs, starts: np.ndarray,
-                 lens: np.ndarray, piece_cap: int):
+                 lens: np.ndarray, piece_cap: int, reg=None):
         self.env = env
         self.spec = spec
         self.meta = meta
         self.arrs = arrs
+        self.reg = reg
         self.starts = np.asarray(starts, np.int32)
         self.lens = np.asarray(lens, np.int64)
         self.piece_cap = int(piece_cap)
@@ -168,9 +180,17 @@ class PieceSource:
     host-side :class:`PackedPiece` window descriptor — producing a piece
     costs NO device work; the window slice runs inside whatever jitted
     program consumes it.  The caller should drop its reference to the
-    source table: the matrix (plus f64 side arrays) carries everything."""
+    source table: the matrix (plus f64 side arrays) carries everything.
 
-    def __init__(self, table: Table, pad: int, drop: tuple = ()):
+    The packed arrays live in an HBM-ledger registration (spillable; see
+    module docstring): ``scratch_bytes`` lets the caller fold the
+    consumer's transient working set (sort operands,
+    :func:`cylon_tpu.ops.pack.sort_operand_nbytes`) into the admission
+    decision — the piece-cap-sizing consult of the ledger."""
+
+    def __init__(self, table: Table, pad: int, drop: tuple = (),
+                 scratch_bytes: int = 0):
+        from ..exec import memory
         from .common import table_lane_spec
         self.env = table.env
         items = [(n, c) for n, c in table.columns.items() if n not in drop]
@@ -182,6 +202,11 @@ class PieceSource:
              if c.bounds is not None else None)
             for n, c in items)
         mesh = self.env.mesh
+        w = self.env.world_size
+        rows = w * (table.capacity + int(pad))
+        memory.ensure_headroom(
+            self.env, rows * memory.spec_row_bytes(self.spec),
+            scratch=int(scratch_bytes))
         arrs = []
         if self.spec.n_lanes:
             arrs.append(_piece_pack_fn(mesh, self.spec, pad)(
@@ -190,14 +215,40 @@ class PieceSource:
         for c, cl in zip(cols, self.spec.cols):
             if not cl.lanes:
                 arrs.append(_pad_rows_fn(mesh, pad)(c.data))
-        self.arrs = tuple(arrs)
+        self._reg = memory.register("piece_src", tuple(arrs),
+                                    spillable=True,
+                                    sharding=self.env.sharding(),
+                                    anchor=self)
+
+    @property
+    def arrs(self) -> tuple | None:
+        """Device arrays while resident, None while spilled to host."""
+        from ..exec import memory
+        return memory.device_arrays(self._reg)
+
+    @property
+    def spilled(self) -> bool:
+        return self._reg.spilled
 
     def packed(self, starts: np.ndarray, lens: np.ndarray,
                piece_cap: int | None = None) -> PackedPiece:
+        from ..exec import memory
         if piece_cap is None:
             piece_cap = config.pow2ceil(max(int(lens.max(initial=0)), 1))
-        return PackedPiece(self.env, self.spec, self.meta, self.arrs,
-                           starts, lens, piece_cap)
+        memory.touch(self._reg)
+        if not self._reg.spilled:
+            return PackedPiece(self.env, self.spec, self.meta, self.arrs,
+                               starts, lens, piece_cap, reg=self._reg)
+        # host-resident source: upload ONLY this window (async dispatch —
+        # the pipelined loop prefetches piece r+1 so this overlaps piece
+        # r's compute); the uploaded arrays ARE the window, so the
+        # in-program slice starts at 0
+        w = self.env.world_size
+        arrs = memory.upload_window(self._reg, np.asarray(starts, np.int64),
+                                    int(piece_cap))
+        return PackedPiece(self.env, self.spec, self.meta, arrs,
+                           np.zeros(w, np.int32), lens, piece_cap,
+                           reg=self._reg)
 
     def piece(self, starts: np.ndarray, lens: np.ndarray) -> Table:
         """Materialized window (seed behavior): slice + full unpack."""

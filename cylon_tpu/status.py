@@ -27,6 +27,11 @@ class Code(enum.IntEnum):
     NotImplemented = 10
     SerializationError = 11
     RError = 12
+    #: spill-tier consensus vote (exec/memory): a rank under memory
+    #: pressure requests a COLLECTIVE eviction; rides the same pmax wire
+    #: as the fault codes (docs/robustness.md, "why eviction is
+    #: collective").  Not an error class — never raised.
+    SpillRequired = 46
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42

@@ -831,11 +831,12 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
     deploy story for SF10+ is a pod slice, deploy/README.md)."""
     import jax
 
-    from cylon_tpu.exec import recovery
+    from cylon_tpu.exec import memory, recovery
     from cylon_tpu.status import Code, PredictedResourceExhausted
     # the detail block reports THIS bench invocation's recoveries only
     # (including failed-attempt events from the halving loop below)
     recovery.reset_events()
+    spilled_scales: set = set()
     while True:
         try:
             return _bench_tpch_once(scale, iters)
@@ -847,6 +848,20 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
                     or scale <= 0.02:
                 raise
             predicted = isinstance(fault, PredictedResourceExhausted)
+            if predicted and scale not in spilled_scales \
+                    and memory.spill_for_retry() > 0:
+                # prefer the SPILL rung over in-process scale-halving:
+                # a predicted guard fired pre-allocation (HBM clean), so
+                # evicting resident state to host and retrying at the
+                # SAME scale keeps the benchmark's configuration intact
+                # (docs/robustness.md rung ordering); one spill attempt
+                # per scale — a re-fault then falls through to halving
+                spilled_scales.add(scale)
+                print(f"# TPC-H predicted OOM; spilled resident state, "
+                      f"retrying at SF{scale:g}", flush=True)
+                import gc
+                gc.collect()
+                continue
             if jax.devices()[0].platform != "cpu" and not predicted:
                 # measured (round 5): a REAL device OOM on the axon TPU
                 # rig POISONS the process — the leaked HBM never returns
@@ -915,6 +930,10 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                    # was this number achieved on the happy path or after
                    # in-run degradation (docs/robustness.md)?
                    "recovery_events": _recovery_events(),
+                   # resident vs host-spilled state (exec/memory)
+                   **{k: v for k, v in _spill_stats().items() if k in
+                      ("spill_events", "bytes_spilled",
+                       "peak_ledger_bytes")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 
@@ -922,3 +941,8 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
 def _recovery_events() -> list:
     from cylon_tpu.exec import recovery
     return recovery.drain_events()
+
+
+def _spill_stats() -> dict:
+    from cylon_tpu.exec import memory
+    return memory.stats()

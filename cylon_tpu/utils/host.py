@@ -60,6 +60,26 @@ def host_arrays(xs) -> list:
             for x in xs]
 
 
+def host_shard_blocks(x, world: int) -> list:
+    """Per-shard host blocks of a row-sharded array WITHOUT any
+    cross-process collective: each process pulls only its ADDRESSABLE
+    shards (entries for remote shards stay None).  This is the spill
+    tier's eviction transport (cylon_tpu.exec.memory): collective-free
+    by construction, so a rank whose eviction candidates momentarily
+    diverge from its peers' (GC timing) cannot hang the mesh the way a
+    ``process_allgather``-based pull would.  Numpy inputs pass through
+    as a single block."""
+    if isinstance(x, np.ndarray):
+        return [x]
+    per = x.shape[0] // world
+    blocks: list = [None] * world
+    with _sanctioned_pull("host_shards"):
+        for sh in x.addressable_shards:
+            i = (sh.index[0].start or 0) // per
+            blocks[i] = np.asarray(sh.data)
+    return blocks
+
+
 _pull_fn = None
 
 

@@ -93,11 +93,34 @@ def bump(name: str) -> None:
     acc[1] += 1
 
 
+#: name -> bytes moved, the spill tier's phase attribution: seconds alone
+#: cannot say whether ``spill.upload`` is PCIe-bound or dispatch-bound —
+#: GB/phase does.  Unconditional like bump(): spill traffic must be
+#: attributable even without CYLON_TPU_BENCH.
+_BYTES: dict[str, int] = {}
+
+
+def add_bytes(name: str, nbytes: int) -> None:
+    """Attribute ``nbytes`` of host↔device traffic to a named phase
+    (exec/memory spill/evict/upload); appears as ``b`` in
+    :func:`snapshot` entries."""
+    _BYTES[name] = _BYTES.get(name, 0) + int(nbytes)
+    _ACCUM.setdefault(name, [0.0, 0])
+
+
 def reset() -> None:
     _ACCUM.clear()
+    _BYTES.clear()
 
 
 def snapshot() -> dict:
-    """{region: {"s": total_seconds, "n": calls}} sorted by cost."""
-    return {k: {"s": round(v[0], 4), "n": v[1]}
-            for k, v in sorted(_ACCUM.items(), key=lambda kv: -kv[1][0])}
+    """{region: {"s": total_seconds, "n": calls[, "b": bytes_moved]}}
+    sorted by cost; ``b`` appears only for phases that attributed
+    host↔device bytes (:func:`add_bytes`)."""
+    out = {}
+    for k, v in sorted(_ACCUM.items(), key=lambda kv: -kv[1][0]):
+        ent = {"s": round(v[0], 4), "n": v[1]}
+        if _BYTES.get(k):
+            ent["b"] = _BYTES[k]
+        out[k] = ent
+    return out

@@ -40,9 +40,10 @@ def main():
     import cylon_tpu as ct
     from cylon_tpu import tpch
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
-    from cylon_tpu.exec import recovery
+    from cylon_tpu.exec import memory, recovery
 
     recovery.reset_events()
+    memory.reset_stats()
 
     devs = jax.devices()
     on_accel = devs[0].platform != "cpu"
@@ -76,6 +77,10 @@ def main():
                    "scale": scale,
                    # happy path vs post-degradation (docs/robustness.md)
                    "recovery_events": recovery.drain_events(),
+                   # resident vs host-spilled state (exec/memory)
+                   **{k: v for k, v in memory.stats().items() if k in
+                      ("spill_events", "bytes_spilled",
+                       "peak_ledger_bytes")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

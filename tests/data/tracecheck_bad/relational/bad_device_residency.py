@@ -1,0 +1,20 @@
+"""TS106 fixture: bare device_put/device_get of lane-sized arrays inside
+a ``relational/`` module — residency changes of operator state must go
+through the exec/memory HBM ledger (register/evict/upload_window) so
+budget and spill decisions stay accounted and rank-coherent.  This file
+lives under a ``relational/`` directory on purpose: the rule is scoped
+to the operator directories (exec/memory itself is exempt)."""
+
+import jax
+import numpy as np
+
+
+def stash_matrix_on_host(mat):
+    # TS106: an unaccounted pull bypasses the spill tier's bookkeeping
+    # (and the utils.host transfer funnel)
+    return jax.device_get(mat)
+
+
+def restore_matrix(host_mat, sharding):
+    # TS106: an unaccounted upload skews every ledger budget decision
+    return jax.device_put(np.asarray(host_mat), sharding)

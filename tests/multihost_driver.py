@@ -90,6 +90,41 @@ assert evs[0] == {"site": "join", "kind": "predicted",
 recovery.install_faults("")
 print(f"RECOVERY_OK pid={pid} events={len(evs)}", flush=True)
 
+# Spill tier (docs/robustness.md "Memory ledger & spill tier"): inject
+# eviction PRESSURE on RANK 0 ONLY at the ledger's admission site.  The
+# spill consensus (Code.SpillRequired over the pmax wire) must make
+# every rank run the identical deterministic LRU eviction — same owners,
+# same order, no deadlock — and the host-resident source's per-window
+# re-uploads must keep the pipelined join bit-equal to the un-injected
+# run.  nth=2: the FIRST PieceSource (probe side) must already be
+# registered when the pressure fires, so there is something to evict.
+import zlib
+
+from jax.experimental import multihost_utils
+
+from cylon_tpu.exec import memory, pipelined_join
+
+pipe_base = (pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=4)
+             .to_pandas().sort_values(["k", "a", "b"])
+             .reset_index(drop=True))
+env.barrier()
+recovery.install_faults("spill.evict:0:2=predicted")
+recovery.reset_events()
+memory.reset_stats()
+pipe_inj = (pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=4)
+            .to_pandas().sort_values(["k", "a", "b"])
+            .reset_index(drop=True))
+pd.testing.assert_frame_equal(pipe_inj, pipe_base, check_dtype=False)
+seq = memory.eviction_log()
+assert len(seq) >= 1, seq
+assert memory.stats()["spill_events"] >= 1
+# every rank must have evicted the SAME owners in the SAME order
+sig = np.int64(zlib.crc32("|".join(seq).encode()))
+sigs = np.atleast_1d(multihost_utils.process_allgather(sig))
+assert len({int(s) for s in sigs}) == 1, (seq, sigs)
+recovery.install_faults("")
+print(f"SPILL_OK pid={pid} evictions={seq}", flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

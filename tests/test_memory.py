@@ -1,0 +1,346 @@
+"""HBM budget ledger + host spill tier (cylon_tpu.exec.memory): ledger
+invariants, bit-exact spill round-trips, the ladder's spill rung (and its
+handoff to chunk escalation), budget-driven spilling through the
+pipelined join, and the spill-site watchdog/injection surface.
+docs/robustness.md "Memory ledger & spill tier"."""
+
+import gc
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import config
+from cylon_tpu.exec import memory, recovery
+from cylon_tpu.status import RankDesyncError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Disarmed injector, zeroed stats, and no leaked registrations on
+    either side of every test (leftover spillable state would give other
+    tests' ladders a phantom spill rung)."""
+    recovery.install_faults("")
+    recovery.reset_events()
+    memory.reset_stats()
+    yield
+    recovery.install_faults("")
+    recovery.reset_events()
+    gc.collect()
+    memory.reset_stats()
+
+
+def _tables(env, rng, n=4000):
+    """Same shapes/bounds as tests/test_recovery.py's tables on purpose:
+    every join/pipeline program this file triggers shares the compiled
+    family with that suite (and across the tests here), keeping the
+    tier-1 wall-clock cost of this file low."""
+    ldf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                        "a": rng.integers(0, 50, n).astype(np.int64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                        "b": rng.integers(0, 50, n).astype(np.int64)})
+    return (ldf, rdf, ct.Table.from_pandas(ldf, env),
+            ct.Table.from_pandas(rdf, env))
+
+
+def _mixed_lane_table(env, rng, n=400):
+    """Every lane class in one table: wide int64 (2 lanes), narrow int64
+    (1 lane via bounds), int32, f32 (bitcast lane), bool, dictionary
+    string codes, a NULLABLE int64 (validity lane) and an f64 SIDE array
+    carrying a NaN (bit-exactness must survive it)."""
+    f64 = rng.random(n)
+    f64[0] = np.nan
+    df = pd.DataFrame({
+        "i64w": (rng.integers(0, 2**40, n)).astype(np.int64),
+        "i64n": rng.integers(0, 100, n).astype(np.int64),
+        "i32": rng.integers(0, 100, n).astype(np.int32),
+        "f32": rng.random(n).astype(np.float32),
+        "f64": f64,
+        "b": rng.random(n) < 0.5,
+        "s": pd.Series(rng.choice(["aa", "bb", "cc"], n)),
+        "ni": pd.array(np.where(rng.random(n) < 0.1, None,
+                                rng.integers(0, 50, n)), dtype="Int64"),
+    })
+    return ct.Table.from_pandas(df, env)
+
+
+def _host_bytes(table):
+    """{name: (data bytes, validity array|None)} of the live rows — the
+    bit-exact comparison surface."""
+    out = {}
+    for name, (data, valid) in table.host_columns().items():
+        out[name] = (np.asarray(data).tobytes(),
+                     None if valid is None else np.asarray(valid, bool))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ledger invariants
+# ---------------------------------------------------------------------------
+
+class TestLedger:
+    def test_register_release_drains(self):
+        led = memory.ledger()
+        base = led.balance()
+        reg = memory.register("t", (np.zeros(128, np.int64),))
+        assert led.balance() == base + 1024
+        memory.release(reg)
+        assert led.balance() == base
+        memory.release(reg)  # idempotent: never drives the balance negative
+        assert led.balance() == base
+
+    def test_table_release_drains_to_zero(self, env4, rng):
+        led = memory.ledger()
+        base = led.balance()
+        t = ct.Table.from_pandas(
+            pd.DataFrame({"k": rng.integers(0, 9, 256)}), env4)
+        reg = memory.register_table("tbl", t)
+        assert reg is not None and led.balance() > base
+        del t
+        gc.collect()   # the weakref.finalize anchor drains the entry
+        assert led.balance() == base
+
+    def test_budget_env_override(self, monkeypatch):
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 12345)
+        assert memory.budget_bytes() == 12345
+        assert memory.over_budget(12346)
+
+    def test_property_random_sequences(self, env4, rng):
+        """Random register/evict/readmit/touch/release sequences keep
+        ``balance == sum of live un-spilled registrations`` and the
+        balance non-negative throughout."""
+        led = memory.ledger()
+        base = led.balance()
+        live = []
+
+        def expected():
+            return base + sum(r.nbytes for r in live if not r.spilled)
+
+        for step in range(60):
+            op = rng.integers(0, 5)
+            if op == 0 or not live:
+                arr = np.zeros(int(rng.integers(8, 512)), np.float64)
+                live.append(memory.register(
+                    "prop", (arr,), spillable=bool(rng.integers(0, 2))))
+            else:
+                reg = live[int(rng.integers(0, len(live)))]
+                if op == 1:
+                    memory.evict(reg)
+                elif op == 2 and reg.spilled:
+                    memory.readmit(reg)
+                elif op == 3:
+                    memory.touch(reg)
+                else:
+                    memory.release(reg)
+                    live.remove(reg)
+            assert led.balance() == expected(), step
+            assert led.balance() >= 0
+        for reg in live:
+            memory.release(reg)
+        assert led.balance() == base
+
+    def test_lru_eviction_order_is_deterministic(self):
+        regs = [memory.register(f"lru", (np.zeros(64, np.int64),),
+                                spillable=True) for _ in range(3)]
+        memory.touch(regs[0])   # oldest untouched entry is regs[1]
+        evicted = memory.ledger().evict_until(1, budget=1)
+        assert evicted[0] == regs[1].owner
+        for r in regs:
+            memory.release(r)
+
+
+# ---------------------------------------------------------------------------
+# spill round-trips
+# ---------------------------------------------------------------------------
+
+class TestSpillRoundTrip:
+    def test_bit_exact_all_lane_dtypes(self, env4, rng):
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng)
+        w = env4.world_size
+        lens = t.valid_counts
+        src = PieceSource(t, pad=8)
+        cap = config.pow2ceil(int(lens.max()))
+        starts = np.zeros(w, np.int64)
+        ref = _host_bytes(src.packed(starts, lens, cap).to_table())
+        freed = memory.evict(src._reg)
+        assert freed > 0 and src.spilled and src.arrs is None
+        got = _host_bytes(src.packed(starts, lens, cap).to_table())
+        assert set(got) == set(ref)
+        for name in ref:
+            assert got[name][0] == ref[name][0], f"{name} data bytes differ"
+            rv, gv = ref[name][1], got[name][1]
+            assert (rv is None) == (gv is None)
+            if rv is not None:
+                assert np.array_equal(rv, gv), f"{name} validity differs"
+        st = memory.stats()
+        assert st["spill_events"] == 1 and st["bytes_spilled"] == freed
+        assert st["bytes_readmitted"] > 0
+
+    def test_whole_registration_readmit_bit_exact(self, env4, rng):
+        from cylon_tpu.relational.piece import PieceSource
+        from cylon_tpu.utils.host import host_arrays
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        before = [np.asarray(a).tobytes() for a in host_arrays(
+            list(src.arrs))]
+        memory.evict(src._reg)
+        arrs = memory.readmit(src._reg)
+        assert src.arrs is not None and not src.spilled
+        after = [np.asarray(a).tobytes() for a in host_arrays(list(arrs))]
+        assert before == after
+
+
+# ---------------------------------------------------------------------------
+# budget-driven spilling through the pipelined join (the acceptance run)
+# ---------------------------------------------------------------------------
+
+class TestBudgetSpill:
+    def test_pipelined_join_spills_and_stays_bit_equal(self, env4, rng,
+                                                       monkeypatch):
+        """CYLON_TPU_HBM_BUDGET below the resident working set: the
+        pipelined join completes via the spill tier at the SAME chunk
+        count — no recompute escalation, spill_events > 0, result
+        bit-equal (and order-equal) to the unconstrained run."""
+        from cylon_tpu.exec import pipelined_join
+        _ldf, _rdf, lt, rt = _tables(env4, rng)
+        base = pipelined_join(lt, rt, "k", "k", how="inner",
+                              n_chunks=4).to_pandas()
+        gc.collect()
+        memory.reset_stats()
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        out = pipelined_join(lt, rt, "k", "k", how="inner",
+                             n_chunks=4).to_pandas()
+        st = memory.stats()
+        assert st["spill_events"] > 0, st
+        assert memory.eviction_log(), "no eviction sequence recorded"
+        assert recovery.recovery_events() == []  # NO ladder escalation
+        pd.testing.assert_frame_equal(out, base)  # bit- and order-equal
+
+    def test_spill_disabled_escape_hatch(self, env4, rng, monkeypatch):
+        """CYLON_TPU_SPILL=0: the ledger keeps accounting but NOTHING
+        evicts — neither under real budget pressure nor under injected
+        pressure, and the ladder's spill rung reports nothing to free."""
+        from cylon_tpu.relational.piece import PieceSource
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        monkeypatch.setattr(config, "SPILL_ENABLED", False)
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        assert memory.balance() > 0          # accounting still live
+        recovery.install_faults("spill.evict:0:1=predicted")
+        memory.ensure_headroom(env4, 1 << 20)   # over budget + pressure
+        assert not src.spilled
+        assert memory.stats()["spill_events"] == 0
+        assert memory.spill_for_retry() == 0    # ladder rung disabled too
+        del src
+
+
+# ---------------------------------------------------------------------------
+# the ladder's spill rung + handoff to chunk escalation
+# ---------------------------------------------------------------------------
+
+class TestSpillRung:
+    def test_predicted_fault_takes_spill_rung_first(self, env4, rng):
+        """A predicted receive-budget fault with spillable resident
+        state: the ladder frees bytes and retries at the SAME chunk
+        count — one spill_retry event, no chunk escalation, identical
+        result."""
+        from cylon_tpu.relational import join_tables
+        from cylon_tpu.relational.piece import PieceSource
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        aux = ct.Table.from_pandas(ldf, env4)
+        src = PieceSource(aux, pad=8)
+        recovery.install_faults("shuffle.recv_guard:0:1=predicted")
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        got = j.to_pandas().sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        exp = ldf.merge(rdf, on="k").sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                      check_dtype=False)
+        assert [e["action"] for e in recovery.recovery_events()] \
+            == ["spill_retry"]
+        assert src.spilled
+        del src, aux
+
+    def test_spill_insufficient_hands_off_to_chunks(self, env4, rng):
+        """Spill-insufficient → chunk-escalation handoff: the guard
+        re-faults after the spill rung (nth=2 injection), so the ladder
+        falls through to the 4-chunk streaming rung and completes."""
+        from cylon_tpu.relational import join_tables
+        from cylon_tpu.relational.piece import PieceSource
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        src = PieceSource(ct.Table.from_pandas(ldf, env4), pad=8)
+        recovery.install_faults("shuffle.recv_guard:0:1=predicted,"
+                                "shuffle.recv_guard:0:2=predicted")
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        got = j.to_pandas().sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        exp = ldf.merge(rdf, on="k").sort_values(["k", "a", "b"]) \
+            .reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns], exp,
+                                      check_dtype=False)
+        acts = [e["action"] for e in recovery.recovery_events()
+                if e["site"] == "join"]
+        assert acts[:2] == ["spill_retry", "retry_chunks_4"], acts
+        del src
+
+    def test_no_spillable_state_goes_straight_to_chunks(self, env4, rng):
+        """Without spillable registrations the rung is skipped — the
+        pre-existing behavior (retry_chunks_4) is unchanged."""
+        from cylon_tpu.relational import join_tables
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        gc.collect()   # no leftover spillable sources from other tests
+        recovery.install_faults("shuffle.recv_guard:0:1=predicted")
+        join_tables(lt, rt, "k", "k", how="inner")
+        assert [e["action"] for e in recovery.recovery_events()] \
+            == ["retry_chunks_4"]
+
+
+# ---------------------------------------------------------------------------
+# spill-site injection + watchdog
+# ---------------------------------------------------------------------------
+
+class TestSpillInjection:
+    def test_grammar_accepts_spill_sites_and_kind(self):
+        recovery.install_faults("spill.evict=predicted")
+        recovery.install_faults("spill.upload=spill_stall")
+        recovery.install_faults("spill.evict:0:2=spill_stall")
+        with pytest.raises(ValueError):
+            recovery.install_faults("spill.nope=predicted")
+
+    def test_upload_stall_surfaces_typed_desync(self, env4, rng):
+        """A hung host→device re-upload surfaces as RankDesyncError with
+        site=spill.upload (exchange watchdog reuse), not a silent
+        stall."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        memory.evict(src._reg)
+        recovery.install_faults("spill.upload=spill_stall")
+        w = env4.world_size
+        with pytest.raises(RankDesyncError) as ei:
+            src.packed(np.zeros(w, np.int64), t.valid_counts, 64)
+        assert ei.value.site == "spill.upload"
+        del src
+
+    def test_evict_pressure_injection_evicts_lru(self, env4, rng):
+        """kind=predicted at spill.evict simulates memory pressure: the
+        admission path evicts exactly the LRU spillable owner."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        owner = src._reg.owner
+        recovery.install_faults("spill.evict:0:1=predicted")
+        memory.ensure_headroom(env4, 0)
+        assert src.spilled
+        assert memory.eviction_log() == [owner]
+        del src
+
+    def test_evict_exception_kinds_raise_typed(self, env4):
+        from cylon_tpu.status import DeviceOOMError
+        recovery.install_faults("spill.evict=device_oom")
+        with pytest.raises(Exception) as ei:
+            memory.ensure_headroom(env4, 0)
+        assert isinstance(recovery.classify(ei.value), DeviceOOMError)

@@ -66,3 +66,8 @@ def test_multi_process_join_groupby_sort(nproc):
         # rank-coherent recovery: only rank 0 was injected, yet every
         # process converged on the same retry branch without deadlock
         assert f"RECOVERY_OK pid={i} events=1" in out, out[-2000:]
+        # rank-coherent spill: eviction pressure injected on rank 0 only;
+        # consensus made every process run the IDENTICAL eviction
+        # sequence (the driver cross-checks the sequence hash via
+        # allgather and prints it per rank)
+        assert f"SPILL_OK pid={i} evictions=" in out, out[-2000:]

@@ -87,6 +87,28 @@ def test_ts105_sanctioned_in_recovery_module():
                for f in ast_lint.lint_source("cylon_tpu/other.py", src))
 
 
+def test_ts106_device_residency_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_device_residency.py"))
+        if f.rule == "TS106"]
+    # one device_get + one device_put, both flagged
+    assert len(found) == 2
+    assert all("exec.memory" in f.message for f in found)
+
+
+def test_ts106_scoped_to_operator_dirs():
+    # the identical calls OUTSIDE relational/ or parallel/ are fine —
+    # exec/memory.py (the ledger itself) and core/table.py (_put, the
+    # documented upload boundary) must not be flagged
+    src = "import jax\n\ndef f(x, s):\n    return jax.device_put(x, s)\n"
+    assert ast_lint.lint_source("cylon_tpu/exec/memory.py", src) == []
+    assert ast_lint.lint_source("cylon_tpu/core/table.py", src) == []
+    assert any(f.rule == "TS106" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/other.py", src))
+    assert any(f.rule == "TS106" for f in ast_lint.lint_source(
+        "cylon_tpu/parallel/other.py", src))
+
+
 def test_suppression_silences_everything():
     assert ast_lint.lint_file(os.path.join(BAD, "suppressed.py")) == []
 
@@ -111,7 +133,7 @@ def test_package_lints_clean():
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
-                                       "TS105"}
+                                       "TS105", "TS106"}
 
 
 # ---------------------------------------------------------------------------
