@@ -61,7 +61,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     import cylon_tpu as ct
     from cylon_tpu import config
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
-    from cylon_tpu.exec import memory, recovery
+    from cylon_tpu.exec import checkpoint, memory, recovery
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.utils import timing
 
@@ -138,6 +138,7 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     config.BENCH_TIMINGS = False
     recovery.reset_events()  # detail reports THIS workload's recoveries
     memory.reset_stats()     # ... and THIS workload's spill traffic
+    checkpoint.reset_stats()  # ... and THIS workload's checkpoint traffic
     try:
         step()  # warmup + compile
         times = []
@@ -184,6 +185,13 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # PCIe-assisted, not HBM-resident
             **{k: v for k, v in memory.stats().items() if k in
                ("spill_events", "bytes_spilled", "peak_ledger_bytes")},
+            # durable-checkpoint traffic (exec/checkpoint): a number with
+            # checkpoint_events > 0 paid page writes in-loop; one with
+            # resume_fast_forwarded_pieces > 0 restored committed pieces
+            # instead of recomputing them (CYLON_TPU_RESUME=1)
+            **{k: v for k, v in checkpoint.stats().items() if k in
+               ("checkpoint_events", "bytes_checkpointed",
+                "resume_fast_forwarded_pieces")},
         },
     }
 

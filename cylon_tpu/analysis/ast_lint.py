@@ -70,6 +70,15 @@ _RECOVERY_MODULE = "exec/recovery.py"
 _RESIDENCY_DIRS = ("relational", "parallel")
 _RESIDENCY_FUNCS = {"device_put", "device_get"}
 
+#: modules that may not write checkpoint artifacts directly (TS107):
+#: relational/ operators and the pipelined range loop — all durable
+#: state goes through exec/checkpoint.py (pages with content hashes,
+#: two-phase rank-coherent manifest commit); that module is outside
+#: these paths and therefore exempt by construction
+_CKPT_PIPELINE_FILE = "exec/pipeline.py"
+_CKPT_IO_LEAVES = {"save", "savez", "savez_compressed", "load",
+                   "dump", "dumps", "loads"}
+
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
 _STATIC_CALLS = {"len", "range", "enumerate", "zip", "isinstance", "getattr",
@@ -328,6 +337,7 @@ class _ModuleLint:
         self._check_jit_sites()
         self._check_oom_stringmatch()
         self._check_device_residency()
+        self._check_ckpt_artifacts()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -459,6 +469,37 @@ class _ModuleLint:
                     "upload_window) so budget and spill decisions stay "
                     "accounted and rank-coherent")
 
+    def _check_ckpt_artifacts(self) -> None:
+        """TS107: a direct ``open``/``np.save``/``np.load``/``pickle.*``
+        of a checkpoint-directory path (``CYLON_TPU_CKPT_DIR`` or a
+        ckpt-named derivation of it) inside ``relational/`` or
+        ``exec/pipeline.py`` — durable artifacts written outside
+        :mod:`cylon_tpu.exec.checkpoint` carry no content hash and skip
+        the two-phase rank-coherent manifest commit, so a resume could
+        restore torn or rank-divergent state."""
+        norm = self.path.replace(os.sep, "/")
+        parts = norm.split("/")
+        if not ("relational" in parts or norm.endswith(_CKPT_PIPELINE_FILE)):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name(node.func)
+            leaf = fname.split(".")[-1]
+            root = fname.split(".")[0]
+            is_io = (fname == "open"
+                     or (leaf in _CKPT_IO_LEAVES
+                         and root in _NUMPY_MODULES | {"jnp", "pickle"}))
+            if is_io and _mentions_ckpt_path(node):
+                self._emit(
+                    "TS107", node,
+                    f"`{fname}` writes/reads a checkpoint artifact outside "
+                    "exec/checkpoint.py — durable piece state must go "
+                    "through the checkpoint Stage (content-hashed pages, "
+                    "two-phase rank-coherent manifest commit); a direct "
+                    "artifact has no hash and no commit epoch, so resume "
+                    "could restore torn or rank-divergent state")
+
     def _check_jit_sites(self) -> None:
         for node in ast.walk(self.tree):
             if not (isinstance(node, ast.Call)
@@ -484,6 +525,23 @@ class _ModuleLint:
                     f"param(s) {sorted(control_params)} drive Python "
                     "control flow — every call with a tracer there fails, "
                     "every distinct value retraces")
+
+
+def _mentions_ckpt_path(node: ast.Call) -> bool:
+    """Does the call's argument subtree reference the checkpoint
+    directory — the ``CYLON_TPU_CKPT_DIR`` env var or a ckpt-named
+    name/attribute/constant derived from it?  Keeps TS107 targeted:
+    ordinary np.save/open of non-checkpoint paths never fires."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and ("CKPT" in sub.value.upper()
+                     or "CYLON_TPU_CKPT_DIR" in sub.value)):
+            return True
+        if isinstance(sub, ast.Name) and "ckpt" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "ckpt" in sub.attr.lower():
+            return True
+    return False
 
 
 def lint_source(path: str, source: str) -> list[Finding]:

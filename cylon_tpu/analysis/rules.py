@@ -34,6 +34,9 @@ RULES = {
     "TS106": "bare jax.device_put/device_get in relational/ or parallel/ "
              "(residency changes must go through the exec/memory HBM "
              "ledger)",
+    "TS107": "checkpoint artifact written outside exec/checkpoint.py "
+             "(direct open/np.save/pickle of CYLON_TPU_CKPT_DIR paths "
+             "bypasses the page-hash/two-phase-manifest protocol)",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

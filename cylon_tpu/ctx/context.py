@@ -138,6 +138,11 @@ class CylonEnv:
         devs = self.config.resolve_devices()
         self._devices = devs
         self._mesh = Mesh(np.asarray(devs, dtype=object), (ROW_AXIS,))
+        # settle the compiler-crash signature classification while the
+        # backend is known-good (one probe compile, cached per process) —
+        # the operator compile ladders dispatch on it (exec/recovery)
+        from ..exec.recovery import prime_compiler_probe
+        prime_compiler_probe()
         self._conf: dict[str, str] = {}
         self._finalized = False
         self.serial = CylonEnv._next_serial

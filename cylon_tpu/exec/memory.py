@@ -554,6 +554,16 @@ def _put_blocks(blocks: list, sharding):
     return jax.device_put(np.concatenate(blocks), sharding)
 
 
+def put_blocks(blocks: list, sharding):
+    """Public name for the sanctioned per-shard-blocks upload boundary —
+    the durable-checkpoint restore path (exec/checkpoint) re-enters its
+    host pages through the SAME transport the spill tier uses, so a
+    resumed piece is byte-identical to the resident array it was pulled
+    from (and multi-controller restores stay collective-free: each
+    process uploads only its addressable blocks)."""
+    return _put_blocks(blocks, sharding)
+
+
 def _upload(hosts, sharding, stall: bool = False):
     """Per-array host shard-block lists -> device (:func:`_put_blocks`).
     The dispatch stays ASYNC — blocking every upload would serialize

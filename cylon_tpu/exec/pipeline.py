@@ -205,6 +205,26 @@ class GroupBySink:
         self._regs: list = []  # HBM-ledger registrations of the partials
         self._pending = []   # in-flight fused dispatches (see __call__)
         self._disjoint = False
+        self._ckpt = None    # durable-checkpoint Stage (exec/checkpoint)
+        self._adopted = 0    # pieces adopted so far = checkpoint index
+
+    def attach_checkpoint(self, stage) -> None:
+        """Arm durable checkpointing (exec/checkpoint): each adopted
+        partial aggregate — the sink's completed-piece state — is saved
+        and committed at its stage boundary.  Adoption order equals
+        consumption order (the pending queue is FIFO), so the adoption
+        counter IS the piece index."""
+        self._ckpt = stage
+
+    def restore_partial(self, part: Table) -> None:
+        """Adopt a checkpoint-restored partial (resume fast-forward)
+        without re-saving it — bit-identical to the partial the crashed
+        process computed, so finalize() is bit-equal to an uninterrupted
+        run."""
+        from . import memory
+        self._parts.append(part)
+        self._regs.append(memory.register_table("sink_part", part))
+        self._adopted += 1
 
     def _adopt(self, part: Table) -> None:
         """Keep one chunk's partial aggregate, accounted in the HBM
@@ -214,6 +234,9 @@ class GroupBySink:
         from . import memory
         self._parts.append(part)
         self._regs.append(memory.register_table("sink_part", part))
+        if self._ckpt is not None:
+            self._ckpt.save_piece(self._adopted, part)
+        self._adopted += 1
 
     def mark_key_disjoint(self) -> None:
         """Caller guarantee: no group key occurs in more than one consumed
@@ -581,14 +604,70 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
 
     live_ranges = [r for r in range(n_ranges) if qualifies(r)]
 
-    if packed and live_ranges:
+    # ---- durable checkpoint stage (exec/checkpoint) ---------------------
+    # Armed only when CYLON_TPU_CKPT_DIR is set — otherwise `stage` stays
+    # None and this path adds zero filesystem writes and zero extra
+    # collectives.  The plan token pins the stage's static plan; a resume
+    # restores committed pieces bit-identically and fast-forwards the
+    # loop past them (a corrupt page degrades to recomputing the stage's
+    # remaining pieces, never to a wrong answer).
+    from . import checkpoint as ckpt
+    stage = None
+    if (ckpt.enabled() and live_ranges
+            and (sink is None or isinstance(sink, GroupBySink))):
+        # the consumption MODE is part of the plan: a sink stage
+        # checkpoints partial aggregates, a sinkless one piece outputs —
+        # restoring one as the other would splice wrong-shaped state in
+        mode = ("nosink", tuple(suffixes)) if sink is None else \
+            ("sink", tuple(sink.by), tuple(sink._chunk_aggs), sink.ddof)
+        token = ckpt.plan_token(
+            "pipelined_join", how, tuple(left_on), tuple(right_on),
+            n_ranges, w, tuple(caps_l), tuple(caps_r),
+            tuple(int(x) for x in pcounts.sum(axis=0)),
+            tuple(int(x) for x in r_lens.sum(axis=0)), mode)
+        stage = ckpt.open_stage(env, "pipelined_join", token)
+        if isinstance(sink, GroupBySink):
+            sink.attach_checkpoint(stage)
+
+    start = 0
+    outs = []
+    if stage is not None and ckpt.resume_requested():
+        from ..status import CheckpointCorruptError
+        from . import recovery
+        restored: list = []
+        if stage.resuming:
+            while (len(restored) < len(live_ranges)
+                   and stage.has_piece(len(restored))):
+                try:
+                    restored.append(stage.load_piece(len(restored)))
+                except CheckpointCorruptError as e:
+                    ckpt.corrupt_fallback(stage, len(restored), e)
+                    break
+        # rank-coherent fast-forward: every rank adopts the MINIMUM
+        # restorable prefix across ranks (one vote per stage; entered by
+        # every rank whenever resume is requested, even with nothing
+        # restorable locally) — a rank-local fallback would leave the
+        # recomputing rank alone in the per-piece commit collectives
+        # below
+        start = recovery.ckpt_resume_consensus(getattr(env, "mesh", None),
+                                               len(restored))
+        if len(restored) > start:
+            ckpt.unrestore(len(restored) - start)
+        for tbl in restored[:start]:
+            if sink is not None:
+                sink.restore_partial(tbl)
+                outs.append(None)   # a GroupBySink call returns None too
+            else:
+                outs.append(tbl)
+
+    if packed and live_ranges[start:]:
         # pre-warm: with the capacities known, every distinct join
         # program can AOT-compile BEFORE the range loop (while the probe
         # sort still occupies the device) instead of stalling dispatch
         # mid-stream.  No-op where the persistent compile cache is off.
         from ..relational.join import prewarm_packed_join
         warmed = set()
-        for r in live_ranges:
+        for r in live_ranges[start:]:
             # the program's static key includes the all-live class (lens
             # exactly at capacity drops the liveness operand), not just
             # the capacity pair — dedupe on the same signature
@@ -617,9 +696,8 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                     + caps_r[r] * memory.spec_row_bytes(src_r.spec))
         return memory.prefetch_depth(pair) > 1
 
-    outs = []
-    nxt = make_pieces(live_ranges[0]) if live_ranges else None
-    for i, r in enumerate(live_ranges):
+    nxt = make_pieces(live_ranges[start]) if live_ranges[start:] else None
+    for i in range(start, len(live_ranges)):
         piece_l, piece_r = nxt
         nxt = None
         if i + 1 < len(live_ranges) and _prefetch_ok(live_ranges[i + 1]):
@@ -637,6 +715,11 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                                 allow_defer=(sink is not None))
         with timing.region("pipe.consume"):
             out_r = sink(res_r) if sink is not None else res_r
+        if stage is not None and sink is None:
+            # sinkless stage boundary: the piece output IS the
+            # completed-piece state (a GroupBySink checkpoints its own
+            # partials at adoption instead)
+            stage.save_piece(i, res_r)
         outs.append(out_r)
         if nxt is None and i + 1 < len(live_ranges):
             nxt = make_pieces(live_ranges[i + 1])

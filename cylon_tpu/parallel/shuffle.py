@@ -351,6 +351,11 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
                 "shard would materialize the bulk of the table",
                 site="shuffle.recv_guard")
 
+    if rounds > 1:
+        # countable path marker (tests/test_fuzz.py regime tier): the
+        # multi-round protocol actually engaged for this exchange
+        from ..utils import timing
+        timing.bump("exchange.multiround")
     counts_i = np.asarray(counts, np.int32)
     tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)
     outs = tuple(_alloc_fn(mesh, out_cap, str(c.dtype), c.shape[1:])()

@@ -58,15 +58,14 @@ _PAD_CACHE = BoundedCache()
 
 
 def _is_compiler_crash(e: Exception) -> bool:
-    """True when the XLA:TPU compiler subprocess died (SIGSEGV landmines:
-    f64 sort payloads and specific gather lane widths, v5e libtpu 2026-07)
-    rather than the program being invalid.  Matches both the axon
-    remote-compile tunnel's surfacing ("remote_compile ... SIGSEGV") and a
-    directly-attached TPU VM's ("tpu_compile_helper" subprocess death) —
-    the ladder must engage on either."""
-    s = str(e)
-    return ("tpu_compile_helper" in s or "SIGSEGV" in s
-            or "Mosaic failed to compile" in s)
+    """True when the XLA compiler process died rather than the program
+    being invalid — delegates to the per-process probe-compiled
+    signature set (:func:`cylon_tpu.exec.recovery.is_compiler_crash`,
+    primed at first env creation, ``CYLON_TPU_CRASH_SIGS`` overrides),
+    so the pad ladder engages on whatever surfacing shape THIS platform
+    produces instead of a substring list frozen at authoring time."""
+    from ..exec.recovery import is_compiler_crash
+    return is_compiler_crash(e)
 
 
 def _pad_ladder(sig_key, attempts):

@@ -181,10 +181,13 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
     if (how in ("inner", "left", "semi", "anti")
             and rwork.row_count <= bc
             and lwork.row_count >= 4 * max(rwork.row_count, 1)):
+        # countable path marker (tests/test_fuzz.py regime tier)
+        timing.bump("join.broadcast")
         return lwork, allgather_table(rwork), True
     if (how in ("inner", "right")
             and lwork.row_count <= bc
             and rwork.row_count >= 4 * max(lwork.row_count, 1)):
+        timing.bump("join.broadcast")
         return allgather_table(lwork), rwork, True
 
     if how in ("inner", "left", "right", "semi", "anti"):

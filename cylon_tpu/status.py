@@ -32,6 +32,12 @@ class Code(enum.IntEnum):
     #: as the fault codes (docs/robustness.md, "why eviction is
     #: collective").  Not an error class — never raised.
     SpillRequired = 46
+    #: durable-checkpoint two-phase commit vote (exec/checkpoint): every
+    #: rank has STAGED its manifest and votes this code with the staged
+    #: epoch riding the same pmax wire, so a manifest is committed on
+    #: every rank at the identical epoch or on none.  Not an error class
+    #: — never raised.
+    CkptCommit = 47
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42
@@ -129,6 +135,39 @@ class RankDesyncError(CylonError):
 #: the four recovery-fault types, in one tuple for isinstance dispatch
 FAULT_TYPES = (PredictedResourceExhausted, DeviceOOMError,
                CapacityOverflowError, RankDesyncError)
+
+
+class ResumableAbort(CylonError):
+    """The retry ladder's FINAL rung (exec/recovery + exec/checkpoint):
+    an unrecoverable fault (real device OOM on an HBM-poisoning rig, an
+    exhausted compiler-crash ladder) arrived while durable checkpointing
+    was armed — committed piece state has been flushed, and a FRESH
+    process launched with ``CYLON_TPU_RESUME=1`` fast-forwards past the
+    committed pieces bit-identically instead of recomputing.  ``token``
+    is the resume token (the checkpoint directory); the original fault
+    rides ``__cause__``.  Terminal by design: never retried in-process
+    (the whole point is that in-process retries are doomed here)."""
+
+    code = Code.ExecutionError
+    kind = "resumable"
+
+    def __init__(self, msg: str = "", token: str | None = None):
+        super().__init__(msg)
+        self.token = token
+
+
+class CheckpointCorruptError(CylonError):
+    """A checkpoint page or manifest failed its content-hash check (or
+    an injected ``corrupt`` fault simulated that) on the resume path:
+    the stage's remaining pieces are recomputed instead of restored —
+    corruption degrades resume to recompute, never to a wrong answer."""
+
+    code = Code.SerializationError
+    kind = "corrupt"
+
+    def __init__(self, msg: str = "", site: str | None = None):
+        super().__init__(msg)
+        self.site = site
 
 
 class CylonTypeError(CylonError):

@@ -831,11 +831,12 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
     deploy story for SF10+ is a pod slice, deploy/README.md)."""
     import jax
 
-    from cylon_tpu.exec import memory, recovery
+    from cylon_tpu.exec import checkpoint, memory, recovery
     from cylon_tpu.status import Code, PredictedResourceExhausted
     # the detail block reports THIS bench invocation's recoveries only
     # (including failed-attempt events from the halving loop below)
     recovery.reset_events()
+    checkpoint.reset_stats()
     spilled_scales: set = set()
     while True:
         try:
@@ -868,13 +869,23 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
                 # and every later allocation fails, so in-process retries
                 # are doomed.  A PREDICTED guard error is different: it
                 # fired before any allocation, HBM is untouched, and the
-                # in-process scale-halving retry below is safe.
+                # in-process scale-halving retry below is safe.  (With
+                # durable checkpointing armed the ladder's FINAL rung
+                # already converted this into a ResumableAbort carrying
+                # the resume token — classify() passes it through above
+                # — so this bare-abort advice is the UNARMED path only.)
+                resume_hint = (
+                    "; set CYLON_TPU_CKPT_DIR to make the fresh-process "
+                    "rerun fast-forward past completed pieces "
+                    "(CYLON_TPU_RESUME=1, docs/robustness.md)"
+                    if not checkpoint.enabled() else "")
                 raise RuntimeError(
                     f"TPC-H SF{scale:g} exceeded device memory and "
                     "this rig does not recover HBM after an OOM in the "
                     "same process; rerun at a smaller --scale in a FRESH "
                     "process, or use scripts/bench_tpch_q3q5.py "
-                    "(column-projected ingest) for large scales") from e
+                    "(column-projected ingest) for large scales"
+                    + resume_hint) from e
             scale = scale / 2
             print(f"# TPC-H {fault.kind} OOM; retrying at SF{scale:g}",
                   flush=True)
@@ -934,6 +945,12 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                    **{k: v for k, v in _spill_stats().items() if k in
                       ("spill_events", "bytes_spilled",
                        "peak_ledger_bytes")},
+                   # durable checkpoint traffic (exec/checkpoint): did
+                   # this number include checkpoint writes, and did a
+                   # resumed run fast-forward instead of recomputing?
+                   **{k: v for k, v in _ckpt_stats().items() if k in
+                      ("checkpoint_events", "bytes_checkpointed",
+                       "resume_fast_forwarded_pieces")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 
@@ -946,3 +963,8 @@ def _recovery_events() -> list:
 def _spill_stats() -> dict:
     from cylon_tpu.exec import memory
     return memory.stats()
+
+
+def _ckpt_stats() -> dict:
+    from cylon_tpu.exec import checkpoint
+    return checkpoint.stats()

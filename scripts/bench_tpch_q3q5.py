@@ -40,10 +40,11 @@ def main():
     import cylon_tpu as ct
     from cylon_tpu import tpch
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
-    from cylon_tpu.exec import memory, recovery
+    from cylon_tpu.exec import checkpoint, memory, recovery
 
     recovery.reset_events()
     memory.reset_stats()
+    checkpoint.reset_stats()
 
     devs = jax.devices()
     on_accel = devs[0].platform != "cpu"
@@ -81,6 +82,10 @@ def main():
                    **{k: v for k, v in memory.stats().items() if k in
                       ("spill_events", "bytes_spilled",
                        "peak_ledger_bytes")},
+                   # durable-checkpoint traffic (exec/checkpoint)
+                   **{k: v for k, v in checkpoint.stats().items() if k in
+                      ("checkpoint_events", "bytes_checkpointed",
+                       "resume_fast_forwarded_pieces")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

@@ -1,0 +1,298 @@
+"""Chaos-soak harness for the recovery ladder + durable checkpoint rung.
+
+Seeded randomized fault schedules over every injector site
+(``CYLON_TPU_FAULTS`` grammar, docs/robustness.md) driven against a
+TPC-H-shaped pipelined join+groupby workload running in a CHILD
+subprocess, so the ``kill`` fault kind (SIGKILL mid-range-loop) and the
+``ResumableAbort`` path can actually be survived and resumed:
+
+* every schedule must end in a BIT-EQUAL result (sha over the sorted
+  result columns' raw bytes vs an un-injected baseline), possibly after
+  the consensus retry ladder degraded the run in-process;
+* or in a hard crash / typed ``ResumableAbort`` — then the harness
+  reruns the child with ``CYLON_TPU_RESUME=1`` against the surviving
+  checkpoint directory and THAT run must be bit-equal, fast-forwarding
+  past committed pieces (``resume_fast_forwarded_pieces``) where any
+  were committed;
+* recovery-event counts stay bounded (the ladder's escalation is finite
+  by construction — an unbounded count means a retry loop escaped it).
+
+The first three schedules are pinned (kill-and-resume, corrupt-on-write
+then kill, corrupt-on-load during resume) so the acceptance paths run
+on every seed; the rest are drawn from ``--seed``.
+
+Usage::
+
+    python scripts/chaos_soak.py --seed 7                 # 20 schedules
+    python scripts/chaos_soak.py --seed 7 --schedules 4 --rows 1500
+
+Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
+runs in CI as a slow-marked test (tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+#: (site, eligible kinds) for the randomized draws — `stall`/`desync`
+#: are excluded: a desync is terminal by design (never retried), so a
+#: schedule containing one cannot converge and would only test the
+#: harness, not the ladder
+SITE_KINDS = [
+    ("shuffle.recv_guard", ["predicted", "device_oom", "capacity"]),
+    ("join.piece_cap", ["capacity"]),
+    ("groupby.device_oom", ["device_oom", "predicted"]),
+    ("spill.evict", ["predicted"]),
+    ("ckpt.write", ["corrupt", "device_oom", "kill"]),
+    ("ckpt.load", ["corrupt"]),
+]
+
+#: per-run ceiling on logged recovery events: the ladder's schedule is
+#: spill + 2 chunk rungs (+1 cap rung) per operator — a soak workload
+#: crossing this is looping, not recovering
+MAX_RECOVERY_EVENTS = 8
+
+RESUMABLE_EXIT = 17
+
+
+# ---------------------------------------------------------------------------
+# worker: one workload run in this process (spawned by the parent)
+# ---------------------------------------------------------------------------
+
+def worker(args) -> int:
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.ctx.context import CPUMeshConfig
+    from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, \
+        recovery
+    from cylon_tpu.status import ResumableAbort
+
+    recovery.install_faults(None)   # validate the env grammar up front
+    env = ct.CylonEnv(config=CPUMeshConfig(world_size=4))
+
+    # TPC-H-shaped: orders ⋈ lineitem on the order key, aggregated per
+    # order — integer "money" so every retry/restore path is exactly
+    # bit-comparable.  Seeded: the resumed process rebuilds the
+    # identical inputs, which is what makes the stage plan tokens match.
+    rng = np.random.default_rng(20260803)
+    n_ord = max(args.rows // 4, 64)
+    n_line = args.rows
+    orders = ct.Table.from_pydict(
+        {"o_orderkey": np.arange(n_ord, dtype=np.int64),
+         "o_shippriority": rng.integers(0, 5, n_ord).astype(np.int64)}, env)
+    lineitem = ct.Table.from_pydict(
+        {"l_orderkey": rng.integers(0, n_ord, n_line).astype(np.int64),
+         "l_quantity": rng.integers(1, 51, n_line).astype(np.int64),
+         "l_extendedprice": rng.integers(900_00, 10_500_00,
+                                         n_line).astype(np.int64)}, env)
+
+    def attempt(nc):
+        sink = GroupBySink("l_orderkey", [("l_quantity", "sum"),
+                                          ("l_extendedprice", "sum")])
+        pipelined_join(lineitem, orders, "l_orderkey", "o_orderkey",
+                       how="inner", n_chunks=nc, sink=sink)
+        return sink.finalize()
+
+    try:
+        out = recovery.run_with_recovery(
+            lambda: attempt(args.chunks), True, attempt, "soak", env=env)
+    except ResumableAbort as e:
+        print(json.dumps({"resumable": True, "token": e.token,
+                          "events": len(recovery.recovery_events())}),
+              flush=True)
+        return RESUMABLE_EXIT
+
+    df = out.to_pandas().sort_values("l_orderkey").reset_index(drop=True)
+    h = hashlib.sha256()
+    for col in sorted(df.columns):
+        h.update(np.ascontiguousarray(df[col].to_numpy()).tobytes())
+    print(json.dumps({
+        "ok": True, "sha": h.hexdigest(), "rows": int(len(df)),
+        "events": len(recovery.recovery_events()),
+        "event_list": recovery.recovery_events(),
+        **checkpoint.stats(),
+    }), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: schedule generation + child supervision
+# ---------------------------------------------------------------------------
+
+def _draw_schedule(rng) -> dict:
+    n = 1 + int(rng.random() < 0.4)
+    entries, resume_entries = [], []
+    have_capacity = False
+    for _ in range(n):
+        site, kinds = SITE_KINDS[int(rng.integers(0, len(SITE_KINDS)))]
+        kind = kinds[int(rng.integers(0, len(kinds)))]
+        if kind == "capacity" and have_capacity:
+            # the capacity ladder is ONE rung by design (bounded
+            # escalation, docs/robustness.md) and a capacity abort is
+            # not resumable — a schedule with two capacity faults is
+            # unconvergeable by construction, like the excluded
+            # stall/desync kinds; redraw the kind (or drop the entry
+            # where capacity is the site's only kind)
+            others = [k for k in kinds if k != "capacity"]
+            if not others:
+                continue
+            kind = others[int(rng.integers(0, len(others)))]
+        have_capacity = have_capacity or kind == "capacity"
+        nth = int(rng.integers(1, 3))
+        entry = f"{site}::{nth}={kind}"
+        if site == "ckpt.load":
+            # ckpt.load only fires while RESUMING (Stage.load_piece) —
+            # armed in the primary run it would never trigger and the
+            # schedule would silently degenerate to a happy-path run;
+            # arm it in the resume leg instead
+            resume_entries.append(entry)
+        else:
+            entries.append(entry)
+    if resume_entries and not any(e.endswith("=kill") for e in entries):
+        # the resume leg only runs after a hard crash — force one
+        entries.append("ckpt.write::2=kill")
+    return {"faults": ",".join(entries),
+            "resume_faults": ",".join(resume_entries)}
+
+
+def _pinned_schedules() -> list[dict]:
+    return [
+        # the acceptance path: SIGKILL mid-range-loop after one piece
+        # committed, resume must fast-forward (ffwd > 0, no recompute of
+        # the committed piece)
+        {"faults": "ckpt.write::2=kill", "resume_faults": "",
+         "expect_ffwd": True},
+        # a corrupted page among the committed pieces: resume detects
+        # the hash mismatch and degrades to recompute — still bit-equal
+        {"faults": "ckpt.write::1=corrupt,ckpt.write::3=kill",
+         "resume_faults": ""},
+        # corruption injected on the LOAD side of the resume itself
+        {"faults": "ckpt.write::3=kill",
+         "resume_faults": "ckpt.load::1=corrupt"},
+    ]
+
+
+def _spawn(args, workdir: str, faults: str, resume: bool) -> tuple:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["CYLON_TPU_FAULTS"] = faults
+    env["CYLON_TPU_CKPT_DIR"] = workdir
+    if resume:
+        env["CYLON_TPU_RESUME"] = "1"
+    else:
+        env.pop("CYLON_TPU_RESUME", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           f"--rows={args.rows}", f"--chunks={args.chunks}"]
+    p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                       text=True, timeout=600)
+    info = None
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                info = json.loads(line)
+            except ValueError:
+                pass
+            break
+    return p, info
+
+
+def _run_schedule(args, idx: int, sched: dict, baseline_sha: str,
+                  failures: list) -> None:
+    workdir = tempfile.mkdtemp(prefix=f"soak{idx:02d}_", dir=args.workdir)
+
+    def fail(msg, proc=None):
+        tail = ("\n" + (proc.stdout + proc.stderr)[-2000:]) if proc else ""
+        failures.append(f"schedule {idx} ({sched['faults']!r}): {msg}{tail}")
+
+    p, info = _spawn(args, workdir, sched["faults"], resume=False)
+    outcome = "ok"
+    if p.returncode == 0:
+        if not info or info.get("sha") != baseline_sha:
+            fail(f"completed but result diverged: {info}", p)
+        elif info["events"] > MAX_RECOVERY_EVENTS:
+            fail(f"unbounded retries: {info['events']} recovery events", p)
+    elif p.returncode == -9 or p.returncode == RESUMABLE_EXIT:
+        outcome = "killed" if p.returncode == -9 else "resumable"
+        p2, info2 = _spawn(args, workdir, sched.get("resume_faults", ""),
+                           resume=True)
+        if p2.returncode != 0:
+            fail(f"resume run failed rc={p2.returncode}", p2)
+        elif not info2 or info2.get("sha") != baseline_sha:
+            fail(f"resumed result diverged: {info2}", p2)
+        elif info2["events"] > MAX_RECOVERY_EVENTS:
+            fail(f"unbounded retries on resume: {info2['events']}", p2)
+        elif sched.get("expect_ffwd") \
+                and not info2.get("resume_fast_forwarded_pieces"):
+            fail(f"resume recomputed committed pieces: {info2}", p2)
+        else:
+            outcome += (f"+resumed(ffwd="
+                        f"{info2.get('resume_fast_forwarded_pieces')})")
+    else:
+        fail(f"unexpected exit rc={p.returncode}", p)
+    rf = sched.get("resume_faults", "")
+    print(f"# schedule {idx:02d} faults={sched['faults']!r}"
+          + (f" resume_faults={rf!r}" if rf else "")
+          + f" -> {outcome}", flush=True)
+    shutil.rmtree(workdir, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--schedules", type=int, default=20)
+    ap.add_argument("--rows", type=int, default=3000)
+    ap.add_argument("--chunks", type=int, default=4)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--worker", action="store_true")
+    args = ap.parse_args()
+
+    if args.worker:
+        sys.path.insert(0, REPO)
+        return worker(args)
+
+    import numpy as np
+    rng = np.random.default_rng(args.seed)
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_soak_")
+
+    schedules = _pinned_schedules()
+    while len(schedules) < args.schedules:
+        schedules.append(_draw_schedule(rng))
+    schedules = schedules[:args.schedules]
+
+    # un-injected, un-checkpointed baseline: the bit-equality oracle
+    p, info = _spawn(args, os.path.join(args.workdir, "baseline"), "",
+                     resume=False)
+    if p.returncode != 0 or not info or not info.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: baseline run failed", file=sys.stderr)
+        return 1
+    baseline_sha = info["sha"]
+    print(f"# baseline sha={baseline_sha[:16]} rows={info['rows']}",
+          flush=True)
+
+    failures: list = []
+    for i, sched in enumerate(schedules):
+        _run_schedule(args, i, sched, baseline_sha, failures)
+
+    print(json.dumps({"schedules": len(schedules),
+                      "failures": len(failures), "seed": args.seed,
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

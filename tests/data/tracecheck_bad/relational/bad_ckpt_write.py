@@ -1,0 +1,32 @@
+"""Known-bad fixture: checkpoint artifacts written outside
+exec/checkpoint.py (TS107) — a relational operator dumping piece state
+straight into CYLON_TPU_CKPT_DIR bypasses the content-hash pages and the
+two-phase rank-coherent manifest commit, so a resume could restore torn
+or rank-divergent state."""
+
+import os
+import pickle
+
+import numpy as np
+
+
+def sneaky_piece_dump(arr, i):
+    ckpt_dir = os.environ["CYLON_TPU_CKPT_DIR"]
+    np.save(os.path.join(ckpt_dir, f"piece_{i}.npy"), arr)  # TS107
+    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as f:  # TS107
+        f.write("{}")
+
+
+def sneaky_meta_pickle(meta, path_under_ckpt_dir):
+    with open(path_under_ckpt_dir, "wb") as fh:  # TS107 (ckpt-named path)
+        pickle.dump(meta, fh)  # not flagged itself: args carry no ckpt name
+
+
+def fine_non_checkpoint_io(arr, scratch_path):
+    np.save(scratch_path, arr)  # NOT flagged: not a checkpoint path
+
+
+
+def sneaky_restore(i):
+    ckpt_dir = os.environ.get("CYLON_TPU_CKPT_DIR", "")
+    return np.load(os.path.join(ckpt_dir, f"piece_{i}.npy"))  # TS107

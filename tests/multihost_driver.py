@@ -49,6 +49,50 @@ rt = ct.Table.from_pandas(rdf, env)
 
 env.barrier()
 
+# ---------------------------------------------------------------------------
+# Scenario mode: kill-rank-0-and-resume (docs/robustness.md "Durable
+# checkpoints & resume").  First launch: the `kill` fault kind SIGKILLs
+# rank 0 mid-range-loop (during a piece's ckpt.write, BEFORE its commit
+# vote) — rank 1's commit consensus converts the orphaned collective into
+# a typed RankDesyncError via the watchdog.  Second launch
+# (CYLON_TPU_RESUME=1): both ranks fast-forward past the pieces whose
+# two-phase CkptCommit vote completed, recompute the rest, and must end
+# bit-equal with the IDENTICAL manifest epoch on every rank.
+# ---------------------------------------------------------------------------
+if os.environ.get("CYLON_TPU_MH_SCENARIO") == "kill_resume":
+    import glob
+    import hashlib
+    import json
+    import zlib
+
+    from jax.experimental import multihost_utils
+
+    from cylon_tpu.exec import checkpoint, pipelined_join, recovery
+
+    resuming = os.environ.get("CYLON_TPU_RESUME") == "1"
+    if not resuming:
+        recovery.install_faults("ckpt.write:0:2=kill")
+    jt = pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=4)
+    got = (jt.to_pandas().sort_values(["k", "a", "b"])
+           .reset_index(drop=True))
+    sha = hashlib.sha256(got.to_csv(index=False).encode()).hexdigest()
+    mans = sorted(glob.glob(os.path.join(
+        checkpoint.ckpt_dir(), f"rank{pid}", "stage*", "MANIFEST.json")))
+    assert mans, "no committed manifest on this rank"
+    with open(mans[0], encoding="utf-8") as f:
+        epoch = int(json.load(f)["epoch"])
+    # every rank must have committed the IDENTICAL epoch and result
+    wire = np.asarray([epoch, zlib.crc32(sha.encode())], np.int64)
+    gathered = np.asarray(multihost_utils.process_allgather(wire))
+    gathered = gathered.reshape(nproc, 2)
+    assert len({int(r[0]) for r in gathered}) == 1, gathered
+    assert len({int(r[1]) for r in gathered}) == 1, gathered
+    ffwd = checkpoint.stats()["resume_fast_forwarded_pieces"]
+    if resuming:
+        assert ffwd > 0, "resume recomputed every committed piece"
+    print(f"KILLRESUME_OK pid={pid} epoch={epoch} ffwd={ffwd}", flush=True)
+    sys.exit(0)
+
 j = join_tables(lt, rt, "k", "k", how="inner")
 g = groupby_aggregate(j, "k", [("a", "sum"), ("b", "mean")])
 s = sort_table(g, "k")

@@ -1,0 +1,381 @@
+"""Durable checkpoint/resume rung (cylon_tpu.exec.checkpoint +
+docs/robustness.md "Durable checkpoints & resume"): host-page round
+trips, the two-phase manifest commit, resume fast-forward through the
+pipelined range loop (sink and sinkless), corruption fallback, the
+ladder's FINAL ResumableAbort rung, and the trimmed chaos soak.  The
+cross-PROCESS kill-and-resume acceptance runs in scripts/chaos_soak.py
+(pinned schedule 0) and in the slow-marked soak test here."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, recovery
+from cylon_tpu.status import (CheckpointCorruptError, DeviceOOMError,
+                              ResumableAbort)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Every test runs with its own checkpoint root, a fresh stage
+    sequence, zeroed counters and a disarmed injector."""
+    monkeypatch.setenv("CYLON_TPU_CKPT_DIR", str(tmp_path / "ckpt"))
+    monkeypatch.delenv("CYLON_TPU_RESUME", raising=False)
+    checkpoint.reset_stages()
+    checkpoint.reset_stats()
+    recovery.install_faults("")
+    yield
+    checkpoint.reset_stages()
+    checkpoint.reset_stats()
+    recovery.install_faults("")
+
+
+def _tables(env, rng, n=2500, card=250):
+    ldf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                        "a": rng.integers(0, 50, n).astype(np.int64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                        "b": rng.integers(0, 50, n).astype(np.int64)})
+    return (ldf, rdf, ct.Table.from_pandas(ldf, env),
+            ct.Table.from_pandas(rdf, env))
+
+
+def _frames_bitequal(a: pd.DataFrame, b: pd.DataFrame) -> None:
+    assert list(a.columns) == list(b.columns)
+    for c in a.columns:
+        np.testing.assert_array_equal(a[c].to_numpy(), b[c].to_numpy(), c)
+
+
+def _run_join(lt, rt, n_chunks=4):
+    return (pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=n_chunks)
+            .to_pandas().sort_values(["k", "a", "b"])
+            .reset_index(drop=True))
+
+
+def _run_sink(lt, rt, n_chunks=4):
+    sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
+    pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=n_chunks,
+                   sink=sink)
+    return (sink.finalize().to_pandas().sort_values("k")
+            .reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# page round trip (Stage.save_piece / load_piece)
+# ---------------------------------------------------------------------------
+
+class TestPageRoundTrip:
+    def test_bit_exact_all_column_classes(self, env4, rng):
+        """Strings (dictionary), nullable ints, NaN-carrying f64 and
+        plain int64 all survive the host-page round trip bit-exactly —
+        the spill-tier transport persisted."""
+        n = 400
+        df = pd.DataFrame({
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "s": np.asarray([f"v{i % 7}" for i in range(n)], dtype=object),
+            "f": np.where(rng.random(n) < 0.1, np.nan, rng.random(n)),
+            "ni": pd.array(rng.integers(0, 9, n), dtype="Int64"),
+        })
+        df.loc[rng.integers(0, n, 20), "ni"] = pd.NA
+        t = ct.Table.from_pandas(df, env4)
+        stage = checkpoint.open_stage(env4, "unit", "tok")
+        stage.save_piece(0, t)
+        back = stage.load_piece(0)
+        assert back.column_names == t.column_names
+        for name in t.column_names:
+            a, b = t.column(name), back.column(name)
+            np.testing.assert_array_equal(np.asarray(a.data),
+                                          np.asarray(b.data), name)
+            assert (a.validity is None) == (b.validity is None)
+            if a.validity is not None:
+                np.testing.assert_array_equal(np.asarray(a.validity),
+                                              np.asarray(b.validity))
+            assert a.type == b.type
+        np.testing.assert_array_equal(t.valid_counts, back.valid_counts)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 1
+
+    def test_manifest_commits_identical_epoch_per_piece(self, env4, rng):
+        import json
+        _, _, lt, rt = _tables(env4, rng, n=800)
+        stage = checkpoint.open_stage(env4, "unit", "tok")
+        stage.save_piece(0, lt)
+        stage.save_piece(1, rt)
+        with open(stage._manifest_path, encoding="utf-8") as f:
+            man = json.load(f)
+        assert man["epoch"] == 2 and man["plan"] == "tok"
+        assert set(man["pieces"]) == {"0", "1"}
+        # no stray staged manifest survives a clean commit
+        assert not os.path.exists(stage._manifest_path + ".staged")
+
+    def test_hash_mismatch_raises_typed(self, env4, rng):
+        _, _, lt, _ = _tables(env4, rng, n=800)
+        stage = checkpoint.open_stage(env4, "unit", "tok")
+        stage.save_piece(0, lt)
+        page = os.path.join(stage.dir, stage.committed[0]["meta"])
+        raw = bytearray(open(page, "rb").read())
+        raw[0] ^= 0xFF
+        with open(page, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(CheckpointCorruptError):
+            stage.load_piece(0)
+        assert checkpoint.stats()["corrupt_pages"] == 1
+
+
+# ---------------------------------------------------------------------------
+# resume fast-forward through the pipelined range loop
+# ---------------------------------------------------------------------------
+
+class TestResumeFastForward:
+    def test_sinkless_resume_bit_equal_no_recompute(self, env4, rng,
+                                                    monkeypatch):
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_join(lt, rt)
+        s1 = checkpoint.stats()
+        assert s1["checkpoint_events"] >= 2
+        assert s1["bytes_checkpointed"] > 0
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_join(lt, rt)
+        _frames_bitequal(resumed, base)
+        s2 = checkpoint.stats()
+        # every piece fast-forwarded, none recomputed (no new commits)
+        assert s2["resume_fast_forwarded_pieces"] == s1["checkpoint_events"]
+        assert s2["checkpoint_events"] == 0
+
+    def test_sink_partials_resume_bit_equal(self, env4, rng, monkeypatch):
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_sink(lt, rt)
+        exp = (ldf.merge(rdf, on="k").groupby("k", as_index=False)
+               .agg(a_sum=("a", "sum"), b_sum=("b", "sum"))
+               .sort_values("k").reset_index(drop=True))
+        pd.testing.assert_frame_equal(base, exp, check_dtype=False)
+        n_committed = checkpoint.stats()["checkpoint_events"]
+        assert n_committed >= 2
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_sink(lt, rt)
+        _frames_bitequal(resumed, base)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] \
+            == n_committed
+
+    def test_partial_prefix_resume(self, env4, rng, monkeypatch):
+        """Only a prefix committed (as after a mid-loop crash): resume
+        restores the prefix and recomputes the rest — still bit-equal."""
+        import json
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_join(lt, rt)
+        # drop the last committed piece from the manifest, as if the
+        # process died before its commit
+        rank_dir = os.path.join(checkpoint.ckpt_dir(),
+                                f"rank{0}")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        mpath = os.path.join(stage_dir, "MANIFEST.json")
+        man = json.load(open(mpath, encoding="utf-8"))
+        full = len(man["pieces"])
+        assert full >= 2
+        dropped = str(max(int(k) for k in man["pieces"]))
+        del man["pieces"][dropped]
+        man["epoch"] -= 1
+        json.dump(man, open(mpath, "w", encoding="utf-8"))
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_join(lt, rt)
+        _frames_bitequal(resumed, base)
+        s = checkpoint.stats()
+        assert s["resume_fast_forwarded_pieces"] == full - 1
+        assert s["checkpoint_events"] == 1   # only the dropped piece re-ran
+
+    def test_corrupt_page_degrades_to_recompute(self, env4, rng,
+                                                monkeypatch):
+        """A flipped byte in a committed page: resume detects the hash
+        mismatch, falls back to recomputing the stage's remaining
+        pieces, and the result is STILL bit-equal — corruption never
+        produces a wrong answer."""
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_join(lt, rt)
+        rank_dir = os.path.join(checkpoint.ckpt_dir(), "rank0")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        page = next(p for p in sorted(os.listdir(stage_dir))
+                    if p.startswith("piece_0.p"))
+        path = os.path.join(stage_dir, page)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_join(lt, rt)
+        _frames_bitequal(resumed, base)
+        s = checkpoint.stats()
+        assert s["corrupt_pages"] >= 1
+        assert s["resume_fast_forwarded_pieces"] == 0
+
+    def test_injected_load_corruption(self, env4, rng, monkeypatch):
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_join(lt, rt)
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        recovery.install_faults("ckpt.load::1=corrupt")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_join(lt, rt)
+        _frames_bitequal(resumed, base)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 0
+        assert any(e["site"] == "ckpt.load" and e["action"] == "recompute"
+                   for e in recovery.recovery_events())
+
+    def test_plan_token_mismatch_starts_over(self, env4, rng, monkeypatch):
+        """A stale checkpoint from a DIFFERENT plan (other chunk count)
+        is never spliced in: the stage starts over and recomputes."""
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        _run_join(lt, rt, n_chunks=4)
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        out = _run_join(lt, rt, n_chunks=3)   # different plan, same stage id
+        exp = (ldf.merge(rdf, on="k").sort_values(["k", "a", "b"])
+               .reset_index(drop=True))
+        pd.testing.assert_frame_equal(out[exp.columns], exp,
+                                      check_dtype=False)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 0
+
+    def test_injected_write_fault_records_event(self, env4, rng):
+        """A non-corrupt/non-kill fault armed at ckpt.write is recorded
+        like every other injection site (the soak's MAX_RECOVERY_EVENTS
+        bound counts it) — and the ladder still converges."""
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        recovery.install_faults("ckpt.write::1=device_oom")
+
+        def attempt(nc=4):
+            return _run_join(lt, rt, n_chunks=nc)
+
+        out = recovery.run_with_recovery(attempt, True, attempt, "test",
+                                         env=env4)
+        exp = (ldf.merge(rdf, on="k").sort_values(["k", "a", "b"])
+               .reset_index(drop=True))
+        pd.testing.assert_frame_equal(out[exp.columns], exp,
+                                      check_dtype=False)
+        assert any(e["site"] == "ckpt.write" and e["action"] == "injected"
+                   for e in recovery.recovery_events())
+
+    def test_resume_consensus_wire_math(self):
+        """Single-controller identity + wire-range validation for the
+        min-agree fast-forward vote, and unrestore() backs discarded
+        restores out of the counter."""
+        assert recovery.ckpt_resume_consensus(None, 0) == 0
+        assert recovery.ckpt_resume_consensus(None, 7) == 7
+        with pytest.raises(ValueError):
+            recovery.ckpt_resume_consensus(None, -1)
+        with pytest.raises(ValueError):
+            recovery.ckpt_resume_consensus(None, 1 << 20)
+        checkpoint._STATS["resume_fast_forwarded_pieces"] = 5
+        checkpoint.unrestore(2)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 3
+        checkpoint.reset_stats()
+
+    def test_staged_only_manifest_is_ignored(self, env4, rng, monkeypatch):
+        """Phase-2 atomicity: a manifest that was STAGED but never
+        committed (crash between the write and the consensus rename)
+        must not be restored from."""
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        base = _run_join(lt, rt)
+        rank_dir = os.path.join(checkpoint.ckpt_dir(), "rank0")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        mpath = os.path.join(stage_dir, "MANIFEST.json")
+        os.replace(mpath, mpath + ".staged")   # un-commit it
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        resumed = _run_join(lt, rt)
+        _frames_bitequal(resumed, base)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 0
+
+
+# ---------------------------------------------------------------------------
+# happy path + FINAL ladder rung
+# ---------------------------------------------------------------------------
+
+class TestHappyPathAndFinalRung:
+    def test_disabled_means_zero_writes(self, env4, rng, monkeypatch,
+                                        tmp_path):
+        """With CYLON_TPU_CKPT_DIR unset the checkpoint layer is inert:
+        no stage opened, no file written, counters stay zero."""
+        monkeypatch.delenv("CYLON_TPU_CKPT_DIR", raising=False)
+        _, _, lt, rt = _tables(env4, rng, n=800)
+        _run_join(lt, rt)
+        assert checkpoint._STAGE_SEQ[0] == 0
+        assert checkpoint.stats() == {"checkpoint_events": 0,
+                                      "bytes_checkpointed": 0,
+                                      "resume_fast_forwarded_pieces": 0,
+                                      "corrupt_pages": 0}
+        assert not (tmp_path / "ckpt").exists()
+
+    def test_device_oom_abort_becomes_resumable(self, env4, rng):
+        """The FINAL rung: an unrecoverable device OOM with checkpoints
+        armed raises a typed ResumableAbort carrying the resume token
+        (the checkpoint root), original fault on __cause__."""
+        ldf, _, _, _ = _tables(env4, rng, n=1000)
+        t = ct.Table.from_pandas(ldf, env4)
+        from cylon_tpu.relational import groupby_aggregate
+        recovery.install_faults("groupby.device_oom::*=device_oom")
+        with pytest.raises(ResumableAbort) as ei:
+            groupby_aggregate(t, "k", [("a", "sum")])
+        assert ei.value.token == os.path.abspath(checkpoint.ckpt_dir())
+        assert isinstance(ei.value.__cause__, DeviceOOMError)
+        assert os.path.exists(os.path.join(checkpoint.ckpt_dir(),
+                                           "RESUME_TOKEN.json"))
+        acts = [e["action"] for e in recovery.recovery_events()
+                if e["site"] == "groupby"]
+        assert acts[-1] == "resumable_abort"
+
+    def test_compiler_crash_takes_final_rung(self, env4):
+        """An exhausted compiler-crash ladder (a non-fault exception for
+        classify) still takes the FINAL rung when checkpoints are
+        armed."""
+        def boom():
+            raise RuntimeError("tpu_compile_helper subprocess exit "
+                               "signal SIGSEGV (11)")
+
+        with pytest.raises(ResumableAbort) as ei:
+            recovery.run_with_recovery(boom, False, None, "t", env=env4)
+        assert ei.value.token
+        assert "CYLON_TPU_RESUME=1" in str(ei.value)
+
+    def test_without_ckpt_faults_stay_typed(self, env4, monkeypatch):
+        """Un-armed sessions keep the PR 3 behavior exactly: the typed
+        fault raises, no ResumableAbort, no files."""
+        monkeypatch.delenv("CYLON_TPU_CKPT_DIR", raising=False)
+
+        def boom():
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        with pytest.raises(DeviceOOMError):
+            recovery.run_with_recovery(boom, False, None, "t", env=env4)
+
+
+# ---------------------------------------------------------------------------
+# trimmed chaos soak (the cross-process kill-and-resume acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_trimmed():
+    """scripts/chaos_soak.py with the three pinned schedules: SIGKILL
+    mid-range-loop + resume fast-forward (ffwd > 0 asserted by the
+    harness), corrupt-on-write and corrupt-on-load — every schedule must
+    end bit-equal.  The full ≥20-schedule soak is the standalone CLI."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_soak.py"),
+         "--seed", "5", "--schedules", "3", "--rows", "1200",
+         "--chunks", "3"],
+        capture_output=True, text=True, timeout=570, cwd=REPO)
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    assert "killed+resumed(ffwd=1)" in p.stdout, p.stdout[-2000:]

@@ -67,6 +67,57 @@ class TestPadLadder:
         assert rel_gb._is_compiler_crash(e)
         assert not rel_gb._is_compiler_crash(RuntimeError("RESOURCE_EXHAUSTED"))
 
+    def test_probe_classifies_once_per_process(self, env1):
+        """The signature set comes from the per-process probe (primed at
+        env creation), not an inline literal: the cache is populated and
+        contains the platform-independent base shapes."""
+        from cylon_tpu.exec import recovery
+        sigs = recovery.compiler_crash_signatures()
+        assert recovery._CRASH_SIG_CACHE, "env creation did not prime probe"
+        assert set(recovery._BASE_CRASH_SIGS) <= set(sigs)
+        # probed again: same (cached) classification
+        assert recovery.compiler_crash_signatures() is \
+            recovery._CRASH_SIG_CACHE[0]
+
+    def test_ladder_engages_under_synthetic_signature_change(self,
+                                                             monkeypatch):
+        """VERDICT item 8: swap the platform's crash signature
+        (CYLON_TPU_CRASH_SIGS override — the same lever a new libtpu
+        wording would need) and prove the pad ladder STILL advances past
+        crashes carrying the new signature, while the old wording is now
+        correctly treated as a data error and propagates."""
+        from cylon_tpu.exec import recovery
+        monkeypatch.setenv("CYLON_TPU_CRASH_SIGS",
+                           "FLUX_COMPILE_UNIT_FAULT|helper exited 139")
+        assert recovery.is_compiler_crash(
+            RuntimeError("backend: FLUX_COMPILE_UNIT_FAULT at lane 7"))
+        assert not recovery.is_compiler_crash(
+            RuntimeError("tpu_compile_helper subprocess exit signal "
+                         "SIGSEGV"))
+        rel_gb._PAD_CACHE.clear()
+        calls = []
+
+        def crash_new():
+            calls.append("pad0")
+            raise RuntimeError("helper exited 139 compiling fused kernel")
+
+        assert rel_gb._pad_ladder(
+            ("sig", "synthetic"),
+            [("pad0", crash_new),
+             ("scatter", lambda: calls.append("scatter") or "ok")]) == "ok"
+        assert calls == ["pad0", "scatter"]
+        # the OLD signature no longer advances the ladder — it propagates
+        rel_gb._PAD_CACHE.clear()
+
+        def crash_old():
+            raise RuntimeError("tpu_compile_helper subprocess exit "
+                               "signal SIGSEGV (11)")
+
+        with pytest.raises(RuntimeError):
+            rel_gb._pad_ladder(("sig", "synthetic2"),
+                               [("pad0", crash_old),
+                                ("scatter", lambda: "ok")])
+
 
 class TestDenseSegmentParity:
     """The dense one-hot reduction (num_segments <= _DENSE_SEG_MAX) must

@@ -25,6 +25,76 @@ def _free_port() -> int:
 _CPU_MULTIPROCESS_UNSUPPORTED = False
 
 
+def _spawn_drivers(nproc, extra_env, timeout=570):
+    driver = os.path.join(os.path.dirname(__file__), "multihost_driver.py")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env)
+    procs = [subprocess.Popen(
+        [sys.executable, driver, str(i), str(nproc), coord],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(driver))))
+        for i in range(nproc)]
+    outs = []
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def _cpu_backend_unsupported(outs) -> bool:
+    return any("Multiprocess computations aren't implemented on the CPU "
+               "backend" in out for out in outs)
+
+
+def test_kill_rank0_and_resume(tmp_path):
+    """Durable checkpoint acceptance, two-process edition: launch 1 kills
+    rank 0 mid-range-loop (injected `kill` at ckpt.write — rank 1's
+    orphaned commit vote surfaces as a typed desync under the watchdog);
+    launch 2 resumes with CYLON_TPU_RESUME=1 and both ranks must
+    fast-forward past the committed pieces and converge on the IDENTICAL
+    manifest epoch and bit-equal result (asserted in-driver by
+    allgather)."""
+    global _CPU_MULTIPROCESS_UNSUPPORTED
+    if _CPU_MULTIPROCESS_UNSUPPORTED:
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+    base_env = {"CYLON_TPU_MH_SCENARIO": "kill_resume",
+                "CYLON_TPU_CKPT_DIR": str(tmp_path),
+                "CYLON_TPU_WATCHDOG_S": "30"}
+    procs, outs = _spawn_drivers(2, base_env)
+    if _cpu_backend_unsupported(outs):
+        _CPU_MULTIPROCESS_UNSUPPORTED = True
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+    # rank 0 must have died by SIGKILL mid-loop; rank 1 must NOT have
+    # silently completed (its commit partner vanished)
+    assert procs[0].returncode == -9, (procs[0].returncode, outs[0][-2000:])
+    assert "KILLRESUME_OK pid=1" not in outs[1], outs[1][-2000:]
+    procs2, outs2 = _spawn_drivers(2, {**base_env, "CYLON_TPU_RESUME": "1"})
+    for i, (p, out) in enumerate(zip(procs2, outs2)):
+        assert p.returncode == 0, f"resume proc {i} failed:\n{out[-4000:]}"
+        assert f"KILLRESUME_OK pid={i}" in out, out[-2000:]
+    # both ranks printed the same epoch (also asserted in-driver via
+    # allgather) and fast-forwarded at least one committed piece
+    import re
+    stats = [re.search(r"KILLRESUME_OK pid=\d+ epoch=(\d+) ffwd=(\d+)", o)
+             for o in outs2]
+    assert all(stats), outs2
+    assert stats[0].group(1) == stats[1].group(1), outs2
+    assert all(int(m.group(2)) > 0 for m in stats), outs2
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_join_groupby_sort(nproc):
     """2- and 4-process worlds (reference test_all.py runs mpirun -n {2,4});

@@ -146,6 +146,43 @@ class TestInjector:
         with pytest.raises(RankDesyncError):
             recovery.maybe_inject("exchange.stall")
 
+    def test_ckpt_sites_and_kinds_parse(self):
+        """The durable-checkpoint grammar extensions: ckpt.write /
+        ckpt.load sites, `corrupt` raises typed, `kill` parses (firing
+        it would SIGKILL this process — the chaos-soak harness and
+        tests/test_checkpoint.py exercise that in child processes)."""
+        from cylon_tpu.status import CheckpointCorruptError
+        recovery.install_faults("ckpt.load=corrupt")
+        with pytest.raises(CheckpointCorruptError):
+            recovery.maybe_inject("ckpt.load")
+        recovery.install_faults("ckpt.write:0:2=kill")
+        kind, armed = recovery.probe("ckpt.write")
+        assert (kind, armed) == (None, True)   # occurrence 1: armed only
+        assert recovery.probe("ckpt.write")[0] == "kill"
+
+    def test_install_faults_fully_resets_state(self):
+        """Regression (chaos-soak hygiene): re-installing a schedule
+        must clear the per-site occurrence counters AND the recorded
+        event log — otherwise iteration N+1's `nth` specs fire shifted
+        by iteration N's probe count and its report inherits stale
+        events."""
+        recovery.install_faults("groupby.device_oom::2=device_oom")
+        assert recovery.injected("groupby.device_oom") is None       # hit 1
+        assert recovery.injected("groupby.device_oom") == "device_oom"
+        # re-install: counters restart — the nth=2 spec must NOT fire at
+        # the first post-install occurrence (a stale counter would put
+        # the site at hit 3 and the spec would never fire again)
+        recovery.install_faults("groupby.device_oom::2=device_oom")
+        assert recovery.injected("groupby.device_oom") is None       # hit 1
+        assert recovery.injected("groupby.device_oom") == "device_oom"
+        # ... and the recorded event log is cleared as well
+        recovery.install_faults("groupby.device_oom::1=device_oom")
+        with pytest.raises(RuntimeError):
+            recovery.maybe_inject("groupby.device_oom")
+        assert len(recovery.recovery_events()) == 1
+        recovery.install_faults("groupby.device_oom::1=device_oom")
+        assert recovery.recovery_events() == []
+
 
 # ---------------------------------------------------------------------------
 # ladder branches (unit level)
@@ -363,6 +400,15 @@ class TestConsensusAndWatchdog:
     def test_guard_consensus_local(self, env4):
         assert recovery.guard_consensus(env4.mesh, True)
         assert not recovery.guard_consensus(env4.mesh, False)
+
+    def test_ckpt_commit_consensus_local(self, env4):
+        # single-controller: the local staged epoch IS the agreed epoch
+        # (no collective) — multiprocess divergence is exercised by the
+        # kill-resume scenario in tests/multihost_driver.py
+        assert recovery.ckpt_commit_consensus(env4.mesh, 3) == 3
+        assert recovery.ckpt_commit_consensus(None, 0) == 0
+        with pytest.raises(ValueError):
+            recovery.ckpt_commit_consensus(env4.mesh, 1 << 21)
 
     def test_watchdog_passthrough_when_off(self):
         assert recovery.exchange_watchdog("exchange.counts",

@@ -109,6 +109,41 @@ def test_ts106_scoped_to_operator_dirs():
         "cylon_tpu/parallel/other.py", src))
 
 
+def test_ts107_ckpt_artifact_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_ckpt_write.py"))
+        if f.rule == "TS107"]
+    # np.save, two opens of ckpt-named paths, np.load — the non-ckpt
+    # np.save stays clean
+    assert len(found) == 4
+    assert all("exec/checkpoint.py" in f.message for f in found)
+    # pickle.dump's args carry no ckpt name — not flagged itself (the
+    # enclosing open of the ckpt-named path is); nor is the non-ckpt
+    # np.save in fine_non_checkpoint_io
+    assert not any(f.line == 22 for f in found)
+    assert not any(f.line == 26 for f in found)
+
+
+def test_ts107_scoped_to_pipeline_and_relational():
+    # the identical write inside exec/checkpoint.py (the sanctioned
+    # module) or any other exec/ module is NOT flagged; relational/ and
+    # exec/pipeline.py are
+    src = ("import os\nimport numpy as np\n\n"
+           "def f(arr):\n"
+           "    ckpt_dir = os.environ['CYLON_TPU_CKPT_DIR']\n"
+           "    np.save(os.path.join(ckpt_dir, 'p.npy'), arr)\n")
+    assert ast_lint.lint_source("cylon_tpu/exec/checkpoint.py", src) == []
+    assert ast_lint.lint_source("cylon_tpu/exec/memory.py", src) == []
+    assert any(f.rule == "TS107" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", src))
+    assert any(f.rule == "TS107" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/other.py", src))
+    # non-checkpoint IO in those modules stays clean
+    clean = ("import numpy as np\n\ndef f(arr, path):\n"
+             "    np.save(path, arr)\n")
+    assert ast_lint.lint_source("cylon_tpu/exec/pipeline.py", clean) == []
+
+
 def test_suppression_silences_everything():
     assert ast_lint.lint_file(os.path.join(BAD, "suppressed.py")) == []
 
@@ -133,7 +168,7 @@ def test_package_lints_clean():
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
-                                       "TS105", "TS106"}
+                                       "TS105", "TS106", "TS107"}
 
 
 # ---------------------------------------------------------------------------
