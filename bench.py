@@ -51,9 +51,14 @@ BASELINE_ROWS_PER_SEC_PER_WORKER = 1_000_000 / 0.60
 
 
 def _sync(arr):
-    """Force execution and wait (see cylon_tpu.utils.host.sync_pull)."""
+    """Force execution and wait (see cylon_tpu.utils.host.sync_pull).
+    Under async profiling this is THE iteration-end block — its
+    ``bench.output_sync.block`` entry absorbs all device time the
+    dispatch-only phase markers enqueued and nothing else pulled."""
+    from cylon_tpu.utils import timing
     from cylon_tpu.utils.host import sync_pull
-    sync_pull(arr)
+    with timing.sync_region("bench.output_sync"):
+        sync_pull(arr)
 
 
 def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
@@ -157,6 +162,15 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
         config.TIMING_ASYNC = prev_async
     best = min(times)
     rows_per_sec_per_chip = (2 * n) / best / w
+    # dispatch/block attribution split (utils/timing.split_snapshot):
+    # under async profiling every plain region is host time to ENQUEUE
+    # its work and every ".block" twin (sync_region — the pipelined
+    # join's batched phase pull) is deliberate blocking time.  A phase
+    # whose dispatch AND block are both near zero has left the critical
+    # path — its device work hides under another phase's block point,
+    # which is how piece r+1's overlap with piece r's consume shows up.
+    snap = timing.snapshot()
+    dispatch_s, block_s = timing.split_snapshot(snap)
     return {
         "metric": ("dist join+groupby throughput (int64 keys"
                    + (f", skew={skew:g}" if skew else "") + ")"),
@@ -176,7 +190,16 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             "all_iters_s": [round(t, 4) for t in times],
             "timing_mode": "async" if timing_async else "block",
             "profiled_iter_s": round(profiled_s, 4),
-            "phases_s": {k: v["s"] for k, v in timing.snapshot().items()},
+            # dispatch-path config: which of the three ISSUE-6 rungs were
+            # active for this number (escape hatches: CYLON_TPU_PACKED_*,
+            # CYLON_TPU_DONATE, CYLON_TPU_PALLAS_PROBE)
+            "packed_pieces": config.PACKED_PIECES,
+            "packed_overlap": config.PACKED_OVERLAP,
+            "donate_buffers": config.DONATE_BUFFERS,
+            "pallas_probe": config.PALLAS_PROBE,
+            "phases_s": {k: v["s"] for k, v in snap.items()},
+            "phases_dispatch_s": dispatch_s,
+            "phases_block_s": block_s,
             # (site, kind, action) per recovery: was the number achieved
             # on the happy path or after degradation? (docs/robustness.md)
             "recovery_events": recovery.drain_events(),
@@ -184,7 +207,8 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # state — a throughput number with spill_events > 0 was
             # PCIe-assisted, not HBM-resident
             **{k: v for k, v in memory.stats().items() if k in
-               ("spill_events", "bytes_spilled", "peak_ledger_bytes")},
+               ("spill_events", "bytes_spilled", "peak_ledger_bytes",
+                "donated_bytes_reused")},
             # durable-checkpoint traffic (exec/checkpoint): a number with
             # checkpoint_events > 0 paid page writes in-loop; one with
             # resume_fast_forwarded_pieces > 0 restored committed pieces

@@ -36,7 +36,18 @@ function within the same module) — and flags:
   of operator state must go through the HBM ledger
   (:mod:`cylon_tpu.exec.memory`): an unaccounted upload skews every
   budget decision, and an unaccounted pull bypasses the spill tier's
-  eviction bookkeeping AND the ``utils.host`` transfer funnel.
+  eviction bookkeeping AND the ``utils.host`` transfer funnel;
+* **TS108** use-after-donate in ``relational/`` or ``exec/`` modules: a
+  name passed at a *statically known* ``donate_argnums`` position (a
+  ``jax.jit(..., donate_argnums=(...))`` wrapper, or a builder call
+  carrying a constant-tuple ``donate=``/``donate_argnums=`` keyword —
+  the ``(0,) if flag else ()`` conditional idiom counts) and then READ
+  after the donating call: XLA aliased the buffer into the program's
+  outputs, so the read observes freed or overwritten memory on device
+  (and raises "Array has been deleted" at host access).  Rebinding or
+  ``del`` clears the mark; donation flags whose positions are not
+  statically visible (a variable ``donate=donate``) are not tracked —
+  the rule under-approximates, like the rest of this pass.
 
 The pass is heuristic by design (a linter, not a verifier): it
 under-approximates taint (module-local call graph only) and exempts
@@ -78,6 +89,12 @@ _RESIDENCY_FUNCS = {"device_put", "device_get"}
 _CKPT_PIPELINE_FILE = "exec/pipeline.py"
 _CKPT_IO_LEAVES = {"save", "savez", "savez_compressed", "load",
                    "dump", "dumps", "loads"}
+
+#: directories whose modules donate buffers through jitted programs
+#: (TS108): the piece/join/sort builders and the pipelined range loop
+_DONATE_DIRS = ("relational", "exec")
+#: keyword names that declare donated positions on a builder/jit call
+_DONATE_KWS = {"donate", "donate_argnums"}
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -338,6 +355,7 @@ class _ModuleLint:
         self._check_oom_stringmatch()
         self._check_device_residency()
         self._check_ckpt_artifacts()
+        self._check_use_after_donate()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -500,6 +518,105 @@ class _ModuleLint:
                     "artifact has no hash and no commit epoch, so resume "
                     "could restore torn or rank-divergent state")
 
+    def _check_use_after_donate(self) -> None:
+        """TS108: a name passed at a statically-known donated position
+        and read after the donating call (see module docstring).  Scans
+        each function body in statement order: statement N's loads are
+        checked against donations recorded by statements < N, so the
+        donating call's own arguments never self-flag."""
+        parts = self.path.replace(os.sep, "/").split("/")
+        if not any(d in parts for d in _DONATE_DIRS):
+            return
+        for fn, _parents in self.funcs:
+            self._scan_donate_fn(fn)
+
+    def _scan_donate_fn(self, fn) -> None:
+        donating: dict[str, tuple] = {}   # callable name -> positions
+        donated: dict[str, int] = {}      # buffer name -> donating line
+
+        def mark_call_args(call: ast.Call, positions: tuple) -> None:
+            if any(isinstance(a, ast.Starred) for a in call.args):
+                return  # positions unresolvable past a *splat
+            for p in positions:
+                if p < len(call.args) and isinstance(call.args[p], ast.Name):
+                    donated.setdefault(call.args[p].id, call.lineno)
+
+        def stmt_bound(st) -> set:
+            bound: set[str] = set()
+            for node in ast.walk(st):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        bound |= _target_roots(tgt)
+                elif isinstance(node, (ast.AugAssign, ast.For)):
+                    bound |= _target_roots(node.target)
+                elif isinstance(node, ast.Delete):
+                    for tgt in node.targets:
+                        bound |= _target_roots(tgt)
+            return bound
+
+        for st in _linear_stmts(fn.body):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs scanned as their own functions
+            # 0. a COMPOUND statement that rebinds a donated name clears
+            # the mark BEFORE its loads are checked: a `for buf in ...`
+            # target binds before the body reads it, so flagging those
+            # reads would be a false positive — the read-then-rebind
+            # ordering inside one compound is not statically resolvable,
+            # and this pass under-approximates (never false-flags)
+            if not isinstance(st, (ast.Assign, ast.AugAssign, ast.Expr,
+                                   ast.Return, ast.Delete)):
+                for name in stmt_bound(st):
+                    donated.pop(name, None)
+            # 1. loads of already-donated names.  Metadata-only reads
+            # (`buf.shape`, `buf.dtype`, ... — _STATIC_ATTRS) are exempt
+            # like everywhere else in this pass: jax keeps the aval on a
+            # deleted Array, so they never touch the donated buffer.
+            meta_reads = {id(a.value) for a in ast.walk(st)
+                          if isinstance(a, ast.Attribute)
+                          and a.attr in _STATIC_ATTRS}
+            for node in ast.walk(st):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and id(node) not in meta_reads
+                        and node.id in donated):
+                    self._emit(
+                        "TS108", node,
+                        f"`{node.id}` read after being donated at line "
+                        f"{donated[node.id]} — donate_argnums aliased its "
+                        "buffer into the donating program's outputs, so "
+                        "this read observes freed/overwritten device "
+                        "memory (rebind or drop the name instead)")
+                    donated.pop(node.id, None)  # one finding per donation
+            # 2. donations performed by this statement
+            for node in ast.walk(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Call):
+                    # immediate apply: builder(..., donate=(..))(args)
+                    ipos = _donated_positions(node.func)
+                    if ipos is not None:
+                        mark_call_args(node, ipos)
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in donating):
+                    mark_call_args(node, donating[node.func.id])
+            for node in ast.walk(st):
+                if not isinstance(node, ast.Assign):
+                    continue
+                positions = (_donated_positions(node.value)
+                             if isinstance(node.value, ast.Call) else None)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if positions is not None:
+                        donating[tgt.id] = positions
+                    else:
+                        # rebound to a non-donating value: stale donate
+                        # positions must not flag the new callable's args
+                        donating.pop(tgt.id, None)
+            # 3. (re)bindings and dels clear the donated mark
+            for name in stmt_bound(st):
+                donated.pop(name, None)
+
     def _check_jit_sites(self) -> None:
         for node in ast.walk(self.tree):
             if not (isinstance(node, ast.Call)
@@ -525,6 +642,46 @@ class _ModuleLint:
                     f"param(s) {sorted(control_params)} drive Python "
                     "control flow — every call with a tracer there fails, "
                     "every distinct value retraces")
+
+
+def _donated_positions(call: ast.Call) -> tuple | None:
+    """Statically-known donated argument positions declared by a call: a
+    ``donate=``/``donate_argnums=`` keyword whose value is a non-empty
+    tuple/list of int constants (a single int counts; the
+    ``(0,) if flag else ()`` conditional idiom resolves to its body).
+    ``None`` when absent or not statically resolvable — those calls are
+    not tracked (TS108 under-approximates)."""
+    for kw in call.keywords:
+        if kw.arg not in _DONATE_KWS:
+            continue
+        val = kw.value
+        if isinstance(val, ast.IfExp):
+            val = val.body
+        elts = val.elts if isinstance(val, (ast.Tuple, ast.List)) else [val]
+        pos = []
+        for v in elts:
+            if (isinstance(v, ast.Constant) and isinstance(v.value, int)
+                    and not isinstance(v.value, bool)):
+                pos.append(v.value)
+            else:
+                return None
+        return tuple(pos) or None
+    return None
+
+
+def _linear_stmts(body: list):
+    """Top-level statements of a function body in source order.  Each
+    compound statement (if/loop/with/try) is processed as ONE unit by
+    the TS108 scan: its loads are checked against donations recorded by
+    *earlier* statements, then any donations inside it are recorded for
+    the statements after it.  A compound that REBINDS a donated name
+    (e.g. a for-loop target) clears the mark before its loads are
+    checked — the read-vs-rebind ordering inside one block is not
+    statically resolvable.  Donation→read sequences wholly inside one
+    compound block are therefore missed (under-approximation), but a
+    read can never be flagged against a donation that runs after it or
+    against a binding that shadows the donated buffer."""
+    return list(body)
 
 
 def _mentions_ckpt_path(node: ast.Call) -> bool:

@@ -37,6 +37,9 @@ RULES = {
     "TS107": "checkpoint artifact written outside exec/checkpoint.py "
              "(direct open/np.save/pickle of CYLON_TPU_CKPT_DIR paths "
              "bypasses the page-hash/two-phase-manifest protocol)",
+    "TS108": "use-after-donate: an array read after being passed through "
+             "a donate_argnums position in relational/ or exec/ (the "
+             "donating call invalidated its buffer)",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -119,6 +119,35 @@ TIMING_ASYNC = os.environ.get("CYLON_TPU_TIMING", "block") == "async"
 #: the equivalence reference; tests compare the two exactly).
 PACKED_PIECES = _env_flag("CYLON_TPU_PACKED_PIECES", True)
 
+#: Phase-overlapped piece scheduling (exec/pipeline.pipelined_join): the
+#: setup phases (build sort, range bounds, probe targets, probe sort)
+#: dispatch back-to-back with NO host sync between them — their host-side
+#: outputs resolve in ONE batched pull at a designated sync point — and
+#: per-piece phase work for piece r+1 dispatches while piece r is being
+#: consumed (typed faults raised while dispatching ahead are HELD and
+#: re-raised at the piece's consume point, so the recovery ladder sees
+#: the same consensus-coherent event order with overlap on or off).
+#: Off = the prior per-phase-sync dispatch behavior (escape hatch).
+PACKED_OVERLAP = _env_flag("CYLON_TPU_PACKED_OVERLAP", True)
+
+#: Donate per-piece scratch (phase-1 carry/payload buffers, splitter
+#: operands, the pipeline's dead sorted-table columns at pack time)
+#: through the jitted programs via donate_argnums, so the steady-state
+#: piece loop reuses buffers instead of re-allocating per piece.  The
+#: HBM ledger credits donated bytes against pack admission
+#: (exec/memory.ensure_headroom(reuse=)).  Results are bit-equal with
+#: donation on or off (tests/test_pipeline.py::TestPackedPieces).
+DONATE_BUFFERS = _env_flag("CYLON_TPU_DONATE", True)
+
+#: Route the pipelined join's phase-1 probe (per-row range assignment
+#: against the build side's key-group splitters) through the Pallas TPU
+#: kernel in ops/pallas_probe.py instead of the XLA (rows x splitters)
+#: comparison matrix.  Bit-equal by construction (same lexicographic
+#: algebra); interpreter fallback exercises the kernel on CPU rigs.
+#: Default off — opt in per run; eligibility (int-kind key operands,
+#: tile-aligned capacity) still gates per call site.
+PALLAS_PROBE = _env_flag("CYLON_TPU_PALLAS_PROBE", False)
+
 #: AOT pre-compile (lower().compile()) the per-piece join programs for
 #: every distinct piece-capacity pair BEFORE the range loop, so a
 #: mid-stream capacity change never stalls dispatch on a compile.  The

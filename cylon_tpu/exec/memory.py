@@ -425,12 +425,20 @@ def spillable_bytes() -> int:
 
 
 def ensure_headroom(env, need: int, scratch: int = 0,
-                    site: str = "spill.evict") -> None:
+                    site: str = "spill.evict", reuse: int = 0) -> None:
     """Admission control for a new resident allocation of ``need`` bytes
     (plus ``scratch`` transient working-set bytes — e.g. the piece
     join's sort-operand footprint, :func:`cylon_tpu.ops.pack.
     sort_operand_nbytes`): when the ledger would exceed the budget, cold
     spillable owners evict (LRU) first.
+
+    ``reuse``: bytes of caller-owned buffers DONATED into the allocating
+    program (``donate_argnums`` — docs/pipeline.md donation rules): XLA
+    frees/aliases them during the allocation, so peak demand is ``need -
+    reuse``, not ``need`` — counting both would double-charge donated
+    bytes and evict spillable owners that still fit.  Rank-uniform: the
+    donation decision is a config flag plus static shapes, identical on
+    every rank.
 
     Coherence protocol (docs/robustness.md "why eviction is
     collective"): what multiprocess ranks agree on is the eviction
@@ -449,9 +457,11 @@ def ensure_headroom(env, need: int, scratch: int = 0,
     kind, armed = recovery.probe(site)
     if kind in _RAISE_KINDS:
         raise recovery.make_fault(kind, site)
+    if reuse:
+        _STATS["donated_bytes_reused"] += int(reuse)
     if not _spill_enabled():
         return
-    need = int(need) + int(scratch)
+    need = max(int(need) + int(scratch) - int(reuse), 0)
     b = budget_bytes()
     import jax
     multi = jax.process_count() > 1
@@ -629,7 +639,8 @@ def upload_window(reg: Registration, starts, window: int):
 # ---------------------------------------------------------------------------
 
 _STATS = {"spill_events": 0, "bytes_spilled": 0,
-          "readmit_events": 0, "bytes_readmitted": 0}
+          "readmit_events": 0, "bytes_readmitted": 0,
+          "donated_bytes_reused": 0}
 
 #: owners in eviction order since the last reset — the multihost driver
 #: asserts this sequence is IDENTICAL across ranks
@@ -649,7 +660,9 @@ def _note_spill(site: str, reg: Registration) -> None:
 def stats() -> dict:
     """Spill counters for bench JSON detail (alongside recovery_events):
     ``spill_events``/``bytes_spilled`` (device→host evictions),
-    ``readmit_events``/``bytes_readmitted`` (host→device re-entries) and
+    ``readmit_events``/``bytes_readmitted`` (host→device re-entries),
+    ``donated_bytes_reused`` (admission credit for buffers donated into
+    the allocating program — bytes the ledger did NOT double-count) and
     ``peak_ledger_bytes`` (high-water resident balance)."""
     return dict(_STATS, peak_ledger_bytes=_LEDGER.peak,
                 ledger_bytes=_LEDGER.balance())

@@ -43,9 +43,24 @@ from ..relational.common import (PAD_L, REP, ROW, check_same_env,
 from ..relational.join import join_tables
 from ..relational.piece import PackedPiece, PieceSource  # noqa: F401
 from ..relational.repart import concat_tables, shuffle_table
-from ..status import InvalidError
+from ..status import CylonError, InvalidError
 
 shard_map = jax.shard_map
+
+
+def _norep_kwargs() -> dict:
+    """shard_map kwargs disabling replication checking — required when a
+    pallas_call is in the program (no replication rule on jax < 0.5; the
+    vma shim in ops/pallas_probe covers jax >= 0.5, whose flag is named
+    check_vma).  The program stays pure-local; the jaxpr gate still
+    asserts it contains no collective."""
+    import inspect
+    params = inspect.signature(shard_map).parameters
+    if "check_rep" in params:
+        return {"check_rep": False}
+    if "check_vma" in params:
+        return {"check_vma": False}
+    return {}
 
 
 @program_cache()
@@ -278,8 +293,14 @@ class GroupBySink:
 
     def _settle(self, pending) -> None:
         from ..relational.groupby import groupby_aggregate
+        from ..utils import timing
         h, chunk = pending
-        out = h.resolve()
+        with timing.sync_region("pipe.consume"):
+            # the per-piece host sync of the sink pipeline: its ".block"
+            # twin is where the dispatch/block split (bench.py,
+            # CYLON_TPU_TIMING=async) charges the device work that every
+            # dispatch-only pipe.* marker above it enqueued
+            out = h.resolve()
         if out is None:   # compile ladder exhausted mid-resolve
             # materialize FIRST: groupby_aggregate would otherwise retry
             # the identical (crash-exhausted, uncached) pushdown ladder
@@ -349,15 +370,19 @@ class GroupBySink:
 # range-partitioned pipelined join
 # ---------------------------------------------------------------------------
 
+def _key_op_kinds(dtypes: tuple, need_nf: tuple, narrow: tuple) -> tuple:
+    """Static operand KIND tuple of pack.key_operands for this key
+    structure — derived next to the packing rules it mirrors
+    (ops/pack.key_operand_kinds, the single source of truth); the
+    Pallas probe's eligibility gate reads it."""
+    from ..ops.pack import key_operand_kinds
+    return key_operand_kinds(dtypes, need_nf, narrow)
+
+
 def _n_key_ops(dtypes: tuple, need_nf: tuple, narrow: tuple) -> int:
     """Static operand count of pack.key_operands for this key structure
     (liveness flag + per-column null flag + 1 or 2 value lanes)."""
-    n = 1
-    for dt, nf, nw in zip(dtypes, need_nf, narrow):
-        n += int(bool(nf))
-        d = np.dtype(dt)
-        n += 2 if (d.kind in "iu" and d.itemsize == 8 and not nw) else 1
-    return n
+    return len(_key_op_kinds(dtypes, need_nf, narrow))
 
 
 @program_cache()
@@ -402,11 +427,19 @@ def _range_bounds_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
 
 @program_cache()
 def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
-                      need_nf: tuple, n_ops: int):
+                      need_nf: tuple, n_ops: int, donate: bool = False,
+                      use_pallas: bool = False):
     """Per-row range id for the probe side: count of splitters <= row key
     (>= because splitters are group STARTS of the sorted build).  Dead rows
     get id R so a stable sort by id puts them last.  Also returns per-shard
-    per-range live counts."""
+    per-range live counts.
+
+    ``use_pallas`` routes the splitter probe through the Pallas kernel
+    (ops/pallas_probe — splitters resident in SMEM, rows streamed in
+    tiles; no (rows, splitters) comparison matrix in HBM); bit-equal to
+    the XLA path by construction.  ``donate`` donates the splitter
+    operand args (positions 3..3+n_ops) — their only consumer is this
+    program, so the steady-state loop reuses their buffers."""
     from ..ops import pack
 
     def per_shard(vc, by_datas, by_valids, *sops):
@@ -417,17 +450,77 @@ def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
         ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask,
                                pad_key=PAD_L, need_null_flags=need_nf,
                                narrow32=narrow)
-        ge = pack.rows_ge_splitters(ko, tuple(sops))
-        # pinned accumulator: jnp.sum(bool) defaults to int64 under x64 —
-        # a row-scale widening the jaxpr pass (JX203) flags
-        tgt = jnp.sum(ge, axis=1, dtype=jnp.int32)
+        if use_pallas:
+            from ..ops import pallas_probe
+            tgt = pallas_probe.count_ge_splitters(ko.ops, tuple(sops))
+        else:
+            ge = pack.rows_ge_splitters(ko, tuple(sops))
+            # pinned accumulator: jnp.sum(bool) defaults to int64 under
+            # x64 — a row-scale widening the jaxpr pass (JX203) flags
+            tgt = jnp.sum(ge, axis=1, dtype=jnp.int32)
         tgt = jnp.where(mask, tgt, jnp.int32(n_ranges))
         counts = jnp.zeros(n_ranges + 1, jnp.int32).at[tgt].add(1)
         return tgt, counts[:n_ranges]
 
     in_specs = (REP, ROW, ROW) + (ROW,) * n_ops
+    sm_kwargs = _norep_kwargs() if use_pallas else {}
+    jit_kwargs = {"donate_argnums": tuple(range(3, 3 + n_ops))} \
+        if donate else {}
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                             out_specs=(ROW, ROW)))
+                             out_specs=(ROW, ROW), **sm_kwargs),
+                   **jit_kwargs)
+
+
+def _pull_phase_outputs(devs: list):
+    """ONE batched pull of the deferred setup-phase outputs (range
+    boundaries + per-range probe counts) — the overlap scheduler's
+    designated pre-loop sync point.  Every rank reaches it at the same
+    program position (right after the probe-sort dispatch), so a fault
+    raised by any deferred phase surfaces HERE, classified onto the
+    typed taxonomy, never inside an arbitrary later sync.  The
+    ``pipe.phase_sync`` injector site makes that contract testable on
+    the CPU rig (tests/test_recovery.py)."""
+    from ..utils.host import host_arrays
+    from .recovery import maybe_inject
+    maybe_inject("pipe.phase_sync")
+    try:
+        return host_arrays(devs)
+    except Exception as e:  # noqa: BLE001 — re-raise typed when classifiable
+        from .recovery import classify
+        fault = classify(e)
+        if fault is None:
+            raise
+        raise fault from e
+
+
+class _PieceFuture:
+    """One range piece's phase work (packed window descriptors; the
+    seed's materialized windows; for spilled sources the async window
+    uploads) dispatched AHEAD of its consumption.  A typed fault raised
+    while dispatching ahead (piece-cap overflow, injected spill
+    pressure) is HELD and re-raised when the piece is CONSUMED — the
+    identical consensus-coherent point the non-overlapped schedule
+    raises at, so the recovery ladder takes the same rung at the same
+    piece with overlap on or off.  Foreign (non-taxonomy) exceptions
+    raise immediately: deferring an unclassified error would detach it
+    from its dispatch context."""
+
+    __slots__ = ("_pieces", "_fault")
+
+    def __init__(self, thunk, defer_faults: bool = True):
+        self._pieces = self._fault = None
+        if not defer_faults:
+            self._pieces = thunk()
+            return
+        try:
+            self._pieces = thunk()
+        except CylonError as e:
+            self._fault = e
+
+    def get(self):
+        if self._fault is not None:
+            raise self._fault
+        return self._pieces
 
 
 def pipelined_join(left: Table, right: Table, left_on, right_on,
@@ -505,8 +598,26 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
 
     from ..relational.sort import local_sort_table
     from ..utils import timing
+    # Phase-overlapped scheduling (CYLON_TPU_PACKED_OVERLAP, docs/
+    # pipeline.md): the setup phases below — build sort, range bounds,
+    # probe targets, probe sort — chain purely on device arrays; nothing
+    # between them needs a host value.  With overlap on, each phase is a
+    # plain async dispatch and the two host-side sidecars (range
+    # boundaries, per-range probe counts) stay ON DEVICE until the one
+    # designated sync point after the probe-sort dispatch, where a single
+    # batched pull resolves both — the DeferredTable counts-on-device
+    # trick (PR 2's join count phase) generalized to every setup phase.
+    # Off restores the prior pull-per-phase dispatch behavior.
+    overlap = config.PACKED_OVERLAP
+    donate = config.DONATE_BUFFERS
+    # The phase-1 sorts may donate their input buffers ONLY when those
+    # buffers are fresh shuffle outputs this function exclusively owns
+    # (world > 1).  At world == 1 lwork/rwork are with_columns views
+    # SHARING buffers with the caller's tables — donating them would
+    # invalidate user data (use-after-donate, lint rule TS108).
+    donate_sort = donate and env.world_size > 1
     with timing.region("pipe.build_sort"):
-        rsorted = local_sort_table(rwork, right_on)
+        rsorted = local_sort_table(rwork, right_on, donate=donate_sort)
         # hash shuffle above co-located equal keys; the per-shard sort
         # makes them contiguous — together that is grouped_by's contract
         rsorted.grouped_by = tuple(right_on)
@@ -520,8 +631,9 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                     for a, b in zip(l_keys, r_keys))
     from ..relational.common import narrow32_flags
     narrow = narrow32_flags(l_keys, r_keys)
-    n_ops = _n_key_ops(tuple(str(c.data.dtype) for c in r_keys), need_nf,
-                       narrow)
+    key_dtypes = tuple(str(c.data.dtype) for c in r_keys)
+    op_kinds = _key_op_kinds(key_dtypes, need_nf, narrow)
+    n_ops = len(op_kinds)
 
     from ..relational.common import col_arrays
     from ..utils.host import host_array
@@ -530,19 +642,28 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     with timing.region("pipe.bounds"):
         res = _range_bounds_fn(env.mesh, n_ranges, narrow, need_nf, n_ops)(
             vcr, r_datas, r_valids)
-        b = host_array(res[0]).reshape(w, n_ranges - 1).astype(np.int64)
+        b_dev = res[0]
+        if not overlap:
+            b_host = host_array(b_dev)
     sops = res[1:]
-    n_r = vcr.astype(np.int64)
-    bb = np.concatenate([np.zeros((w, 1), np.int64), b, n_r[:, None]], axis=1)
-    r_starts = bb[:, :-1]
-    r_lens = np.diff(bb, axis=1)
 
     l_datas, l_valids = col_arrays(l_keys)
     vcl = np.asarray(lwork.valid_counts, np.int32)
+    use_pallas = False
+    if config.PALLAS_PROBE:
+        from ..ops import pallas_probe
+        use_pallas = pallas_probe.supported(lwork.capacity, n_ranges - 1,
+                                            op_kinds)
     with timing.region("pipe.targets"):
+        # sops' only consumer — donated so the loop's steady state reuses
+        # their buffers instead of re-allocating per query
         tgt, pc_flat = _probe_targets_fn(env.mesh, n_ranges, narrow, need_nf,
-                                         n_ops)(vcl, l_datas, l_valids, *sops)
-        pcounts = host_array(pc_flat).reshape(w, n_ranges).astype(np.int64)
+                                         n_ops, donate=donate,
+                                         use_pallas=use_pallas)(
+            vcl, l_datas, l_valids, *sops)
+        if not overlap:
+            pc_host = host_array(pc_flat)
+    del sops
 
     from ..core.dtypes import LogicalType
     tmp = "__range__"
@@ -552,9 +673,25 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
         {tmp: Column(tgt, LogicalType.INT32, None, bounds=(0, n_ranges))})
     del lwork, tgt
     with timing.region("pipe.probe_sort"):
-        lsorted = local_sort_table(ltab, [tmp])
+        # ltab's buffers (fresh shuffle outputs + the fresh range column)
+        # are last read here — donated, the sorted output reuses them
+        del l_datas, l_valids, l_keys
+        lsorted = local_sort_table(ltab, [tmp], donate=donate_sort)
         timing.maybe_block(next(iter(lsorted.columns.values())).data)
     del ltab
+
+    if overlap:
+        # THE pre-loop host sync: every setup phase above was dispatched
+        # with no intervening pull, so the device executes them as one
+        # uninterrupted stream while the host raced ahead to here.
+        with timing.sync_region("pipe.phase_sync"):
+            b_host, pc_host = _pull_phase_outputs([b_dev, pc_flat])
+    b = np.asarray(b_host).reshape(w, n_ranges - 1).astype(np.int64)
+    pcounts = np.asarray(pc_host).reshape(w, n_ranges).astype(np.int64)
+    n_r = vcr.astype(np.int64)
+    bb = np.concatenate([np.zeros((w, 1), np.int64), b, n_r[:, None]], axis=1)
+    r_starts = bb[:, :-1]
+    r_lens = np.diff(bb, axis=1)
     l_starts = np.concatenate([np.zeros((w, 1), np.int64),
                                np.cumsum(pcounts, axis=1)], axis=1)[:, :-1]
 
@@ -572,12 +709,16 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     # (collectively — docs/robustness.md) before the pack allocates
     from ..ops.pack import sort_operand_nbytes
     scratch = sort_operand_nbytes(
-        tuple(str(c.data.dtype) for c in r_keys), need_nf, narrow,
-        (max(caps_l) + max(caps_r)) * w)
+        key_dtypes, need_nf, narrow, (max(caps_l) + max(caps_r)) * w)
     with timing.region("pipe.pack"):
+        # the sorted tables are exclusively owned here (fresh sort
+        # outputs, deleted right below) — donate their columns into the
+        # pack programs so the lane matrices reuse those buffers, with
+        # the ledger crediting the reuse (exec/memory, docs/pipeline.md)
         src_l = PieceSource(lsorted, max(caps_l), drop=(tmp,),
-                            scratch_bytes=scratch)
-        src_r = PieceSource(rsorted, max(caps_r), scratch_bytes=scratch)
+                            scratch_bytes=scratch, donate=donate)
+        src_r = PieceSource(rsorted, max(caps_r), scratch_bytes=scratch,
+                            donate=donate)
         timing.maybe_block(src_r.arrs)
     del lsorted, rsorted
 
@@ -696,14 +837,21 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                     + caps_r[r] * memory.spec_row_bytes(src_r.spec))
         return memory.prefetch_depth(pair) > 1
 
-    nxt = make_pieces(live_ranges[start]) if live_ranges[start:] else None
+    def piece_future(r):
+        # with overlap on, a typed fault raised while dispatching piece
+        # r's phases ahead of time is held and re-raised at r's consume
+        # point (_PieceFuture) — the recovery ladder sees the identical
+        # escalation order as the non-overlapped schedule
+        return _PieceFuture(lambda: make_pieces(r), defer_faults=overlap)
+
+    nxt = piece_future(live_ranges[start]) if live_ranges[start:] else None
     for i in range(start, len(live_ranges)):
-        piece_l, piece_r = nxt
+        piece_l, piece_r = nxt.get()
         nxt = None
         if i + 1 < len(live_ranges) and _prefetch_ok(live_ranges[i + 1]):
             # async upload dispatch for piece r+1 (spilled sources) —
             # overlaps the join compute of piece r below
-            nxt = make_pieces(live_ranges[i + 1])
+            nxt = piece_future(live_ranges[i + 1])
         with timing.region("pipe.piece_join"):
             # packed pieces: slice + key unpack are fused into this
             # dispatch; with a sink the counts stay on device, so piece
@@ -722,7 +870,9 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             stage.save_piece(i, res_r)
         outs.append(out_r)
         if nxt is None and i + 1 < len(live_ranges):
-            nxt = make_pieces(live_ranges[i + 1])
+            # piece r+1's phase dispatch overlaps piece r's in-flight
+            # consumption (the sink's pending pull / deferred counts)
+            nxt = piece_future(live_ranges[i + 1])
     if not outs:
         # no range qualified (e.g. inner join, no overlapping keys at all):
         # one empty piece pair keeps the output schema path uniform
@@ -784,6 +934,24 @@ def _trace_probe_targets(mesh):
                               *sops)
 
 
+def _trace_probe_targets_pallas(mesh):
+    """The ``CYLON_TPU_PALLAS_PROBE`` dispatch variant: identical
+    contract, the splitter probe routed through the Pallas kernel
+    (ops/pallas_probe).  Still a pure-local program — the jaxpr walk
+    recurses into the pallas_call body, so a collective smuggled into
+    the kernel would be a JX205 finding like anywhere else."""
+    w = int(mesh.devices.size)
+    S = jax.ShapeDtypeStruct
+    n_ranges = 4
+    n_ops = _n_key_ops(("int32",), (False,), (False,))
+    fn = _unwrap(_probe_targets_fn(mesh, n_ranges, (False,), (False,),
+                                   n_ops, use_pallas=True))
+    vc = S((w,), np.int32)
+    sops = tuple(S((w * (n_ranges - 1),), np.int32) for _ in range(n_ops))
+    return jax.make_jaxpr(fn)(vc, (S((w * 1024,), np.int32),), (None,),
+                              *sops)
+
+
 from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
 
 declare_builder(f"{__name__}._chunk_fn", _trace_chunk, tags=("pipeline",))
@@ -791,3 +959,5 @@ declare_builder(f"{__name__}._range_bounds_fn", _trace_range_bounds,
                 tags=("pipeline",))
 declare_builder(f"{__name__}._probe_targets_fn", _trace_probe_targets,
                 tags=("pipeline",))
+declare_builder(f"{__name__}._probe_targets_fn[pallas]",
+                _trace_probe_targets_pallas, tags=("pipeline",))

@@ -85,9 +85,13 @@ shard_map = jax.shard_map
 #: wraps the page write + manifest commit of one piece, ``ckpt.load``
 #: the resume-path restore — kind ``corrupt`` there corrupts (or
 #: simulates detecting a corrupted) page instead of raising.
+#: ``pipe.phase_sync`` is the overlap scheduler's designated pre-loop
+#: batched pull (exec/pipeline._pull_phase_outputs) — injecting there
+#: proves deferred-phase faults surface typed at the consensus-coherent
+#: sync point, not inside an arbitrary later pull.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
-         "ckpt.write", "ckpt.load")
+         "ckpt.write", "ckpt.load", "pipe.phase_sync")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill

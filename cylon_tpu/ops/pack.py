@@ -182,6 +182,33 @@ def key_operands(datas, validities=None, row_mask=None, descendings=None,
     return KeyOps(tuple(ops), tuple(kinds))
 
 
+def key_operand_kinds(dtypes, need_null_flags, narrow32) -> tuple:
+    """Static operand KIND tuple that :func:`key_operands` (with a
+    ``row_mask``, ascending keys) produces for this key structure —
+    liveness flag, then per column an optional null flag plus the value
+    operand kind(s).  This is :func:`_sort_value`'s packing rules in
+    dtype space only (no arrays built): keep the two in lockstep — the
+    Pallas probe's eligibility gate and exec/pipeline's static operand
+    counts both read this."""
+    kinds = ["i"]
+    for dt, nf, nrw in zip(dtypes, need_null_flags, narrow32):
+        if nf:
+            kinds.append("i")
+        d = np.dtype(dt)
+        if d.kind == "b":
+            kinds.append("i")
+        elif d.kind in "iu":
+            # wide 64-bit values split into a native (hi, lo) lane pair
+            kinds.extend(("i",) if (d.itemsize <= 4 or nrw) else ("i", "i"))
+        elif d.kind == "f":
+            # f32 sorts via the order-preserving uint32 bitcast ('i');
+            # f64 keeps native NaN-aware float compares ('f')
+            kinds.append("i" if d.itemsize <= 4 else "f")
+        else:
+            raise TypeError(f"unsortable dtype {dt}")
+    return tuple(kinds)
+
+
 def concat_keyops(a: KeyOps, b: KeyOps) -> KeyOps:
     assert a.kinds == b.kinds
     return KeyOps(tuple(jnp.concatenate([x, y]) for x, y in zip(a.ops, b.ops)),

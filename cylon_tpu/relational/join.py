@@ -605,13 +605,24 @@ def _packed_materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
                            cap_r: int, plan: tuple,
                            lspec: lanes.LaneSpec, rspec: lanes.LaneSpec,
                            n_arrs_l: int, n_arrs_r: int,
-                           carry_emit: bool, carry_match: bool):
+                           carry_emit: bool, carry_match: bool,
+                           donate: tuple = ()):
     """Phase 2 over packed windows.  Carried sides unpack from the sorted
     payload lanes exactly like :func:`_materialize_fn`; non-carried sides
     gather whole rows from the WINDOW lane matrix (one (out, L) gather —
     the matrix already exists, so there is no pack step) and unpack only
     at the output rows.  f64 side columns slice their window and gather by
-    take index (carry-LITE, same as the monolith)."""
+    take index (carry-LITE, same as the monolith).
+
+    ``donate``: argnums of per-piece phase-1 state this FINAL dispatch
+    consumes — ``(0,)`` the carry tuple, ``(0, 1)`` carry + sorted
+    payload lanes — so the steady-state loop reuses those buffers for
+    the output instead of allocating fresh ones (docs/pipeline.md
+    donation rules).  Never includes the window arrays (positions 4+):
+    they are the packed SOURCE, shared by every remaining piece — a
+    use-after-donate (lint rule TS108).  Callers donate only on the last
+    dispatch over the state: the speculative-capacity dispatch and any
+    fused consumer sharing the state via JoinState must not donate."""
 
     l_f64 = any(not c.lanes for c in lspec.cols)
     r_f64 = any(not c.lanes for c in rspec.cols)
@@ -681,8 +692,9 @@ def _packed_materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
         return _plan_outputs(plan, ldat, lval, l_ok, rdat, rval, r_ok)
 
     in_specs = (ROW, ROW, REP, REP) + (ROW,) * (n_arrs_l + n_arrs_r)
+    jit_kwargs = {"donate_argnums": tuple(donate)} if donate else {}
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
-                             out_specs=(ROW, ROW)))
+                             out_specs=(ROW, ROW)), **jit_kwargs)
 
 
 def _fits32_meta(dtype, bounds) -> bool:
@@ -885,9 +897,13 @@ def _join_packed_impl(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
             with timing.region("join.materialize"):
                 carry = _carry_fn(env.mesh, how, cap_l, cap_r, all_live)(
                     vcl, vcr, idx_s_s, bnd_s)
+                # donate the freshly built carry (exclusively owned here)
+                # but NOT pl_s — the JoinState shares those lanes with any
+                # fused consumer that drains the deferred state (TS108)
                 mfn = _packed_materialize_fn(
                     env.mesh, how, out_cap, cap_l, cap_r, plan, pl.spec,
-                    pr.spec, len(pl.arrs), len(pr.arrs), True, True)
+                    pr.spec, len(pl.arrs), len(pr.arrs), True, True,
+                    donate=(0,) if config.DONATE_BUFFERS else ())
                 out_d, out_v = mfn(carry, pl_s, pl.starts, pr.starts,
                                    *pl.arrs, *pr.arrs)
             return {nme: Column(d, t, v, dc, bounds=b)
@@ -919,11 +935,17 @@ def _join_packed_impl(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
     predicted = _CAP_CACHE.get(cache_key)
     mat_args = (carry, pl_s, pl.starts, pr.starts) + pl.arrs + pr.arrs
 
-    def mat_fn(cap):
+    def mat_fn(cap, donate=()):
         return _packed_materialize_fn(
             env.mesh, how, cap, cap_l, cap_r, plan, pl.spec, pr.spec,
-            len(pl.arrs), len(pr.arrs), carry_emit, carry_match)
+            len(pl.arrs), len(pr.arrs), carry_emit, carry_match,
+            donate=donate)
 
+    # phase-1 state (carry + sorted payload lanes) dies with this piece:
+    # its LAST materialize dispatch donates it so the output reuses the
+    # buffers.  The speculative dispatch below must NOT donate — a
+    # capacity miss re-dispatches over the same state (TS108)
+    final_donate = (0, 1) if config.DONATE_BUFFERS else ()
     with timing.region("join.materialize"):
         out_d = out_v = None
         if predicted is not None:
@@ -934,7 +956,7 @@ def _join_packed_impl(pl: PackedPiece, pr: PackedPiece, left_on, right_on,
         out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
         _CAP_CACHE.put(cache_key, out_cap)
         if out_d is None or out_cap > predicted:
-            out_d, out_v = mat_fn(out_cap)(*mat_args)
+            out_d, out_v = mat_fn(out_cap, donate=final_donate)(*mat_args)
     out = build_table(names, out_d, out_v, types, dicts, counts, env,
                       bounds=bounds)
     if coalesce:

@@ -54,7 +54,15 @@ shard_map = jax.shard_map
 
 
 @program_cache()
-def _piece_pack_fn(mesh: Mesh, spec, pad: int):
+def _piece_pack_fn(mesh: Mesh, spec, pad: int, donate: bool = False):
+    """Laneless (f64) columns pass ``None`` data — :func:`cylon_tpu.ops.
+    lanes.pack_lanes` reads only their validity, and a dead donated
+    buffer would otherwise be invalidated while :func:`_pad_rows_fn`
+    still needs it (use-after-donate, lint rule TS108).  ``donate``
+    consumes the caller's column buffers: the pack is their last reader
+    (the pipeline deletes the sorted table right after), so XLA may
+    free/reuse them DURING the pack instead of holding input + matrix
+    live together."""
     from ..ops import lanes
 
     def per_shard(datas, valids):
@@ -64,17 +72,19 @@ def _piece_pack_fn(mesh: Mesh, spec, pad: int):
                 [mat, jnp.zeros((pad, mat.shape[1]), mat.dtype)])
         return mat
 
+    jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW, ROW),
-                             out_specs=ROW))
+                             out_specs=ROW), **jit_kwargs)
 
 
 @program_cache()
-def _pad_rows_fn(mesh: Mesh, pad: int):
+def _pad_rows_fn(mesh: Mesh, pad: int, donate: bool = False):
     def per_shard(d):
         return jnp.concatenate([d, jnp.zeros((pad,), d.dtype)]) if pad else d
 
+    jit_kwargs = {"donate_argnums": (0,)} if donate else {}
     return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
-                             out_specs=ROW))
+                             out_specs=ROW), **jit_kwargs)
 
 
 @program_cache()
@@ -189,7 +199,7 @@ class PieceSource:
     decision — the piece-cap-sizing consult of the ledger."""
 
     def __init__(self, table: Table, pad: int, drop: tuple = (),
-                 scratch_bytes: int = 0):
+                 scratch_bytes: int = 0, donate: bool = False):
         from ..exec import memory
         from .common import table_lane_spec
         self.env = table.env
@@ -204,17 +214,37 @@ class PieceSource:
         mesh = self.env.mesh
         w = self.env.world_size
         rows = w * (table.capacity + int(pad))
+        # laneless (f64) columns contribute no data lane: their data rides
+        # the side-array path (_pad_rows_fn) and must NOT enter the pack
+        # program at all — under donation, a dead donated buffer would be
+        # invalidated before _pad_rows_fn reads it (TS108)
+        lane_datas = tuple(c.data if cl.lanes else None
+                           for c, cl in zip(cols, self.spec.cols))
+        valids = tuple(c.validity for c in cols)
+        reuse = 0
+        if donate:
+            # donated column buffers are consumed by the pack programs —
+            # the ledger must not count them AND the matrices they become
+            # as simultaneous peak (docs/pipeline.md donation rules).
+            # Count exactly what is donated: lane data + validity through
+            # the pack program (only built when lanes exist), f64 side
+            # data through the pad program.
+            donated = list(c.data for c, cl in zip(cols, self.spec.cols)
+                           if not cl.lanes)
+            if self.spec.n_lanes:
+                donated += [a for a in (*lane_datas, *valids)
+                            if a is not None]
+            reuse = sum(int(a.nbytes) for a in donated)
         memory.ensure_headroom(
             self.env, rows * memory.spec_row_bytes(self.spec),
-            scratch=int(scratch_bytes))
+            scratch=int(scratch_bytes), reuse=reuse)
         arrs = []
         if self.spec.n_lanes:
-            arrs.append(_piece_pack_fn(mesh, self.spec, pad)(
-                tuple(c.data for c in cols),
-                tuple(c.validity for c in cols)))
+            arrs.append(_piece_pack_fn(mesh, self.spec, pad, donate)(
+                lane_datas, valids))
         for c, cl in zip(cols, self.spec.cols):
             if not cl.lanes:
-                arrs.append(_pad_rows_fn(mesh, pad)(c.data))
+                arrs.append(_pad_rows_fn(mesh, pad, donate)(c.data))
         self._reg = memory.register("piece_src", tuple(arrs),
                                     spillable=True,
                                     sharding=self.env.sharding(),
@@ -275,7 +305,9 @@ def _trace_piece_pack(mesh):
     cap, S = 1024, _jax.ShapeDtypeStruct
     spec = _decl_spec()
     fn = _unwrap(_piece_pack_fn(mesh, spec, 8))
-    datas = (S((w * cap,), np.int32), S((w * cap,), np.float64))
+    # laneless (f64) data never enters the pack program (None leaf —
+    # its buffer rides _pad_rows_fn and may be donated there, TS108)
+    datas = (S((w * cap,), np.int32), None)
     valids = (S((w * cap,), np.bool_), None)
     return _jax.make_jaxpr(fn)(datas, valids)
 

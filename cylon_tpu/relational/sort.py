@@ -59,14 +59,26 @@ def _norm_dirs(by, ascending):
 
 @program_cache()
 def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
-                   narrow: tuple, vspec, f64_idx: tuple = ()):
+                   narrow: tuple, vspec, f64_idx: tuple = (),
+                   by_idx: tuple = (0,), donate: bool = False):
     """Per-shard multi-key sort.  Laneable columns RIDE THE SORT as u32
     payload lanes (~1.7 ns/row/lane measured) via ``vspec`` (a LaneSpec
     over the full column list, f64 columns planned laneless); f64 columns
-    (positions ``f64_idx``) are gathered once at the stable permutation."""
+    (positions ``f64_idx``) are gathered once at the stable permutation.
+
+    Key columns are selected from ``datas``/``valids`` by the static
+    ``by_idx`` positions rather than passed as separate operands: a key
+    buffer must enter the program exactly ONCE for ``donate`` to be
+    sound (donating one of two aliases of a buffer is a use-after-donate
+    — lint rule TS108).  ``donate`` consumes the caller's column buffers
+    (the pipeline's phase-1 sorts, whose inputs are exclusively owned
+    fresh shuffle outputs): XLA reuses them for the sorted output
+    instead of holding input + output live together."""
     from ..ops import lanes
 
-    def per_shard(vc, by_datas, by_valids, datas, valids):
+    def per_shard(vc, datas, valids):
+        by_datas = [datas[i] for i in by_idx]
+        by_valids = [valids[i] for i in by_idx]
         cap = by_datas[0].shape[0]
         mask = live_mask(vc, cap)
         ko = pack.key_operands(list(by_datas), list(by_valids), row_mask=mask,
@@ -98,9 +110,10 @@ def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
                 out_d[i] = datas[i][perm]
         return tuple(out_d), tuple(out_v)
 
+    jit_kwargs = {"donate_argnums": (1, 2)} if donate else {}
     return jax.jit(shard_map(per_shard, mesh=mesh,
-                             in_specs=(REP, ROW, ROW, ROW, ROW),
-                             out_specs=(ROW, ROW)))
+                             in_specs=(REP, ROW, ROW),
+                             out_specs=(ROW, ROW)), **jit_kwargs)
 
 
 @program_cache()
@@ -367,7 +380,8 @@ def sort_table(table: Table, by, ascending=True,
 
 
 def local_sort_table(table: Table, by, ascending=True,
-                     nulls_position: str = "last") -> Table:
+                     nulls_position: str = "last",
+                     donate: bool = False) -> Table:
     """Per-shard local sort by ``by`` — no exchange: each shard's rows are
     reordered in place (the reference's local ``Sort``,
     arrow_kernels.hpp:121).  Used by :func:`sort_table` after its range
@@ -377,15 +391,27 @@ def local_sort_table(table: Table, by, ascending=True,
     total order (range partitioning for equality joins) sort by the codes.
 
     Column bounds survive (the sort permutes the full padded row set, so
-    each column's value multiset is unchanged)."""
+    each column's value multiset is unchanged).
+
+    ``donate=True`` donates the table's column buffers into the sort
+    program (docs/pipeline.md donation rules): the caller must own them
+    EXCLUSIVELY — no other Table, Column or pending dispatch may alias
+    them (the pipelined join donates only its fresh shuffle outputs, and
+    only at ``world_size > 1``, where the shuffle guarantees freshness;
+    a ``with_columns`` view of a user table shares buffers and must
+    never be donated)."""
     env = table.env
     by = [by] if isinstance(by, str) else list(by)
     descendings = _norm_dirs(by, ascending)
     npos = pack.NULL_FIRST if nulls_position == "first" else pack.NULL_LAST
     by_cols = [table.column(n) for n in by]
-    by_datas, by_valids = col_arrays(by_cols)
     vc = np.asarray(table.valid_counts, np.int32)
     items = list(table.columns.items())
+    names = [n for n, _ in items]
+    # key columns ride inside datas/valids, selected by static position:
+    # passing them as separate operands would alias each key buffer into
+    # the program twice — unsound under donation (TS108)
+    by_idx = tuple(names.index(n) for n in by)
     datas = tuple(c.data for _, c in items)
     valids = tuple(c.validity for _, c in items)
     from .common import table_lane_spec
@@ -393,8 +419,8 @@ def local_sort_table(table: Table, by, ascending=True,
     vspec = table_lane_spec([c for _, c in items])
     f64_idx = tuple(i for i, c in enumerate(vspec.cols) if not c.lanes)
     out_d, out_v = _local_sort_fn(env.mesh, descendings, npos, narrow,
-                                  vspec, f64_idx)(
-        vc, by_datas, by_valids, datas, valids)
+                                  vspec, f64_idx, by_idx, donate)(
+        vc, datas, valids)
     cols = {}
     for (n, c), d, v in zip(items, out_d, out_v):
         cols[n] = Column(d, c.type, v, c.dictionary, bounds=c.bounds)
@@ -439,7 +465,27 @@ def _trace_target(mesh):
     return jax.make_jaxpr(fn)(vc, keys, valids, splitters)
 
 
+def _trace_local_sort(mesh):
+    """The phase-1 local sort (ISSUE 6: donation changed its operand
+    structure — keys selected from datas by static by_idx so each buffer
+    enters the program exactly once, TS108): one nullable int32 lane
+    column as the key + one f64 side column gathered at the stable
+    permutation.  Pure-local, no collective, no widening."""
+    from ..ops import lanes
+    w = int(mesh.devices.size)
+    cap, S = 1024, jax.ShapeDtypeStruct
+    vspec = lanes.plan_lanes(("int32", "float64"), (True, False))
+    fn = _unwrap(_local_sort_fn(mesh, (False,), pack.NULL_LAST, (False,),
+                                vspec, (1,), (0,)))
+    vc = S((w,), np.int32)
+    datas = (S((w * cap,), np.int32), S((w * cap,), np.float64))
+    valids = (S((w * cap,), np.bool_), None)
+    return jax.make_jaxpr(fn)(vc, datas, valids)
+
+
 from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
 
 declare_builder(f"{__name__}._sample_fn", _trace_sample, tags=("sort",))
 declare_builder(f"{__name__}._target_fn", _trace_target, tags=("sort",))
+declare_builder(f"{__name__}._local_sort_fn", _trace_local_sort,
+                tags=("sort",))

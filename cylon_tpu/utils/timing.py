@@ -65,6 +65,44 @@ def region(name: str, block=None):
         acc[1] += 1
 
 
+#: snapshot-key suffix marking a BLOCKING host-sync region — the
+#: dispatch/block attribution split (``split_snapshot``)
+BLOCK_SUFFIX = ".block"
+
+
+@contextlib.contextmanager
+def sync_region(name: str):
+    """Time a deliberate blocking host pull under ``name + '.block'``.
+
+    The async attribution mode (``CYLON_TPU_TIMING=async``) turns every
+    :func:`region` into a dispatch-only marker; the wall time those
+    markers no longer capture is spent at the few designated sync points
+    (the pipelined join's batched phase pull, per-piece count/meta
+    pulls, the bench driver's final output sync).  Wrapping exactly those
+    pulls in ``sync_region`` splits each phase into *dispatch* time (its
+    plain region) and *block* time (its ``.block`` twin), so phase
+    overlap is directly measurable: a phase that overlaps well shows
+    near-zero dispatch AND near-zero block — its device work hides under
+    another phase's block point."""
+    with region(name if name.endswith(BLOCK_SUFFIX)
+                else name + BLOCK_SUFFIX):
+        yield
+
+
+def split_snapshot(snap: dict) -> tuple[dict, dict]:
+    """Split a :func:`snapshot` into ``(dispatch, block)`` second-maps:
+    ``.block``-suffixed regions (``sync_region``) land in ``block`` under
+    their base name; everything else is dispatch(-or-blocking-mode)
+    attribution."""
+    dispatch, block = {}, {}
+    for k, v in snap.items():
+        if k.endswith(BLOCK_SUFFIX):
+            block[k[:-len(BLOCK_SUFFIX)]] = v["s"]
+        else:
+            dispatch[k] = v["s"]
+    return dispatch, block
+
+
 def maybe_block(x) -> None:
     """block_until_ready(x) ONLY when bench timings are on AND the timing
     mode is blocking — lets a region charge async device work to itself

@@ -34,15 +34,25 @@ EXPECTED_PHASES = (
 )
 
 
-def run_smoke(env=None, rows: int = 65536, n_chunks: int = 4) -> dict:
+def run_smoke(env=None, rows: int = 65536, n_chunks: int = 4,
+              overlap: bool | None = None, donate: bool | None = None,
+              pallas: bool | None = None) -> dict:
     """Run the pipelined join+groupby at a tiny shape and verify the
     dispatch path: phase keys present, sink result == monolith.  Returns
-    the phase snapshot dict.  Raises AssertionError on any regression."""
+    the phase snapshot dict.  Raises AssertionError on any regression.
+
+    ``overlap``/``donate``/``pallas`` pin the ISSUE-6 dispatch rungs
+    (CYLON_TPU_PACKED_OVERLAP / CYLON_TPU_DONATE / CYLON_TPU_PALLAS_PROBE)
+    for the run; ``None`` keeps the session config.  With overlap ON the
+    pre-loop batched sync marker (``pipe.phase_sync.block``) must appear;
+    with the Pallas probe requested, the eligibility gate must actually
+    route the kernel (no silent fallback at this tile-aligned shape)."""
     import numpy as np
 
     import cylon_tpu as ct
     from cylon_tpu import config
     from cylon_tpu.exec import GroupBySink, pipelined_join
+    from cylon_tpu.ops import pallas_probe
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.utils import timing
 
@@ -63,23 +73,48 @@ def run_smoke(env=None, rows: int = 65536, n_chunks: int = 4) -> dict:
         {"k": rng.integers(0, max_val, rows).astype(np.int64),
          "b": rng.integers(0, 1000, rows).astype(np.int64)}, env)
 
-    prev_bench, prev_async = config.BENCH_TIMINGS, config.TIMING_ASYNC
+    prev = (config.BENCH_TIMINGS, config.TIMING_ASYNC,
+            config.PACKED_OVERLAP, config.DONATE_BUFFERS,
+            config.PALLAS_PROBE)
+    probed = []
+    orig_supported = pallas_probe.supported
+
+    def spy(cap, n_split, kinds):
+        ok = orig_supported(cap, n_split, kinds)
+        probed.append(ok)
+        return ok
+
     try:
         config.BENCH_TIMINGS = True
         config.TIMING_ASYNC = True      # dispatch-only markers (bench mode)
+        if overlap is not None:
+            config.PACKED_OVERLAP = overlap
+        if donate is not None:
+            config.DONATE_BUFFERS = donate
+        if pallas is not None:
+            config.PALLAS_PROBE = pallas
+            pallas_probe.supported = spy
         timing.reset()
         sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
         pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=n_chunks,
                        sink=sink)
         got = sink.finalize()
         snap = timing.snapshot()
+        overlap_on = config.PACKED_OVERLAP
     finally:
-        config.BENCH_TIMINGS = prev_bench
-        config.TIMING_ASYNC = prev_async
+        pallas_probe.supported = orig_supported
+        (config.BENCH_TIMINGS, config.TIMING_ASYNC, config.PACKED_OVERLAP,
+         config.DONATE_BUFFERS, config.PALLAS_PROBE) = prev
         timing.reset()
 
     missing = [p for p in EXPECTED_PHASES if p not in snap]
     assert not missing, f"pipelined phases missing from profile: {missing}"
+    if overlap_on:
+        assert "pipe.phase_sync" + timing.BLOCK_SUFFIX in snap, \
+            "overlap on but the pre-loop batched sync marker is missing"
+    if pallas:
+        assert probed == [True], \
+            f"Pallas probe requested but the gate saw {probed}"
 
     mono = groupby_aggregate(join_tables(lt, rt, "k", "k", how="inner"),
                              "k", [("a", "sum"), ("b", "sum")])
@@ -94,12 +129,15 @@ def run_smoke(env=None, rows: int = 65536, n_chunks: int = 4) -> dict:
 
 def main() -> int:
     rows = 65536
+    all_rungs = "--all-rungs" in sys.argv
     for a in sys.argv[1:]:
         if a.startswith("--rows="):
             rows = int(a.split("=", 1)[1])
-    snap = run_smoke(rows=rows)
+    kw = {"overlap": True, "donate": True, "pallas": True} if all_rungs \
+        else {}
+    snap = run_smoke(rows=rows, **kw)
     print(json.dumps({"metric": "pipelined smoke", "rows": rows,
-                      "ok": True, "phases_s":
+                      "ok": True, "all_rungs": all_rungs, "phases_s":
                       {k: v["s"] for k, v in snap.items()}}))
     return 0
 

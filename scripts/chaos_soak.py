@@ -17,9 +17,14 @@ subprocess, so the ``kill`` fault kind (SIGKILL mid-range-loop) and the
 * recovery-event counts stay bounded (the ladder's escalation is finite
   by construction — an unbounded count means a retry loop escaped it).
 
-The first three schedules are pinned (kill-and-resume, corrupt-on-write
-then kill, corrupt-on-load during resume) so the acceptance paths run
-on every seed; the rest are drawn from ``--seed``.
+The first four schedules are pinned (kill-and-resume, corrupt-on-write
+then kill, corrupt-on-load during resume, and kill-and-resume with the
+phase-overlap escape hatch OFF — ``CYLON_TPU_PACKED_OVERLAP=0`` must
+stay bit-equal to the overlap-on baseline even through a crash+resume)
+so the acceptance paths run on every seed; the rest are drawn from
+``--seed``.  Randomized draws run under the DEFAULT dispatch config,
+which has the overlapped scheduler on — every drawn schedule therefore
+also soaks deferred-fault re-raising (exec/pipeline._PieceFuture).
 
 Usage::
 
@@ -180,16 +185,24 @@ def _pinned_schedules() -> list[dict]:
         # corruption injected on the LOAD side of the resume itself
         {"faults": "ckpt.write::3=kill",
          "resume_faults": "ckpt.load::1=corrupt"},
+        # the overlap escape hatch: kill-and-resume with the
+        # phase-overlapped scheduler DISABLED — both dispatch modes must
+        # hash-equal the overlap-on baseline, crash and resume included
+        {"faults": "ckpt.write::2=kill", "resume_faults": "",
+         "expect_ffwd": True,
+         "env": {"CYLON_TPU_PACKED_OVERLAP": "0"}},
     ]
 
 
-def _spawn(args, workdir: str, faults: str, resume: bool) -> tuple:
+def _spawn(args, workdir: str, faults: str, resume: bool,
+           extra_env: dict | None = None) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["CYLON_TPU_FAULTS"] = faults
     env["CYLON_TPU_CKPT_DIR"] = workdir
+    env.update(extra_env or {})
     if resume:
         env["CYLON_TPU_RESUME"] = "1"
     else:
@@ -218,7 +231,8 @@ def _run_schedule(args, idx: int, sched: dict, baseline_sha: str,
         tail = ("\n" + (proc.stdout + proc.stderr)[-2000:]) if proc else ""
         failures.append(f"schedule {idx} ({sched['faults']!r}): {msg}{tail}")
 
-    p, info = _spawn(args, workdir, sched["faults"], resume=False)
+    p, info = _spawn(args, workdir, sched["faults"], resume=False,
+                     extra_env=sched.get("env"))
     outcome = "ok"
     if p.returncode == 0:
         if not info or info.get("sha") != baseline_sha:
@@ -228,7 +242,7 @@ def _run_schedule(args, idx: int, sched: dict, baseline_sha: str,
     elif p.returncode == -9 or p.returncode == RESUMABLE_EXIT:
         outcome = "killed" if p.returncode == -9 else "resumable"
         p2, info2 = _spawn(args, workdir, sched.get("resume_faults", ""),
-                           resume=True)
+                           resume=True, extra_env=sched.get("env"))
         if p2.returncode != 0:
             fail(f"resume run failed rc={p2.returncode}", p2)
         elif not info2 or info2.get("sha") != baseline_sha:

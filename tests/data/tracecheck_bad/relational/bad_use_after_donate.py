@@ -1,0 +1,55 @@
+"""TS108 fixture: reads of buffers after they were donated into a
+jitted program.  The path puts this under a ``relational/`` directory,
+where the rule is in scope."""
+
+import jax
+
+
+def _builder(mesh, donate=()):
+    def go(carry, state):
+        return carry + state
+
+    return jax.jit(go, donate_argnums=donate)
+
+
+def jit_wrapper_then_reads(buf, other):
+    fn = jax.jit(lambda x, y: x * y, donate_argnums=(0,))
+    out = fn(buf, other)
+    return out + buf            # TS108: buf donated two lines up
+
+
+def builder_kw_then_reads(mesh, carry, state):
+    fn = _builder(mesh, donate=(0, 1))
+    out = fn(carry, state)
+    if carry is not None:       # TS108: carry read after donation
+        out = out
+    return out, state           # TS108: state read after donation
+
+
+def immediate_apply_then_reads(mesh, carry, state):
+    out = _builder(mesh, donate=(0,))(carry, state)
+    return out, carry           # TS108: carry donated on the line above
+
+
+def conditional_idiom_then_reads(mesh, carry, state, flag):
+    fn = _builder(mesh, donate=(0,) if flag else ())
+    out = fn(carry, state)
+    return out + carry          # TS108: the conditional idiom still counts
+
+
+def fine_rebind_clears(mesh, carry, state):
+    fn = _builder(mesh, donate=(0,))
+    carry = fn(carry, state)    # rebinding clears the donated mark
+    return carry                # ok: this is the program's output
+
+
+def fine_del_then_fresh(mesh, carry, state):
+    out = _builder(mesh, donate=(0, 1))(carry, state)
+    del carry, state            # ok: dropped, never read again
+    return out
+
+
+def fine_unknown_positions(mesh, carry, state, donate):
+    fn = _builder(mesh, donate=donate)   # not statically resolvable
+    out = fn(carry, state)
+    return out, carry           # ok: untracked (under-approximation)

@@ -166,6 +166,28 @@ class TestResumeFastForward:
         assert checkpoint.stats()["resume_fast_forwarded_pieces"] \
             == n_committed
 
+    def test_resume_bit_equal_across_overlap_modes(self, env4, rng,
+                                                   monkeypatch):
+        """Checkpoint state is dispatch-mode agnostic: pieces committed
+        under the overlap scheduler resume bit-identically with overlap
+        DISABLED (and the plan tokens match — the schedule is not part
+        of the plan), so an operator can flip the escape hatch between
+        a crash and its resume without losing the checkpoint."""
+        from cylon_tpu import config
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        monkeypatch.setattr(config, "PACKED_OVERLAP", True)
+        base = _run_sink(lt, rt)
+        n_committed = checkpoint.stats()["checkpoint_events"]
+        assert n_committed >= 2
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        monkeypatch.setattr(config, "PACKED_OVERLAP", False)
+        resumed = _run_sink(lt, rt)
+        _frames_bitequal(resumed, base)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] \
+            == n_committed
+
     def test_partial_prefix_resume(self, env4, rng, monkeypatch):
         """Only a prefix committed (as after a mid-loop crash): resume
         restores the prefix and recomputes the rest — still bit-equal."""

@@ -356,6 +356,155 @@ class TestPackedPieces:
         exp = ldf.merge(rdf, on="k", how=how)
         assert len(got) == len(exp)
 
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_donation_and_pallas_probe_bit_equal(self, env4, rng, how):
+        """Buffer donation (CYLON_TPU_DONATE), the overlap scheduler
+        (CYLON_TPU_PACKED_OVERLAP) and the Pallas probe kernel
+        (CYLON_TPU_PALLAS_PROBE, interpreter mode on CPU) must each be
+        EXACTLY equal — same rows, same order, same bits — to the plain
+        per-phase-sync, no-donation dispatch."""
+        from cylon_tpu.ops import pallas_probe
+        n = 4096  # per-shard capacity 1024: Pallas tile-aligned
+        ldf = pd.DataFrame({
+            "k": rng.integers(0, 300, n).astype(np.int64),
+            "a": rng.random(n),                              # f64 side col
+            "s": rng.choice(["x", "y", "z"], n).astype(object)})
+        rdf = pd.DataFrame({"k": rng.integers(100, 400, n).astype(np.int64),
+                            "b": rng.random(n)})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        prev = (config.PACKED_OVERLAP, config.DONATE_BUFFERS,
+                config.PALLAS_PROBE)
+        probed = []
+        orig_supported = pallas_probe.supported
+
+        def spy(cap, n_split, kinds):
+            ok = orig_supported(cap, n_split, kinds)
+            probed.append(ok)
+            return ok
+
+        try:
+            config.PACKED_OVERLAP = False
+            config.DONATE_BUFFERS = False
+            config.PALLAS_PROBE = False
+            ref = pipelined_join(lt, rt, "k", "k", how=how,
+                                 n_chunks=3).to_pandas()
+            config.PACKED_OVERLAP = True
+            config.DONATE_BUFFERS = True
+            got = pipelined_join(lt, rt, "k", "k", how=how,
+                                 n_chunks=3).to_pandas()
+            pd.testing.assert_frame_equal(got, ref, check_exact=True)
+            config.PALLAS_PROBE = True
+            pallas_probe.supported = spy
+            got = pipelined_join(lt, rt, "k", "k", how=how,
+                                 n_chunks=3).to_pandas()
+            pd.testing.assert_frame_equal(got, ref, check_exact=True)
+        finally:
+            pallas_probe.supported = orig_supported
+            (config.PACKED_OVERLAP, config.DONATE_BUFFERS,
+             config.PALLAS_PROBE) = prev
+        # the eligibility gate must have actually routed the probe
+        # through the kernel — a silent fallback would make the pallas
+        # leg of this test vacuous
+        assert probed == [True]
+        exp = ldf.merge(rdf, on="k", how=how)
+        assert len(got) == len(exp)
+
+    def test_pallas_probe_kernel_wide_operand_bit_equal(self, rng):
+        """Kernel-level bit-equality over the operand shapes the narrow
+        single-lane join test can't reach: a MULTI-operand key whose lo
+        lane is uint32 (the wide-int64 (hi int32, lo uint32) pack pair —
+        ops/pack) with values straddling the 0x80000000 rebase boundary
+        and hi-lane ties forcing the lexicographic eq-chain."""
+        import jax.numpy as jnp
+        from cylon_tpu.ops import pack, pallas_probe
+        cap, nsplit = 2048, 13
+        hi = rng.integers(-3, 3, cap).astype(np.int32)   # heavy ties
+        lo = rng.integers(0, 2**32, cap, dtype=np.uint64).astype(np.uint32)
+        lo[:64] = np.uint32(0x80000000)                  # rebase boundary
+        lo[64:128] = np.uint32(0x7FFFFFFF)
+        live = np.ones(cap, np.int32)
+        sel = rng.integers(0, cap, nsplit)
+        kinds = ("i", "i", "i")
+        assert pallas_probe.supported(cap, nsplit, kinds)
+        ops = (jnp.asarray(live), jnp.asarray(hi), jnp.asarray(lo))
+        sops = (jnp.asarray(live[sel]), jnp.asarray(hi[sel]),
+                jnp.asarray(lo[sel]))
+        ge = pack.rows_ge_splitters(pack.KeyOps(ops=ops, kinds=kinds), sops)
+        ref = jnp.sum(ge, axis=1, dtype=jnp.int32)
+        got = pallas_probe.count_ge_splitters(ops, sops)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_pallas_probe_wide_int64_keys_bit_equal(self, env4, rng):
+        """End-to-end: wide int64 keys (bounds past int32, negatives
+        included) pack as TWO value operands per key — the Pallas probe
+        must engage (eligibility spy) and stay bit-equal to the XLA
+        matrix path through the full pipelined join."""
+        from cylon_tpu.ops import pallas_probe
+        n = 4096
+        pool = rng.integers(-2**62, 2**62, 300, dtype=np.int64)
+        ldf = pd.DataFrame({"k": rng.choice(pool, n),
+                            "a": rng.integers(0, 50, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.choice(pool, n // 2),
+                            "b": rng.random(n // 2)})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        prev = config.PALLAS_PROBE
+        probed = []
+        orig_supported = pallas_probe.supported
+
+        def spy(cap, n_split, kinds):
+            ok = orig_supported(cap, n_split, kinds)
+            probed.append(ok)
+            return ok
+
+        try:
+            config.PALLAS_PROBE = False
+            ref = pipelined_join(lt, rt, "k", "k", how="inner",
+                                 n_chunks=3).to_pandas()
+            config.PALLAS_PROBE = True
+            pallas_probe.supported = spy
+            got = pipelined_join(lt, rt, "k", "k", how="inner",
+                                 n_chunks=3).to_pandas()
+        finally:
+            pallas_probe.supported = orig_supported
+            config.PALLAS_PROBE = prev
+        assert probed == [True]
+        pd.testing.assert_frame_equal(got, ref, check_exact=True)
+        assert len(got) == len(ldf.merge(rdf, on="k", how="inner"))
+
+    def test_overlap_one_host_sync_per_piece(self, env4, rng):
+        """Acceptance: under the overlap scheduler the range loop costs
+        at most ONE sanctioned host pull per piece (the transfer funnel's
+        ledger is the counter), and disabling overlap restores the
+        per-phase pulls (strictly more) — the escape hatch contract."""
+        from cylon_tpu.analysis import runtime
+        n = 4096
+        lt = ct.Table.from_pydict(
+            {"k": rng.integers(0, 2000, n).astype(np.int64),
+             "a": rng.integers(0, 50, n).astype(np.int64)}, env4)
+        rt = ct.Table.from_pydict(
+            {"k": rng.integers(0, 2000, n).astype(np.int64),
+             "b": rng.integers(0, 50, n).astype(np.int64)}, env4)
+
+        def pulls(nc, overlap):
+            prev = config.PACKED_OVERLAP
+            config.PACKED_OVERLAP = overlap
+            try:
+                with runtime.transfer_scope() as ledger:
+                    pipelined_join(lt, rt, "k", "k", how="inner",
+                                   n_chunks=nc)
+                return sum(ledger.values())
+            finally:
+                config.PACKED_OVERLAP = prev
+
+        p3, p6 = pulls(3, True), pulls(6, True)
+        # dense uniform keys: every range qualifies, pieces == n_chunks.
+        # marginal host syncs per extra piece <= 1
+        assert p6 - p3 <= 3, (p3, p6)
+        # the one batched pre-loop sync beats the per-phase pulls
+        assert p3 < pulls(3, False)
+
     def test_packed_join_defers_with_lazy_counts(self, env4, rng):
         """A packed inner join with allow_defer hands back a DeferredTable
         whose output counts stay ON DEVICE until someone asks — the piece
@@ -554,3 +703,21 @@ class TestBenchSmoke:
             sys.path.remove(scripts)
         snap = run_smoke(env=env4, rows=16384, n_chunks=4)
         assert all(p in snap for p in EXPECTED_PHASES)
+
+    def test_smoke_all_dispatch_rungs(self, env4):
+        """The same tiny-shape path with ALL ISSUE-6 dispatch rungs
+        pinned on — overlap scheduler + buffer donation + Pallas probe
+        (interpreter mode on CPU): the three flag paths stay covered by
+        tier-1, run_smoke itself asserts the phase_sync marker and that
+        the Pallas eligibility gate engaged (no silent fallback)."""
+        import os
+        import sys
+        scripts = os.path.join(os.path.dirname(__file__), "..", "scripts")
+        sys.path.insert(0, scripts)
+        try:
+            from bench_smoke import run_smoke
+        finally:
+            sys.path.remove(scripts)
+        snap = run_smoke(env=env4, rows=16384, n_chunks=4,
+                         overlap=True, donate=True, pallas=True)
+        assert "pipe.phase_sync.block" in snap

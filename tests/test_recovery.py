@@ -376,6 +376,160 @@ class TestInjectedOperators:
 
 
 # ---------------------------------------------------------------------------
+# overlap scheduler × recovery interplay (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+class TestOverlapRobustness:
+    """The phase-overlapped piece scheduler (CYLON_TPU_PACKED_OVERLAP)
+    must not change WHAT the recovery ladder sees or WHERE typed faults
+    surface: deferred phase faults re-raise at the same consume point,
+    and the ladder's escalation sequence is identical with overlap on
+    or off."""
+
+    def test_piece_future_defers_typed_not_foreign(self):
+        from cylon_tpu.exec.pipeline import _PieceFuture
+
+        def typed():
+            raise CapacityOverflowError("deferred until consumed")
+
+        fut = _PieceFuture(typed, defer_faults=True)   # held, no raise yet
+        with pytest.raises(CapacityOverflowError):
+            fut.get()
+        # the non-overlapped schedule raises at dispatch
+        with pytest.raises(CapacityOverflowError):
+            _PieceFuture(typed, defer_faults=False)
+
+        def foreign():
+            raise ValueError("not a taxonomy fault")
+
+        # foreign exceptions must NOT be detached from their dispatch
+        # context — they raise immediately even when deferring
+        with pytest.raises(ValueError):
+            _PieceFuture(foreign, defer_faults=True)
+
+    def test_phase_sync_fault_surfaces_typed(self, env4, rng, monkeypatch):
+        """A fault injected at the overlap scheduler's designated
+        pre-loop sync point (pipe.phase_sync) surfaces as a TYPED fault
+        there — not as a raw jax error from an arbitrary later pull."""
+        from cylon_tpu import config
+        from cylon_tpu.exec import pipelined_join
+        ldf, rdf, lt, rt = _tables(env4, rng, n=1500)
+        monkeypatch.setattr(config, "PACKED_OVERLAP", True)
+        recovery.install_faults("pipe.phase_sync::1=predicted")
+        with pytest.raises(PredictedResourceExhausted):
+            pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=3)
+        assert recovery.recovery_events() == [
+            {"site": "pipe.phase_sync", "kind": "predicted",
+             "action": "injected"}]
+        # with overlap off the designated sync point does not exist
+        # (per-phase pulls instead) — the same armed fault never fires
+        monkeypatch.setattr(config, "PACKED_OVERLAP", False)
+        recovery.install_faults("pipe.phase_sync::1=predicted")
+        out = pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=3)
+        assert out.row_count == len(ldf.merge(rdf, on="k"))
+        assert recovery.recovery_events() == []
+
+    def test_piece_cap_ladder_identical_overlap_on_off(self, env4, rng,
+                                                       monkeypatch):
+        """Injected CapacityOverflow inside the pipelined fallback: the
+        consensus ladder must take the identical escalation sequence and
+        produce bit- and order-equal output with overlap on or off."""
+        import gc
+        from cylon_tpu import config
+        from cylon_tpu.relational import join_tables
+        ldf, rdf, lt, rt = _tables(env4, rng)
+        runs = {}
+        for overlap in (True, False):
+            # drain leaked spillable registrations from the previous
+            # mode's run: a phantom spill rung would (legitimately)
+            # change the ladder sequence for reasons unrelated to overlap
+            gc.collect()
+            monkeypatch.setattr(config, "PACKED_OVERLAP", overlap)
+            recovery.install_faults(
+                "shuffle.recv_guard:0:1=predicted,"
+                "join.piece_cap::1=capacity")
+            j = join_tables(lt, rt, "k", "k", how="inner")
+            runs[overlap] = (j.to_pandas(), recovery.recovery_events())
+            recovery.install_faults("")
+        (df_on, ev_on), (df_off, ev_off) = runs[True], runs[False]
+        assert ev_on == ev_off
+        assert any(e["action"] == "retry_chunks_16" for e in ev_on), ev_on
+        pd.testing.assert_frame_equal(df_on, df_off)
+
+    def test_spill_upload_fault_identical_overlap_on_off(self, env4, rng,
+                                                         monkeypatch):
+        """Budget-forced spilled sources: a device-OOM fault injected at
+        the spill.upload re-entry fires inside the piece dispatch — under
+        overlap, while dispatching ahead of the consume point — and the
+        ladder must classify it and converge to the identical escalation
+        sequence and bit-equal result in both dispatch modes."""
+        import gc
+        from cylon_tpu import config
+        from cylon_tpu.exec import pipelined_join
+        _ldf, _rdf, lt, rt = _tables(env4, rng)
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        runs = {}
+        for overlap in (True, False):
+            gc.collect()
+            monkeypatch.setattr(config, "PACKED_OVERLAP", overlap)
+            recovery.install_faults("spill.upload::1=device_oom")
+
+            def attempt(nc):
+                return pipelined_join(lt, rt, "k", "k", how="inner",
+                                      n_chunks=nc)
+
+            out = recovery.run_with_recovery(
+                lambda: attempt(4), True, attempt, "join", env=env4)
+            runs[overlap] = (out.to_pandas(), recovery.recovery_events())
+            recovery.install_faults("")
+        (df_on, ev_on), (df_off, ev_off) = runs[True], runs[False]
+        assert ev_on and ev_on == ev_off
+        assert ev_on[0]["kind"] == "device_oom", ev_on
+        pd.testing.assert_frame_equal(df_on, df_off)
+
+    def test_groupby_oom_ladder_identical_overlap_on_off(self, env4, rng,
+                                                         monkeypatch):
+        """The chaos-soak workload shape (pipelined join into a
+        GroupBySink under run_with_recovery) with an injected device OOM
+        at the groupby site: identical ladder events and bit-equal
+        finalize with overlap on or off.  The sink keys on a NON-join
+        column so the cross-chunk combine (groupby_aggregate — where the
+        site is probed) actually runs."""
+        import gc
+        from cylon_tpu import config
+        from cylon_tpu.exec import GroupBySink, pipelined_join
+        n = 2000
+        ldf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                            "g": rng.integers(0, 7, n).astype(np.int64),
+                            "a": rng.integers(0, 50, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.integers(0, 500, n).astype(np.int64),
+                            "b": rng.integers(0, 50, n).astype(np.int64)})
+        lt = ct.Table.from_pandas(ldf, env4)
+        rt = ct.Table.from_pandas(rdf, env4)
+        runs = {}
+        for overlap in (True, False):
+            gc.collect()
+            monkeypatch.setattr(config, "PACKED_OVERLAP", overlap)
+            recovery.install_faults("groupby.device_oom::1=device_oom")
+
+            def attempt(nc):
+                sink = GroupBySink("g", [("a", "sum")])
+                pipelined_join(lt, rt, "k", "k", how="inner",
+                               n_chunks=nc, sink=sink)
+                return sink.finalize()
+
+            out = recovery.run_with_recovery(
+                lambda: attempt(4), True, attempt, "soak", env=env4)
+            runs[overlap] = (out.to_pandas().sort_values("g")
+                             .reset_index(drop=True),
+                             recovery.recovery_events())
+            recovery.install_faults("")
+        (df_on, ev_on), (df_off, ev_off) = runs[True], runs[False]
+        assert ev_on and ev_on == ev_off
+        pd.testing.assert_frame_equal(df_on, df_off)
+
+
+# ---------------------------------------------------------------------------
 # consensus + watchdog
 # ---------------------------------------------------------------------------
 

@@ -144,6 +144,78 @@ def test_ts107_scoped_to_pipeline_and_relational():
     assert ast_lint.lint_source("cylon_tpu/exec/pipeline.py", clean) == []
 
 
+def test_ts108_use_after_donate_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_use_after_donate.py"))
+        if f.rule == "TS108"]
+    # jit-wrapper read, builder-kw carry + state, immediate-apply read,
+    # conditional-idiom read — the rebind/del/unknown-positions cases
+    # stay clean
+    assert len(found) == 5
+    assert all("donate" in f.message for f in found)
+
+
+def test_ts108_scoped_and_cleared():
+    src = ("import jax\n\n"
+           "def f(buf):\n"
+           "    fn = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+           "    out = fn(buf)\n"
+           "    return out + buf\n")
+    # in scope under relational/ and exec/, out of scope elsewhere
+    assert any(f.rule == "TS108" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/other.py", src))
+    assert any(f.rule == "TS108" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/other.py", src))
+    assert not any(f.rule == "TS108" for f in ast_lint.lint_source(
+        "cylon_tpu/ops/other.py", src))
+    # rebinding the donated name clears the mark
+    clean = ("import jax\n\n"
+             "def f(buf):\n"
+             "    fn = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+             "    buf = fn(buf)\n"
+             "    return buf\n")
+    assert ast_lint.lint_source("cylon_tpu/relational/other.py",
+                                clean) == []
+    # a non-static donate keyword is not tracked (under-approximation)
+    unknown = ("import jax\n\n"
+               "def f(buf, d):\n"
+               "    fn = jax.jit(lambda x: x, donate_argnums=d)\n"
+               "    out = fn(buf)\n"
+               "    return out + buf\n")
+    assert ast_lint.lint_source("cylon_tpu/relational/other.py",
+                                unknown) == []
+    # metadata-only reads (shape/dtype/... — _STATIC_ATTRS) of a donated
+    # name are safe: jax keeps the aval on a deleted Array
+    meta = ("import jax\n\n"
+            "def f(buf):\n"
+            "    fn = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+            "    out = fn(buf)\n"
+            "    return out.reshape(buf.shape[0]), buf.dtype\n")
+    assert ast_lint.lint_source("cylon_tpu/relational/other.py",
+                                meta) == []
+    # a compound statement rebinding the donated name (for-loop target)
+    # shadows the buffer BEFORE its body reads it — no finding
+    loop = ("import jax\n\n"
+            "def f(buf, items):\n"
+            "    fn = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+            "    out = fn(buf)\n"
+            "    for buf in items:\n"
+            "        out = out + buf\n"
+            "    return out\n")
+    assert ast_lint.lint_source("cylon_tpu/relational/other.py",
+                                loop) == []
+    # rebinding the CALLABLE to a non-donating program drops its stale
+    # donate positions — the new program's args must not flag
+    redef = ("import jax\n\n"
+             "def f(buf):\n"
+             "    fn = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+             "    fn = jax.jit(lambda x: x)\n"
+             "    out = fn(buf)\n"
+             "    return out + buf\n")
+    assert ast_lint.lint_source("cylon_tpu/relational/other.py",
+                                redef) == []
+
+
 def test_suppression_silences_everything():
     assert ast_lint.lint_file(os.path.join(BAD, "suppressed.py")) == []
 
@@ -168,7 +240,7 @@ def test_package_lints_clean():
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
-                                       "TS105", "TS106", "TS107"}
+                                       "TS105", "TS106", "TS107", "TS108"}
 
 
 # ---------------------------------------------------------------------------
