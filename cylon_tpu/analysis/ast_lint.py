@@ -37,6 +37,15 @@ function within the same module) — and flags:
   (:mod:`cylon_tpu.exec.memory`): an unaccounted upload skews every
   budget decision, and an unaccounted pull bypasses the spill tier's
   eviction bookkeeping AND the ``utils.host`` transfer funnel;
+* **TS109** direct ledger admission/eviction calls
+  (``ensure_headroom``/``try_free``/``spill_for_retry``/``evict_n``/
+  ``evict_until``) anywhere outside ``exec/scheduler.py`` and
+  ``exec/memory.py`` — admission must be SCHEDULER-mediated
+  (:mod:`cylon_tpu.exec.scheduler` ``admit_allocation``/
+  ``free_pressure``/``spill_retry``): a direct call bypasses per-tenant
+  footprint attribution, admission-wait accounting and cross-tenant
+  eviction bookkeeping, so the serving tier's budget decisions stop
+  describing reality;
 * **TS108** use-after-donate in ``relational/`` or ``exec/`` modules: a
   name passed at a *statically known* ``donate_argnums`` position (a
   ``jax.jit(..., donate_argnums=(...))`` wrapper, or a builder call
@@ -89,6 +98,15 @@ _RESIDENCY_FUNCS = {"device_put", "device_get"}
 _CKPT_PIPELINE_FILE = "exec/pipeline.py"
 _CKPT_IO_LEAVES = {"save", "savez", "savez_compressed", "load",
                    "dump", "dumps", "loads"}
+
+#: ledger admission/eviction entry points callable ONLY from the serving
+#: scheduler or the ledger itself (TS109): admission is scheduler-
+#: mediated so per-tenant footprints, admission waits and cross-tenant
+#: evictions stay attributed in one place
+_ADMISSION_FUNCS = {"ensure_headroom", "try_free", "spill_for_retry",
+                    "evict_n", "evict_until"}
+#: the two sanctioned modules (path suffixes)
+_ADMISSION_OK_FILES = ("exec/scheduler.py", "exec/memory.py")
 
 #: directories whose modules donate buffers through jitted programs
 #: (TS108): the piece/join/sort builders and the pipelined range loop
@@ -356,6 +374,7 @@ class _ModuleLint:
         self._check_device_residency()
         self._check_ckpt_artifacts()
         self._check_use_after_donate()
+        self._check_direct_admission()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -517,6 +536,32 @@ class _ModuleLint:
                     "two-phase rank-coherent manifest commit); a direct "
                     "artifact has no hash and no commit epoch, so resume "
                     "could restore torn or rank-divergent state")
+
+    def _check_direct_admission(self) -> None:
+        """TS109: a direct call of a ledger admission/eviction entry
+        point (`ensure_headroom`/`try_free`/`spill_for_retry`/`evict_n`/
+        `evict_until`) outside the serving scheduler and the ledger
+        module itself — admission must be scheduler-mediated
+        (exec/scheduler.admit_allocation / free_pressure / spill_retry)
+        so the multi-tenant serving tier's footprint attribution,
+        admission-wait accounting and cross-tenant eviction bookkeeping
+        see every decision (docs/serving.md)."""
+        norm = self.path.replace(os.sep, "/")
+        if norm.endswith(_ADMISSION_OK_FILES):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _func_name(node.func).split(".")[-1]
+            if leaf in _ADMISSION_FUNCS:
+                self._emit(
+                    "TS109", node,
+                    f"`{_func_name(node.func)}` calls a ledger admission/"
+                    "eviction entry point directly — admission must be "
+                    "scheduler-mediated (cylon_tpu.exec.scheduler."
+                    "admit_allocation / free_pressure / spill_retry) so "
+                    "per-tenant footprints, admission waits and cross-"
+                    "tenant evictions stay attributed and rank-coherent")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

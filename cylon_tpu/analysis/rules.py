@@ -40,6 +40,10 @@ RULES = {
     "TS108": "use-after-donate: an array read after being passed through "
              "a donate_argnums position in relational/ or exec/ (the "
              "donating call invalidated its buffer)",
+    "TS109": "direct ledger admission/eviction call outside "
+             "exec/scheduler.py and exec/memory.py (admission must be "
+             "scheduler-mediated so multi-tenant footprints and "
+             "cross-tenant evictions stay attributed)",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -8,3 +8,6 @@ from .pipeline import (GroupBySink, chunk_table,  # noqa: F401
 from . import checkpoint  # noqa: F401  — durable checkpoint/resume rung
 from . import memory  # noqa: F401  — HBM budget ledger + host spill tier
 from . import recovery  # noqa: F401  — rank-coherent failure recovery
+from . import scheduler  # noqa: F401  — multi-tenant serving tier
+from .scheduler import QueryScheduler  # noqa: F401
+from .session import QuerySession  # noqa: F401

@@ -137,20 +137,24 @@ def unrestore(k: int) -> None:
 # stage identity
 # ---------------------------------------------------------------------------
 
-#: per-process stage sequence: checkpoint-enabled stages replay in the
-#: same order in a fresh process (the workload is deterministic), so the
-#: counter IS the cross-process stage identity — the plan token guards
-#: against the workload having actually changed
-_STAGE_SEQ = [0]
+#: per-(serving-session) stage sequences, key None = outside a
+#: scheduler: checkpoint-enabled stages replay in the same PER-SESSION
+#: order in a fresh process (each session's workload is deterministic,
+#: and the serving scheduler re-creates sessions under the same names),
+#: so (session, counter) IS the cross-process stage identity even when
+#: concurrent sessions interleave their stage openings in a different
+#: order — the plan token guards against the workload having actually
+#: changed
+_STAGE_SEQ: dict = {}
 
 #: stage directories opened this process (for the resume-token file)
 _OPEN_DIRS: list[str] = []
 
 
 def reset_stages() -> None:
-    """Restart the stage sequence (tests replaying a workload in-process
+    """Restart the stage sequences (tests replaying a workload in-process
     to exercise the resume path without a fresh interpreter)."""
-    _STAGE_SEQ[0] = 0
+    _STAGE_SEQ.clear()
     _OPEN_DIRS.clear()
 
 
@@ -380,9 +384,17 @@ class Stage:
 
 def open_stage(env, label: str, token: str) -> Stage:
     """The next pipelined stage's checkpoint handle (advances the
-    deterministic stage sequence).  Call only when :func:`enabled`."""
-    seq = _STAGE_SEQ[0]
-    _STAGE_SEQ[0] += 1
+    deterministic PER-SESSION stage sequence; under the serving
+    scheduler the stage directory is additionally namespaced by the
+    session name, so concurrent tenants' checkpoints never collide and a
+    resumed process matches each tenant's stages regardless of how the
+    original interleave ordered them).  Call only when :func:`enabled`."""
+    from . import recovery
+    sid = recovery.current_session()
+    seq = _STAGE_SEQ.get(sid, 0)
+    _STAGE_SEQ[sid] = seq + 1
+    if sid is not None:
+        label = f"{sid}.{label}"
     stage = Stage(env, label, token, seq)
     _OPEN_DIRS.append(stage.dir)
     return stage

@@ -87,6 +87,13 @@ def _spill_enabled() -> bool:
     return config.SPILL_ENABLED
 
 
+def _session_tag() -> str | None:
+    """The serving session tagged on this thread (exec/recovery holds the
+    thread-local identity the scheduler sets), or None outside one."""
+    from . import recovery
+    return recovery.current_session()
+
+
 # ---------------------------------------------------------------------------
 # budget
 # ---------------------------------------------------------------------------
@@ -142,13 +149,17 @@ class Registration:
     eviction pull and the re-upload collective-free."""
 
     __slots__ = ("owner", "nbytes", "spillable", "seq", "arrays", "host",
-                 "sharding", "world", "live", "__weakref__")
+                 "sharding", "world", "live", "session", "__weakref__")
 
     def __init__(self, owner: str, arrays, spillable: bool, sharding,
                  seq: int):
         self.owner = owner
         self.nbytes = _nbytes(arrays)
         self.spillable = bool(spillable)
+        # the serving session whose turn allocated this (None outside a
+        # scheduler): eviction under another tenant's admission pressure
+        # is a CROSS-tenant eviction, counted separately in stats()
+        self.session = _session_tag()
         # only a SPILLABLE entry holds its arrays (it must be able to
         # drop the device references on eviction); a bookkeeping-only
         # entry keeping them would pin its own anchor and never drain
@@ -640,7 +651,7 @@ def upload_window(reg: Registration, starts, window: int):
 
 _STATS = {"spill_events": 0, "bytes_spilled": 0,
           "readmit_events": 0, "bytes_readmitted": 0,
-          "donated_bytes_reused": 0}
+          "donated_bytes_reused": 0, "cross_session_evictions": 0}
 
 #: owners in eviction order since the last reset — the multihost driver
 #: asserts this sequence is IDENTICAL across ranks
@@ -650,6 +661,11 @@ _EVICTION_LOG: list[str] = []
 def _note_spill(site: str, reg: Registration) -> None:
     _STATS["spill_events"] += 1
     _STATS["bytes_spilled"] += reg.nbytes
+    if reg.session is not None and reg.session != _session_tag():
+        # another tenant's resident state evicted under THIS context's
+        # pressure (or the scheduler's admission pass, tag None): the
+        # serving tier's "evict cold tenants first" event
+        _STATS["cross_session_evictions"] += 1
     _EVICTION_LOG.append(reg.owner)
     timing.add_bytes(site, reg.nbytes)
     timing.bump(f"memory.{site}")
@@ -662,7 +678,9 @@ def stats() -> dict:
     ``spill_events``/``bytes_spilled`` (device→host evictions),
     ``readmit_events``/``bytes_readmitted`` (host→device re-entries),
     ``donated_bytes_reused`` (admission credit for buffers donated into
-    the allocating program — bytes the ledger did NOT double-count) and
+    the allocating program — bytes the ledger did NOT double-count),
+    ``cross_session_evictions`` (one tenant's registrations evicted under
+    another tenant's — or the scheduler's — admission pressure) and
     ``peak_ledger_bytes`` (high-water resident balance)."""
     return dict(_STATS, peak_ledger_bytes=_LEDGER.peak,
                 ledger_bytes=_LEDGER.balance())

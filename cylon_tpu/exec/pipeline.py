@@ -48,6 +48,17 @@ from ..status import CylonError, InvalidError
 shard_map = jax.shard_map
 
 
+def _interleave() -> None:
+    """Serving-tier interleave point (docs/serving.md): at piece-loop
+    boundaries a session scheduled by :mod:`cylon_tpu.exec.scheduler`
+    hands the baton to the next tenant — its already-dispatched async
+    device work keeps executing underneath, so the PR 6 overlap
+    scheduler keeps the device busy ACROSS tenants.  A no-op (one
+    module-global load) outside a scheduler."""
+    from . import scheduler
+    scheduler.maybe_yield()
+
+
 def _norep_kwargs() -> dict:
     """shard_map kwargs disabling replication checking — required when a
     pallas_call is in the program (no replication rule on jax < 0.5; the
@@ -162,6 +173,7 @@ def pipelined_set_op(a: Table, b: Table, op: str, n_chunks: int = 4):
         b = shuffle_table(b, names)     # resident side: ONCE
     parts = []
     for chunk in chunk_table(a, n_chunks):
+        _interleave()   # chunk boundary = serving-tier interleave point
         if op == "union":
             # unique_table shuffles internally; a pre-shuffle of `a`
             # would be a redundant third pass over its rows
@@ -873,6 +885,10 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             # piece r+1's phase dispatch overlaps piece r's in-flight
             # consumption (the sink's pending pull / deferred counts)
             nxt = piece_future(live_ranges[i + 1])
+        # piece boundary = the serving tier's interleave point: piece
+        # r's consume (and r+1's dispatch-ahead) are in flight on the
+        # device while another tenant's piece enqueues
+        _interleave()
     if not outs:
         # no range qualified (e.g. inner join, no overlapping keys at all):
         # one empty piece pair keeps the output schema path uniform

@@ -55,6 +55,24 @@ control flow after a collective has been entered** — any guard that can
 abort an exchange must take its raise/proceed decision through
 :func:`guard_consensus` BEFORE the first collective of that exchange is
 dispatched.
+
+**Serving-session isolation** (:mod:`cylon_tpu.exec.scheduler`): when
+the multi-tenant scheduler interleaves concurrent queries, each session
+runs on its own thread tagged via :func:`set_session`.  Three things
+follow from the tag: (1) recovery EVENTS carry the session name, so one
+tenant's retry ladder is auditable in isolation
+(:func:`events_for_session`) and never pollutes another's log; (2) the
+injection grammar grows an optional ``@session`` selector
+(``site[:rank][:nth]=kind@tenant``, with ``nth`` counted against the
+TARGET session's own probes) so chaos schedules can fault one tenant
+while its neighbors run clean; (3) the guard/spill/ladder consensus
+wires carry a small session NAMESPACE field above the payload — in a
+multiprocess session a rank that enters a consensus poll while a peer is
+voting from a different session raises a typed
+:class:`RankDesyncError` instead of silently adopting a foreign
+tenant's fault code.  The ladder's nesting depth (``_tls.depth``) is
+already thread-local, so concurrent ladders never see each other's
+escalation state.
 """
 
 from __future__ import annotations
@@ -206,22 +224,58 @@ def prime_compiler_probe() -> None:
 
 
 # ---------------------------------------------------------------------------
+# serving-session identity (exec/scheduler tags each session's thread)
+# ---------------------------------------------------------------------------
+
+def set_session(name: str | None, ordinal: int | None = None) -> None:
+    """Tag recovery state on THIS thread with a serving-session identity
+    (the scheduler calls this on each session's thread): recorded events
+    carry the session name, ``@session``-selective injector specs match
+    against it, and the consensus wires ride its namespace.  ``None``
+    clears the tag (the default, and the whole-process single-query
+    behavior — nothing changes outside a scheduler)."""
+    _tls.session = name
+    _tls.session_ord = ordinal
+
+
+def current_session() -> str | None:
+    """The serving-session name tagged on this thread, or None."""
+    return getattr(_tls, "session", None)
+
+
+def _session_ns() -> int:
+    """Small per-session consensus-wire namespace: 0 with no session
+    tagged, else 1 + (ordinal mod 30) — enough to catch ranks voting
+    from different sessions without outgrowing the int32 wire."""
+    o = getattr(_tls, "session_ord", None)
+    return 0 if o is None else 1 + (int(o) % 30)
+
+
+def events_for_session(name: str) -> list[dict]:
+    """Recorded recovery events tagged with serving session ``name`` —
+    the per-tenant isolation audit (tests/test_scheduler.py asserts one
+    tenant's ladder leaves its neighbors' logs empty)."""
+    return [e for e in _EVENTS if e.get("session") == name]
+
+
+# ---------------------------------------------------------------------------
 # fault injection harness
 # ---------------------------------------------------------------------------
 
 class _FaultSpec:
-    __slots__ = ("site", "rank", "nth", "kind", "fired")
+    __slots__ = ("site", "rank", "nth", "kind", "session", "fired")
 
-    def __init__(self, site: str, rank, nth, kind: str):
+    def __init__(self, site: str, rank, nth, kind: str, session=None):
         self.site = site
         self.rank = rank      # int or None (= every rank)
         self.nth = nth        # int (1-based) or None (= every occurrence)
         self.kind = kind
+        self.session = session  # str or None (= any serving session)
         self.fired = False
 
 
 _FAULTS: list[_FaultSpec] | None = None   # None = parse env on first probe
-_HITS: dict[str, int] = {}                # per-site occurrence counters
+_HITS: dict = {}    # occurrence counters: site -> n, (site, session) -> n
 
 
 def _parse_faults(spec: str) -> list[_FaultSpec]:
@@ -231,6 +285,11 @@ def _parse_faults(spec: str) -> list[_FaultSpec]:
         if not entry:
             continue
         lhs, _, kind = entry.partition("=")
+        # optional trailing @session selector: the spec fires only on a
+        # thread tagged with that serving session (exec/scheduler), and
+        # its `nth` counts against THAT session's own probe sequence
+        kind, _, session = kind.strip().partition("@")
+        session = session.strip() or None
         kind = kind.strip()
         if kind not in KINDS:
             raise ValueError(
@@ -250,8 +309,8 @@ def _parse_faults(spec: str) -> list[_FaultSpec]:
             nth = None if parts[2] == "*" else int(parts[2])
         if len(parts) > 3:
             raise ValueError(f"CYLON_TPU_FAULTS: bad entry {entry!r} "
-                             "(grammar: site[:rank][:nth]=kind)")
-        out.append(_FaultSpec(site, rank, nth, kind))
+                             "(grammar: site[:rank][:nth]=kind[@session])")
+        out.append(_FaultSpec(site, rank, nth, kind, session))
     return out
 
 
@@ -283,27 +342,62 @@ def probe(site: str) -> tuple[str | None, bool]:
     ``install_faults`` call, probes at the same program points), so
     ``armed`` is rank-UNIFORM even when ``kind`` is rank-selective.
     Guards use it to decide — coherently — whether a consensus poll is
-    needed at all."""
+    needed at all.
+
+    ``@session``-selective specs match only on a thread tagged with that
+    serving session (:func:`set_session`), and their ``nth`` counts
+    against the TARGET session's own probe sequence at the site — a
+    co-tenant's interleaved probes never shift the firing point."""
     global _FAULTS
     if _FAULTS is None:
         install_faults(None)
     if not _FAULTS:
         return None, False
     _HITS[site] = hit = _HITS.get(site, 0) + 1
+    sess = current_session()
+    sess_hit = hit
+    if sess is not None:
+        skey = (site, sess)
+        _HITS[skey] = sess_hit = _HITS.get(skey, 0) + 1
     rank = jax.process_index()
+
+    def _could_fire(f) -> bool:
+        """Could this spec still fire at this site on ANY rank?  Must be
+        computed from rank-UNIFORM state only — the per-site and
+        per-(site, session) hit counters, which advance identically on
+        every rank (same program points; scheduled sessions are
+        pick-consensus-aligned) — never from the rank-local ``fired``
+        flag: a rank+session-selective one-shot flips ``fired`` only on
+        the firing rank, and an armed flag keyed on it would diverge
+        the guards' consensus-poll gating across ranks."""
+        if f.site != site:
+            return False
+        if f.nth is None:
+            return True                      # every-occurrence: always
+        if f.session is None:
+            return f.nth >= hit              # pre-session semantics
+        if f.session == sess:
+            return f.nth >= sess_hit         # this probe included
+        # another session's spec: its NEXT probe is occurrence +1
+        return f.nth >= _HITS.get((site, f.session), 0) + 1
+
+    # armed BEFORE consuming one-shots (the firing probe itself reads
+    # as armed, exactly like the pre-session semantics)
+    armed = any(_could_fire(f) for f in _FAULTS)
     kind = None
     for f in _FAULTS:
         if f.site != site or f.fired:
             continue
         if f.rank is not None and f.rank != rank:
             continue
-        if f.nth is not None and f.nth != hit:
+        if f.session is not None and f.session != sess:
+            continue
+        if f.nth is not None and f.nth != (sess_hit if f.session is not None
+                                           else hit):
             continue
         f.fired = f.nth is not None
         kind = f.kind
         break
-    armed = any(f.site == site and (f.nth is None or f.nth >= hit)
-                for f in _FAULTS)
     return kind, armed
 
 
@@ -379,7 +473,13 @@ def _last_phase() -> str:
 def _record(site: str, kind: str, action: str) -> None:
     from ..utils import timing
     from ..utils.logging import log
-    _EVENTS.append({"site": site, "kind": kind, "action": action})
+    ev = {"site": site, "kind": kind, "action": action}
+    sess = current_session()
+    if sess is not None:
+        # serving sessions get per-tenant audit trails; the key is
+        # absent outside a scheduler so single-query logs are unchanged
+        ev["session"] = sess
+    _EVENTS.append(ev)
     timing.bump(f"recovery.{site}.{kind}.{action}")
     log.warning("recovery: %s fault at %s -> %s", kind, site, action)
 
@@ -436,9 +536,45 @@ def _consensus_wire(mesh: Mesh | None, wire: int) -> int:
                              lambda: int(np.asarray(res)[0]))
 
 
+def _ns_consensus(mesh: Mesh | None, payload: int, base: int,
+                  what: str) -> int:
+    """Max-reduce ``payload`` (< ``base``) with the serving-session
+    namespace riding ABOVE it: ``wire = ns * base + payload``.  With no
+    session tagged (ns = 0, the single-query default) this is exactly
+    the plain wire.  In a multiprocess session, an agreed wire whose
+    namespace differs from this rank's means a peer entered the poll
+    from a DIFFERENT serving session — a scheduler interleave divergence
+    — and adopting its payload would hand one tenant another tenant's
+    fault, so it raises typed instead (docs/serving.md, recovery
+    isolation).
+
+    Detection is deliberately ONE-SIDED: the max-reduce surfaces the
+    collision on every rank whose namespace is BELOW the agreed one;
+    the highest-namespace rank sees its own ns win and proceeds — until
+    its now-aborted peers leave it alone in its next collective, where
+    the exchange watchdog converts the hang into the same typed desync.
+    A ckpt-commit-style complemented second round would make detection
+    symmetric, but would double the consensus cost of EVERY guarded
+    operator in multiprocess sessions to harden a divergence the
+    scheduler's pick consensus (exec/scheduler._pick) already prevents
+    upstream; this layer is defense-in-depth, not the primary fence."""
+    ns = _session_ns()
+    agreed = _consensus_wire(mesh, ns * base + int(payload))
+    if agreed // base != ns:
+        raise RankDesyncError(
+            f"cross-session consensus collision at {what}: this rank "
+            f"voted in session namespace {ns}, the agreed wire is from "
+            f"namespace {agreed // base} — ranks are interleaving "
+            "different serving sessions", site=what, phase=_last_phase())
+    return agreed % base
+
+
 def consensus_code(mesh: Mesh | None, code: Code | int) -> Code:
-    """The agreed (max) status code across every rank of the session."""
-    return Code(_consensus_wire(mesh, int(Code(int(code)))))
+    """The agreed (max) status code across every rank of the session.
+    Session-namespaced: concurrent serving sessions' polls can never
+    silently satisfy each other (:func:`_ns_consensus`)."""
+    return Code(_ns_consensus(mesh, int(Code(int(code))), 64,
+                              "exchange.consensus"))
 
 
 def _wire_code(fault: CylonError | None) -> int:
@@ -497,16 +633,24 @@ def spill_consensus(mesh: Mesh | None, local_need: bool) -> bool:
 
 def count_consensus(mesh: Mesh | None, n: int) -> int:
     """Max-agree a small non-negative count across ranks — the spill
-    tier's eviction-COUNT wire (exec/memory.ensure_headroom): every rank
-    then evicts that many oldest candidates, so the eviction sequence is
-    identical even when a straggling GC leaves one rank's balance
-    momentarily higher.  Same transport as the ladder's code wire."""
-    return int(_consensus_wire(mesh, max(int(n), 0)))
+    tier's eviction-COUNT wire (exec/memory.ensure_headroom) and the
+    scheduler's pick-agreement wire: every rank then takes the identical
+    action, so the eviction sequence is identical even when a straggling
+    GC leaves one rank's balance momentarily higher.  Same transport as
+    the ladder's code wire, session-namespaced like it."""
+    return int(_ns_consensus(mesh, min(max(int(n), 0), (1 << 20) - 1),
+                             1 << 20, "exchange.count"))
 
 
 #: epoch field width of the checkpoint-commit wire (epochs are per-stage
 #: piece counters, far below this; the vote code rides above it)
 _CKPT_EPOCH_BASE = 1 << 20
+
+#: session-namespace base for the checkpoint wires: the payload
+#: (CkptCommit * 2^20 + epoch ≈ 50.3M max) fits under 2^26, and the
+#: namespace (≤ 30) on top stays inside the int32 pmax transport
+#: (30 * 2^26 + 50.3M ≈ 2.064e9 < 2^31)
+_CKPT_NS_BASE = 1 << 26
 
 
 def ckpt_commit_consensus(mesh: Mesh | None, epoch: int) -> int:
@@ -519,7 +663,12 @@ def ckpt_commit_consensus(mesh: Mesh | None, epoch: int) -> int:
     at the identical epoch or on none — a crash between stage and commit
     leaves only staged files, which resume ignores.  A diverging epoch
     is a structural desync (ranks checkpointing different pieces) and
-    raises typed rather than committing torn state."""
+    raises typed rather than committing torn state.  The wires are
+    session-namespaced like every other consensus (:func:`_ns_consensus`
+    at :data:`_CKPT_NS_BASE`): two serving tenants' stages commonly sit
+    at EQUAL epoch numbers, so without the namespace a rank-schedule
+    divergence could durably commit one tenant's manifest against
+    another tenant's vote."""
     epoch = int(epoch)
     if not 0 <= epoch < _CKPT_EPOCH_BASE:
         raise ValueError(f"checkpoint epoch {epoch} out of wire range")
@@ -531,9 +680,10 @@ def ckpt_commit_consensus(mesh: Mesh | None, epoch: int) -> int:
     # complement of the MIN — and every rank compares both extremes
     # against its own stage before renaming anything
     wire = int(Code.CkptCommit) * _CKPT_EPOCH_BASE + epoch
-    agreed = _consensus_wire(mesh, wire)
-    inv = _consensus_wire(mesh, int(Code.CkptCommit) * _CKPT_EPOCH_BASE
-                          + (_CKPT_EPOCH_BASE - 1 - epoch))
+    agreed = _ns_consensus(mesh, wire, _CKPT_NS_BASE, "ckpt.commit")
+    inv = _ns_consensus(mesh, int(Code.CkptCommit) * _CKPT_EPOCH_BASE
+                        + (_CKPT_EPOCH_BASE - 1 - epoch),
+                        _CKPT_NS_BASE, "ckpt.commit")
     lo = _CKPT_EPOCH_BASE - 1 - (inv % _CKPT_EPOCH_BASE)
     if agreed != wire or lo != epoch:
         raise RankDesyncError(
@@ -553,7 +703,10 @@ def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:
     fallback would leave the recomputing rank alone in the per-piece
     commit collectives.  The count rides the wire complemented so the
     pmax transport yields the min; adopting the min needs no divergence
-    check (divergence IS the input here, and min is the agreement)."""
+    check (divergence IS the input here, and min is the agreement) —
+    but the wire IS session-namespaced, so a vote arriving from another
+    serving tenant's resume surfaces typed instead of silently clamping
+    this tenant's fast-forward."""
     n = int(n)
     if not 0 <= n < _CKPT_EPOCH_BASE:
         raise ValueError(f"resume fast-forward count {n} out of wire range")
@@ -561,8 +714,9 @@ def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:
         return n
     wire = (int(Code.CkptCommit) * _CKPT_EPOCH_BASE
             + (_CKPT_EPOCH_BASE - 1 - n))
-    return _CKPT_EPOCH_BASE - 1 - (_consensus_wire(mesh, wire)
-                                   % _CKPT_EPOCH_BASE)
+    return _CKPT_EPOCH_BASE - 1 - (
+        _ns_consensus(mesh, wire, _CKPT_NS_BASE, "ckpt.resume")
+        % _CKPT_EPOCH_BASE)
 
 
 # ---------------------------------------------------------------------------
@@ -702,9 +856,12 @@ def run_with_recovery(primary, can_fallback: bool, fallback, label: str,
         rung — a rank whose local fault differs from (or lacks) the
         agreed one adopts a synthesized fault of the agreed class
         (classify() passes typed faults through, keeping ENCLOSING
-        ladders and type-dispatching callers coherent too)."""
+        ladders and type-dispatching callers coherent too).  The wire is
+        session-namespaced (_ns_consensus): one serving session's ladder
+        can never adopt a fault a peer rank voted from ANOTHER session's
+        ladder."""
         wire = _wire_code(fault)
-        agreed_w = _consensus_wire(mesh, wire) if multi else wire
+        agreed_w = _ns_consensus(mesh, wire, 1024, label) if multi else wire
         if agreed_w == 0:
             return Code.OK, None
         if fault is None or _wire_code(fault) != agreed_w:
@@ -739,7 +896,11 @@ def run_with_recovery(primary, can_fallback: bool, fallback, label: str,
         local_can = config.SPILL_ENABLED and memory.spillable_bytes() > 0
         do_spill = spill_consensus(mesh, local_can) if multi else local_can
         if do_spill:
-            memory.spill_for_retry()
+            # eviction goes through the scheduler facade (TS109): the
+            # serving tier is the one sanctioned admission/eviction
+            # mediator, so even the ladder's rung stays attributable
+            from . import scheduler
+            scheduler.spill_retry()
             from ..utils.logging import log as _log
             _record(label, kind, "spill_retry")
             _log.warning("%s %s fault; spill rung: resident state evicted "

@@ -1,0 +1,530 @@
+"""Multi-tenant serving tier: the admission-controlled concurrent query
+scheduler — many sessions, one mesh.
+
+The reference ships a push-based streaming op DAG with RoundRobin /
+Priority / ForkJoin executors and intra-process logical-rank task
+parallelism (SURVEY C9 ``ops/execution/execution.hpp:43-110``, C11
+``ArrowTaskAllToAll``) — many in-flight operators sharing one worker
+set.  Our :mod:`cylon_tpu.exec.pipeline` is that DAG for a SINGLE
+query; this module is the serving layer above it, multiplexing many
+concurrent queries (a TPC-H mix is the reference workload) over the
+substrate PRs 3–6 built:
+
+* **Admission control = the HBM ledger** (:mod:`cylon_tpu.exec.memory`,
+  PR 4).  Every submitted query carries a pack-time footprint estimate;
+  a session starts only when the running sessions' declared footprints
+  plus its own fit the mesh-wide budget (realized overruns are handled
+  at allocation time by the ledger's own consensus'd admission path).
+  Under pressure the scheduler evicts COLD tenants'
+  spillable registrations first — deterministic LRU over the shared
+  ledger, the eviction COUNT agreed over the PR 3 consensus wire
+  (:func:`cylon_tpu.exec.recovery.count_consensus`, the same transport
+  as the ``Code.SpillRequired`` vote) so every rank of a multiprocess
+  session admits and evicts identically.  A session whose footprint
+  still cannot fit WAITS (counted: ``admission_waits``); when nothing is
+  running at all, admission degrades to serial execution (the oldest
+  pending session is force-admitted) rather than deadlocking.
+
+* **Cooperative interleave at piece-loop boundaries.**  Each admitted
+  session runs on its own daemon thread, but a single BATON serializes
+  device dispatch: exactly one session runs between interleave points
+  (:func:`maybe_yield` — called by the pipelined range loop per piece,
+  the chunked set-op loop per chunk, and every hash shuffle), so each
+  query sees the single-controller engine semantics every operator was
+  built under, while the PR 6 overlap scheduler keeps the device busy
+  ACROSS tenants: piece r of tenant A is still executing (async
+  dispatch) while tenant B's next piece is being enqueued.
+
+* **Pluggable policy**: ``fifo`` (arrival order, run-to-completion),
+  ``priority`` (highest priority first, arrival order within), ``fair``
+  (weighted fair share — the runnable session with the smallest
+  ``attributed dispatch seconds / weight``, from the per-session
+  :class:`~cylon_tpu.utils.timing.AttributionScope`, runs next; equal
+  weights degenerate to round-robin).  In multiprocess sessions the
+  pick is agreed over the consensus wire (max ordinal), so wall-clock
+  skew between ranks cannot fork the schedule.
+
+* **Shared plan cache**: :func:`cylon_tpu.utils.cache.program_cache`
+  lives on the mesh, so tenants running the same plan shapes pay each
+  compile once — no per-tenant program duplication (asserted in
+  tests/test_scheduler.py).
+
+* **Per-session recovery isolation** (:mod:`cylon_tpu.exec.recovery`):
+  the session thread is tagged (``set_session``), so recovery events
+  carry the tenant, fault injection targets tenants (``@session``
+  grammar), consensus codes ride a session namespace (a rank voting
+  from another tenant's ladder surfaces as a typed desync, never as a
+  silently adopted foreign fault), checkpoint stage sequences are
+  per-session, and the ladder's escalation depth is thread-local — one
+  tenant's retry ladder or ``ResumableAbort`` cannot poison another's.
+
+**TS109 — scheduler-mediated admission.**  This module (and the ledger
+itself) is the ONE sanctioned caller of the ledger's admission/eviction
+entry points (``ensure_headroom`` / ``try_free`` / ``spill_for_retry``
+/ ``evict_n`` / ``evict_until``).  Operators route allocations through
+:func:`admit_allocation`, guards through :func:`free_pressure`, the
+retry ladder through :func:`spill_retry` — so per-tenant footprints,
+admission waits and cross-tenant evictions stay attributed in one
+place.  A direct ledger call anywhere else is a lint finding
+(docs/trace_safety.md).
+
+Happy path contract: with no scheduler active, :func:`maybe_yield` is
+one module-global load, and the facades add one thread-local read over
+the raw ledger calls — single-query workloads are unchanged.
+
+See docs/serving.md for the full admission contract, fairness
+semantics, interleave points and isolation rules.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import config
+from ..status import InvalidError
+from .session import DONE, FAILED, PENDING, RUNNING, QuerySession
+
+#: the active scheduler — at most one per process; read by maybe_yield
+#: on every interleave point, so the no-scheduler fast path is one load
+_ACTIVE: "QueryScheduler | None" = None
+
+_tls = threading.local()   # .session: the QuerySession on this thread
+
+
+def current_session() -> QuerySession | None:
+    """The serving session running on THIS thread, or None."""
+    return getattr(_tls, "session", None)
+
+
+def maybe_yield() -> None:
+    """Cooperative interleave point — piece-loop boundaries call this.
+
+    Outside a scheduler (or on a non-session thread) it is a no-op.  On
+    a session thread it hands the baton back to the scheduler, which
+    picks the next session per policy; the call returns when this
+    session is granted its next slice.  Async device work this session
+    already dispatched keeps executing while it waits — that is the
+    cross-tenant overlap the serving tier exists for."""
+    sched = _ACTIVE
+    if sched is None:
+        return
+    sess = current_session()
+    if sess is None or sess.state != RUNNING:
+        return
+    sched._yield_turn(sess)
+
+
+# ---------------------------------------------------------------------------
+# the sanctioned admission/eviction facades (lint rule TS109)
+# ---------------------------------------------------------------------------
+
+def admit_allocation(env, need: int, scratch: int = 0,
+                     site: str = "spill.evict", reuse: int = 0) -> None:
+    """Admission for a new resident allocation of ``need`` bytes — the
+    operator-facing entry (PieceSource pack admission).  Attributes the
+    bytes to the current serving session, then routes to the ledger's
+    consensus-coherent admission path
+    (:func:`cylon_tpu.exec.memory.ensure_headroom`): under budget
+    pressure, cold tenants' spillable registrations evict first,
+    identically on every rank."""
+    from . import memory
+    sess = current_session()
+    if sess is not None:
+        sess.bytes_admitted += int(need)
+    memory.ensure_headroom(env, need, scratch=scratch, site=site,
+                           reuse=reuse)
+
+
+def free_pressure(need: int) -> int:
+    """Best-effort eviction of ``need`` bytes of headroom at a guard
+    call site (the exchange receive-budget guard).  Returns bytes freed;
+    0 when the ledger is already under budget or in multiprocess
+    sessions (where eviction is taken exclusively on the consensus'd
+    admission path)."""
+    from . import memory
+    if memory.over_budget(int(need)):
+        return memory.try_free(int(need))
+    return 0
+
+
+def spill_retry() -> int:
+    """The retry ladder's spill rung, scheduler-mediated: evict every
+    spillable resident registration (all tenants — the rung is a
+    last-resort pressure release and spill round-trips are bit-exact),
+    returning bytes freed."""
+    from . import memory
+    return memory.spill_for_retry()
+
+
+def estimate_footprint(*tables, factor: float = 2.0) -> int:
+    """Pack-time HBM footprint estimate for a query over ``tables``
+    (Tables or DataFrames): resident column bytes (data + validity),
+    scaled by ``factor`` for packed lane matrices + piece scratch.  An
+    ESTIMATE by design — admission gates on it, execution gates on the
+    ledger's exact accounting."""
+    total = 0
+    for t in tables:
+        t = getattr(t, "_table", t)
+        for c in t.columns.values():
+            total += int(c.data.nbytes)
+            if c.validity is not None:
+                total += int(c.validity.nbytes)
+    return int(total * float(factor))
+
+
+# ---------------------------------------------------------------------------
+# scheduling policies
+# ---------------------------------------------------------------------------
+
+def _fifo_key(s: QuerySession):
+    return s.ordinal
+
+
+def _priority_key(s: QuerySession):
+    return (-s.priority, s.ordinal)
+
+
+def _fair_key(s: QuerySession):
+    # primary clock: attributed dispatch seconds (utils/timing scope);
+    # sessions whose work never enters a timed region tie at 0 there, so
+    # granted-slice wall time breaks the tie before arrival order does
+    return (s.attributed_s() / s.weight, s.service_s / s.weight,
+            s.ordinal)
+
+
+POLICIES = {"fifo": _fifo_key, "priority": _priority_key,
+            "fair": _fair_key}
+
+
+# ---------------------------------------------------------------------------
+# the scheduler
+# ---------------------------------------------------------------------------
+
+class QueryScheduler:
+    """Admission-controlled concurrent query scheduler over one mesh.
+
+    Usage::
+
+        sched = QueryScheduler(env, policy="fair")
+        a = sched.submit("tenant_a", qa, footprint_bytes=fa)
+        b = sched.submit("tenant_b", qb, footprint_bytes=fb, weight=2.0)
+        sched.run()                      # interleaves until all done
+        a.result, a.summary()
+
+    ``budget_bytes`` overrides the ledger budget for ADMISSION decisions
+    only (the ledger's own allocation-time budget stays
+    ``CYLON_TPU_HBM_BUDGET``/platform-detected); ``max_concurrency``
+    caps simultaneously admitted sessions independently of memory.
+    ``run`` drives the baton loop on the calling thread and returns the
+    session list; failed sessions carry their exception in ``.error``
+    (pass ``raise_errors=True`` to re-raise the first one)."""
+
+    def __init__(self, env, policy: str = "fair",
+                 budget_bytes: int | None = None,
+                 max_concurrency: int | None = None):
+        if policy not in POLICIES:
+            raise InvalidError(
+                f"unknown scheduling policy {policy!r}; one of "
+                f"{sorted(POLICIES)}")
+        self.env = env
+        self.policy = policy
+        self._key = POLICIES[policy]
+        self.budget_bytes = budget_bytes
+        self.max_concurrency = max_concurrency
+        self.sessions: list[QuerySession] = []
+        self._control = threading.Event()
+        self._abort = False
+        self._forced_admissions = 0
+        self._scheduler_evictions = 0
+
+    # -- submission --------------------------------------------------------
+    def submit(self, name: str, fn, *, footprint_bytes: int = 0,
+               priority: int = 0, weight: float = 1.0,
+               tenant: str | None = None) -> QuerySession:
+        """Queue one query.  ``fn`` is a zero-arg callable executed on
+        the session's thread under the baton; its return value lands in
+        ``session.result``.  ``footprint_bytes`` is the pack-time HBM
+        estimate admission gates on (:func:`estimate_footprint`)."""
+        if any(s.name == name for s in self.sessions):
+            raise InvalidError(f"duplicate session name {name!r}")
+        sess = QuerySession(name, fn, len(self.sessions),
+                            footprint_bytes=footprint_bytes,
+                            priority=priority, weight=weight, tenant=tenant)
+        self.sessions.append(sess)
+        return sess
+
+    # -- the baton loop ----------------------------------------------------
+    def run(self, raise_errors: bool = False) -> list[QuerySession]:
+        global _ACTIVE
+        if _ACTIVE is not None:
+            raise InvalidError(
+                "a QueryScheduler is already serving this process")
+        if not self.sessions:
+            return []
+        self._abort = False   # run() is re-enterable: a completed run's
+        #                       abort latch must not fail later submits
+        _ACTIVE = self
+        try:
+            self._loop()
+        finally:
+            # abort protocol: parked sessions wake, see _abort and RAISE
+            # at their yield point (-> FAILED, thread exits) — they must
+            # never free-run concurrently without the baton, which would
+            # break the single-controller semantics every operator
+            # assumes.  _ACTIVE stays set until the threads drain.
+            self._abort = True
+            for s in self.sessions:
+                if s.state == RUNNING:
+                    s._grant.set()     # release any thread still parked
+            for s in self.sessions:
+                if s._thread is not None:
+                    s._thread.join(timeout=60.0)
+            _ACTIVE = None
+        if raise_errors:
+            for s in self.sessions:
+                if s.error is not None:
+                    raise s.error
+        return list(self.sessions)
+
+    def _loop(self) -> None:
+        while True:
+            self._admit_pending()
+            running = [s for s in self.sessions if s.state == RUNNING]
+            if not running:
+                if any(s.state == PENDING for s in self.sessions):
+                    # nothing running AND the head cannot fit even after
+                    # eviction: degrade to serial execution rather than
+                    # starve (docs/serving.md admission contract)
+                    self._force_admit()
+                    continue
+                return
+            self._grant_slice(self._pick(running))
+
+    # -- admission ---------------------------------------------------------
+    def _budget(self) -> int:
+        from . import memory
+        if self.budget_bytes is not None:
+            return int(self.budget_bytes)
+        return memory.budget_bytes()
+
+    def _fits(self, sess: QuerySession) -> bool:
+        """Admission predicate: the candidate's DECLARED footprint on
+        top of the running sessions' declared footprints must fit the
+        budget.  Declared, not realized: admission happens BEFORE a
+        query packs anything (the ledger balance alone would admit
+        everyone up front), and realized pressure from estimates that
+        were wrong is already handled at allocation time by the
+        ledger's own admission path (``ensure_headroom`` evicts/spills
+        with consensus) — gating here on the process-global balance
+        would also leak unrelated residents into every decision."""
+        b = self._budget()
+        if b <= 0:
+            return True
+        committed = sum(s.footprint_bytes for s in self.sessions
+                        if s.state == RUNNING)
+        return committed + sess.footprint_bytes <= b
+
+    def _multi(self) -> bool:
+        import jax
+        return (getattr(self.env, "mesh", None) is not None
+                and jax.process_count() > 1)
+
+    def _evict_for(self, sess: QuerySession) -> None:
+        """Clear REALIZED residue for an admission: evict cold tenants'
+        spillable registrations down to the budget before the admitted
+        session allocates anything — deterministic LRU over the shared
+        ledger, count agreed across ranks (the Code.SpillRequired
+        family's wire) so every rank evicts the same owners in the same
+        order."""
+        from . import memory, recovery
+        if not config.SPILL_ENABLED:
+            return
+        b = self._budget()
+        if b <= 0:
+            return
+        want = memory.ledger().evict_count_for(sess.footprint_bytes, b)
+        if self._multi():
+            want = recovery.count_consensus(self.env.mesh, want)
+        if want <= 0:
+            return
+        evicted = memory.ledger().evict_n(want)
+        if evicted:
+            self._scheduler_evictions += len(evicted)
+            from ..utils.logging import log
+            log.info("scheduler: evicted %s to admit session %s "
+                     "(footprint %d B)", evicted, sess.name,
+                     sess.footprint_bytes)
+
+    def _admit_pending(self) -> None:
+        while True:
+            pend = [s for s in self.sessions if s.state == PENDING]
+            if not pend:
+                return
+            running = [s for s in self.sessions if s.state == RUNNING]
+            if (self.max_concurrency is not None
+                    and len(running) >= self.max_concurrency):
+                self._note_wait(pend)
+                return
+            cand = min(pend, key=self._key)
+            if not self._fits(cand):
+                # head-of-line admission (no overtaking): deterministic
+                # and starvation-free — smaller later queries never
+                # leapfrog a waiting tenant
+                self._note_wait([cand])
+                return
+            # the declared footprint fits; clear REALIZED residue first
+            # — cold tenants' spillable registrations (or estimates
+            # that ran over) evict down to make room before the new
+            # session allocates anything
+            self._evict_for(cand)
+            self._start(cand)
+
+    def _note_wait(self, sessions) -> None:
+        now = time.perf_counter()
+        for s in sessions:
+            if s._wait_mark is None:
+                s._wait_mark = now
+                s.admission_waits += 1
+
+    def _force_admit(self) -> None:
+        pend = [s for s in self.sessions if s.state == PENDING]
+        cand = min(pend, key=self._key)
+        self._forced_admissions += 1
+        from ..utils.logging import log
+        log.warning("scheduler: nothing running and session %s "
+                    "(footprint %d B) cannot fit the budget — force-"
+                    "admitting; execution degrades to the ledger's own "
+                    "spill tier", cand.name, cand.footprint_bytes)
+        self._start(cand)
+
+    def _start(self, sess: QuerySession) -> None:
+        now = time.perf_counter()
+        if sess._wait_mark is not None:
+            sess.admission_wait_s += now - sess._wait_mark
+            sess._wait_mark = None
+        sess.state = RUNNING
+        sess.started_s = now
+        t = threading.Thread(target=self._session_body, args=(sess,),
+                             name=f"cylon-session-{sess.name}", daemon=True)
+        sess._thread = t
+        t.start()
+
+    # -- baton -------------------------------------------------------------
+    def _session_body(self, sess: QuerySession) -> None:
+        from ..utils import timing
+        from . import recovery
+        _tls.session = sess
+        recovery.set_session(sess.name, sess.ordinal)
+        sess._grant.wait()
+        sess._grant.clear()
+        sess._slice_t0 = time.perf_counter()
+        try:
+            if self._abort:
+                # the scheduler aborted before this session's first
+                # slice: fail it rather than free-run without the baton
+                # (the same abort protocol _yield_turn enforces)
+                from ..status import ExecutionError
+                raise ExecutionError(
+                    f"serving scheduler aborted before session "
+                    f"{sess.name} ran")
+            with timing.attribution_scope(sess.name) as scope:
+                sess.timing = scope
+                sess.result = sess.fn()
+            sess.state = DONE
+        except BaseException as e:  # noqa: BLE001 — isolated per session
+            sess.error = e
+            sess.state = FAILED
+        finally:
+            sess.service_s += time.perf_counter() - sess._slice_t0
+            sess.slices += 1
+            sess.finished_s = time.perf_counter()
+            recovery.set_session(None, None)
+            _tls.session = None
+            self._control.set()
+
+    def _yield_turn(self, sess: QuerySession) -> None:
+        """Session side of the baton (runs on the session thread).  On
+        scheduler abort the session FAILS at its yield point instead of
+        free-running without the baton — concurrent unsupervised
+        sessions would violate the single-controller semantics the
+        engine assumes."""
+        from ..status import ExecutionError
+        if self._abort:
+            raise ExecutionError(
+                f"serving scheduler aborted while session {sess.name} "
+                "was in flight")
+        t_park = time.perf_counter()
+        sess.service_s += t_park - sess._slice_t0
+        sess.slices += 1
+        self._control.set()
+        sess._grant.wait()
+        sess._grant.clear()
+        sess._slice_t0 = time.perf_counter()
+        # time parked at the baton is co-tenants' work, not this
+        # session's: regions spanning this yield must not absorb it
+        # (utils/timing scope exclusion — the no-bleed invariant)
+        from ..utils import timing
+        timing.exclude_from_scope(sess._slice_t0 - t_park)
+        if self._abort:
+            raise ExecutionError(
+                f"serving scheduler aborted while session {sess.name} "
+                "was parked at a yield point")
+
+    def _pick(self, running: list[QuerySession]) -> QuerySession:
+        sess = min(running, key=self._key)
+        if self._multi():
+            # policy inputs like fair-share clocks are wall-time and NOT
+            # rank-uniform: agree the pick (max ordinal wins) so every
+            # rank grants the identical session — the serving analog of
+            # the ladder's code consensus
+            from . import recovery
+            from ..status import RankDesyncError
+            agreed = recovery.count_consensus(self.env.mesh, sess.ordinal)
+            for s in running:
+                if s.ordinal == agreed:
+                    return s
+            # a pick this rank cannot honor means session STATES have
+            # already diverged across ranks; granting a local fallback
+            # would dispatch different tenants' collectives per rank —
+            # surface the divergence typed, at the point it is detected
+            raise RankDesyncError(
+                f"scheduler pick consensus chose session ordinal "
+                f"{agreed}, which is not running on this rank "
+                f"(running: {[s.ordinal for s in running]}) — session "
+                "states diverged across ranks", site="scheduler.pick")
+        return sess
+
+    def _grant_slice(self, sess: QuerySession) -> None:
+        self._control.clear()
+        sess._grant.set()
+        while not self._control.wait(timeout=60.0):
+            t = sess._thread
+            if t is None or not t.is_alive():
+                if sess.state == RUNNING:   # died without signaling
+                    sess.state = FAILED
+                    sess.error = RuntimeError(
+                        f"session {sess.name} thread died mid-slice")
+                return
+
+    # -- reporting ---------------------------------------------------------
+    def stats(self) -> dict:
+        """Serving-tier counters for bench JSON detail (per-session
+        detail rides each session's ``summary()``)."""
+        from . import memory
+        mem = memory.stats()
+        return {
+            "policy": self.policy,
+            "sessions": len(self.sessions),
+            "completed": sum(1 for s in self.sessions if s.state == DONE),
+            "failed": sum(1 for s in self.sessions if s.state == FAILED),
+            "admission_waits": sum(s.admission_waits
+                                   for s in self.sessions),
+            "admission_wait_s": round(sum(s.admission_wait_s
+                                          for s in self.sessions), 4),
+            "forced_admissions": self._forced_admissions,
+            "scheduler_evictions": self._scheduler_evictions,
+            "cross_session_evictions": mem["cross_session_evictions"],
+            "spill_events": mem["spill_events"],
+            "slices": sum(s.slices for s in self.sessions),
+        }

@@ -1,0 +1,130 @@
+"""One tenant's query session — the unit the serving scheduler admits,
+interleaves and audits (:mod:`cylon_tpu.exec.scheduler`).
+
+A :class:`QuerySession` wraps a query thunk (any callable running
+against the shared mesh — a TPC-H query over the DataFrame API, a
+pipelined join + sink, an arbitrary plan) together with everything the
+serving tier needs to multiplex it safely against its neighbors:
+
+* **admission inputs** — the pack-time HBM ``footprint_bytes`` estimate
+  the scheduler checks against the mesh-wide ledger budget before the
+  session may start, plus the ``priority``/``weight`` knobs the
+  scheduling policies read;
+* **isolation state** — the session's own
+  :class:`~cylon_tpu.utils.timing.AttributionScope` (per-tenant phase
+  table, no cross-tenant bleed) and its recovery identity
+  (:func:`cylon_tpu.exec.recovery.set_session` on the session thread:
+  tagged events, ``@session``-selective fault injection, namespaced
+  consensus wires, per-session checkpoint stage sequences);
+* **serving metrics** — admission wait count/seconds, granted slices,
+  accumulated service seconds, end-to-end latency.
+
+Sessions execute on their own daemon thread, but only ONE session runs
+between interleave points at a time (the scheduler's baton — see
+scheduler module docstring for why), so the session sees exactly the
+single-threaded engine semantics every operator was built under.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+#: session lifecycle states
+PENDING = "pending"      # submitted, not yet admitted
+RUNNING = "running"      # admitted; thread live (may be waiting for turn)
+DONE = "done"            # fn returned; result holds the return value
+FAILED = "failed"        # fn raised; error holds the exception
+
+
+class QuerySession:
+    """One submitted query's handle.  Created by
+    :meth:`cylon_tpu.exec.scheduler.QueryScheduler.submit`; read-only
+    for callers (the scheduler owns the state transitions)."""
+
+    def __init__(self, name: str, fn, ordinal: int, *,
+                 footprint_bytes: int = 0, priority: int = 0,
+                 weight: float = 1.0, tenant: str | None = None):
+        if "/" in name or name != name.strip() or not name:
+            raise ValueError(
+                f"session name {name!r} must be a non-empty path-safe "
+                "token (it namespaces checkpoint stage directories)")
+        self.name = name
+        self.fn = fn
+        self.ordinal = int(ordinal)
+        self.footprint_bytes = int(footprint_bytes)
+        self.priority = int(priority)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError("session weight must be > 0")
+        self.tenant = tenant or name
+        self.state = PENDING
+        self.result = None
+        self.error: BaseException | None = None
+        #: per-tenant phase table (utils.timing.AttributionScope); set
+        #: when the session thread starts
+        self.timing = None
+        # serving metrics
+        self.admission_waits = 0
+        self.admission_wait_s = 0.0
+        self.bytes_admitted = 0    # allocation bytes routed through
+        #                            scheduler.admit_allocation (TS109)
+        self.slices = 0
+        self.service_s = 0.0       # granted-slice wall time, accumulated
+        self.submitted_s = time.perf_counter()
+        self.started_s: float | None = None
+        self.finished_s: float | None = None
+        # baton machinery (scheduler-owned)
+        self._thread: threading.Thread | None = None
+        self._grant = threading.Event()
+        self._slice_t0 = 0.0
+        self._wait_mark: float | None = None  # admission-wait start
+
+    # -- derived metrics ---------------------------------------------------
+    @property
+    def latency_s(self) -> float | None:
+        """Submit-to-finish wall seconds (None while unfinished)."""
+        if self.finished_s is None:
+            return None
+        return self.finished_s - self.submitted_s
+
+    def attributed_s(self) -> float:
+        """Accumulated device-dispatch seconds from the session's timing
+        scope — the weighted-fair-share policy's ordering key.  Falls
+        back to granted-slice wall time before the scope exists."""
+        if self.timing is not None:
+            return self.timing.total_seconds()
+        return self.service_s
+
+    # -- isolation audits --------------------------------------------------
+    def recovery_events(self) -> list[dict]:
+        """Recovery events recorded under THIS session's tag — the
+        per-tenant isolation audit (empty for a clean run; another
+        tenant's ladder never appears here)."""
+        from . import recovery
+        return recovery.events_for_session(self.name)
+
+    def phase_snapshot(self) -> dict:
+        """The session's private phase table (same shape as
+        ``utils.timing.snapshot``), or {} before the session started."""
+        return self.timing.snapshot() if self.timing is not None else {}
+
+    def summary(self) -> dict:
+        """Serving metrics for bench JSON detail."""
+        return {
+            "name": self.name, "tenant": self.tenant, "state": self.state,
+            "priority": self.priority, "weight": self.weight,
+            "footprint_bytes": self.footprint_bytes,
+            "admission_waits": self.admission_waits,
+            "admission_wait_s": round(self.admission_wait_s, 4),
+            "bytes_admitted": self.bytes_admitted,
+            "slices": self.slices,
+            "service_s": round(self.service_s, 4),
+            "latency_s": (round(self.latency_s, 4)
+                          if self.latency_s is not None else None),
+            "recovery_events": self.recovery_events(),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"QuerySession({self.name!r}, state={self.state}, "
+                f"slices={self.slices})")

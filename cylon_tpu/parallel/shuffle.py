@@ -313,22 +313,23 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
         # rank-uniform (replicated counts) and `armed` is rank-uniform by
         # construction (recovery.probe) — so the un-injected happy path
         # adds no collective and no host sync to the exchange.
-        from ..exec import memory, recovery
+        from ..exec import recovery, scheduler
         need = out_cap * row_bytes
         # HBM-ledger consult (exec/memory): the predicted receive is an
         # allocation ON TOP of the resident balance the ledger tracks —
         # and unlike the static receive budget, ledger pressure is
         # CURABLE: cold spillable owners (packed piece sources — sink
         # partials and receive buffers are accounting-only) evict to
-        # host BEFORE the allocation.  Single-controller only
-        # (try_free no-ops in multiprocess sessions, where eviction is
-        # taken exclusively on the consensus'd admission path), and the
-        # raise/consensus predicate below stays EXACTLY the replicated
-        # count-sidecar one: a ledger balance read is rank-uniform only
-        # up to GC release timing, so gating the consensus poll on it
-        # would risk the very desync this guard exists to prevent.
-        if memory.over_budget(need):
-            memory.try_free(need)
+        # host BEFORE the allocation.  Routed through the serving tier's
+        # facade (scheduler.free_pressure, lint rule TS109); still
+        # single-controller only (the underlying try_free no-ops in
+        # multiprocess sessions, where eviction is taken exclusively on
+        # the consensus'd admission path), and the raise/consensus
+        # predicate below stays EXACTLY the replicated count-sidecar
+        # one: a ledger balance read is rank-uniform only up to GC
+        # release timing, so gating the consensus poll on it would risk
+        # the very desync this guard exists to prevent.
+        scheduler.free_pressure(need)
         over_budget = bool(
             on_accel
             and need > config.EXCHANGE_RECV_BUDGET_BYTES)

@@ -235,7 +235,11 @@ class PieceSource:
                 donated += [a for a in (*lane_datas, *valids)
                             if a is not None]
             reuse = sum(int(a.nbytes) for a in donated)
-        memory.ensure_headroom(
+        # admission is SCHEDULER-mediated (lint rule TS109): the serving
+        # tier attributes the bytes to the current tenant before routing
+        # to the ledger's consensus-coherent admission path
+        from ..exec import scheduler
+        scheduler.admit_allocation(
             self.env, rows * memory.spec_row_bytes(self.spec),
             scratch=int(scratch_bytes), reuse=reuse)
         arrs = []

@@ -129,6 +129,11 @@ def shuffle_table(table: Table, key_names) -> Table:
     """Redistribute rows so equal keys land on the same shard (hash
     partitioning, reference MapToHashPartitions + ArrowAllToAll)."""
     env = table.env
+    # every distributed op shuffles, so this is the serving tier's
+    # coarse interleave point for monolithic (non-pipelined) plans —
+    # a no-op outside a scheduler (docs/serving.md)
+    from ..exec import scheduler
+    scheduler.maybe_yield()
     if env.world_size == 1:
         return table
     keys = [table.column(n) for n in key_names]

@@ -1,5 +1,5 @@
-"""TPC-H subset: data generator + a 13-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q10 Q12 Q14 Q16 Q18 Q19 Q21 Q22).
+"""TPC-H subset: data generator + a 16-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q14 Q15 Q16 Q17 Q18 Q19 Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -15,8 +15,10 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   (filter -> merge -> arithmetic -> groupby -> sort -> head), exactly how
   a user would port them — together they cover join+conditional-agg
   (Q14), groupby-HAVING semi-join (Q18), disjunctive multi-attribute
-  filters (Q19) and — round 5 — the NOT-EXISTS family on true SEMI/ANTI
-  joins (Q16 Q21 Q22);
+  filters (Q19), the round-5 NOT-EXISTS family on true SEMI/ANTI joins
+  (Q16 Q21 Q22), and — round 7, for the serving tier's mixed-traffic
+  plan shapes — scalar-subquery HAVING (Q11), an aggregate view with a
+  scalar-max equi-select (Q15) and a correlated-avg subquery (Q17);
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -187,6 +189,12 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
         np.char.add(cntry.astype(np.str_), "-555-"),
         np.arange(n_cust).astype(np.str_))
     customer["c_cntrycode"] = cntry.astype(np.int64)
+    # Q11 addition (round 7) draws from a FOURTH independent stream so
+    # every earlier table/column stays byte-identical (same regression-
+    # baseline rule as the rng2/rng3 blocks above)
+    rng4 = np.random.default_rng(seed + 15485863)
+    partsupp["ps_supplycost"] = np.round(
+        rng4.uniform(1.0, 1000.0, len(ps_partkey)), 2)
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
             "part": part, "partsupp": partsupp}
@@ -820,6 +828,129 @@ def q22_pandas(pdfs: dict,
 
 
 # ---------------------------------------------------------------------------
+# Q11 — important stock identification (scalar-subquery HAVING)
+# ---------------------------------------------------------------------------
+
+def q11(dfs: dict, env=None, nation: str = "GERMANY",
+        fraction: float = 0.0001):
+    """SELECT ps_partkey, sum(ps_supplycost*ps_availqty) AS value FROM
+    partsupp, supplier, nation WHERE ps_suppkey = s_suppkey AND
+    s_nationkey = n_nationkey AND n_name = :nation GROUP BY ps_partkey
+    HAVING value > :fraction * (SELECT sum(...) same predicate) ORDER BY
+    value DESC.  The scalar subquery is the filtered aggregate's own
+    total — computed once, threaded through as a host scalar."""
+    n = dfs["nation"]
+    n = n[n["n_name"] == nation]
+    s = dfs["supplier"].merge(n, left_on="s_nationkey",
+                              right_on="n_nationkey", env=env)
+    ps = dfs["partsupp"].merge(s[["s_suppkey"]], left_on="ps_suppkey",
+                               right_on="s_suppkey", env=env)
+    ps["value"] = ps["ps_supplycost"] * ps["ps_availqty"].astype("float64")
+    g = ps.groupby(["ps_partkey"], env=env)[["value"]].sum()
+    total = float(g["value"].sum())
+    out = g[g["value"] > fraction * total]
+    return out.sort_values(["value", "ps_partkey"],
+                           ascending=[False, True],
+                           env=env)[["ps_partkey", "value"]]
+
+
+def q11_pandas(pdfs: dict, nation: str = "GERMANY",
+               fraction: float = 0.0001) -> pd.DataFrame:
+    n = pdfs["nation"]
+    n = n[n.n_name == nation]
+    s = pdfs["supplier"].merge(n, left_on="s_nationkey",
+                               right_on="n_nationkey")
+    ps = pdfs["partsupp"].merge(s[["s_suppkey"]], left_on="ps_suppkey",
+                                right_on="s_suppkey")
+    ps = ps.assign(value=ps.ps_supplycost * ps.ps_availqty.astype(np.float64))
+    g = ps.groupby("ps_partkey", as_index=False)["value"].sum()
+    total = float(g.value.sum())
+    g = g[g.value > fraction * total]
+    return g.sort_values(["value", "ps_partkey"],
+                         ascending=[False, True])[
+        ["ps_partkey", "value"]].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q15 — top supplier (aggregate view + scalar max equi-select)
+# ---------------------------------------------------------------------------
+
+def q15(dfs: dict, env=None, date_lo: str = "1996-01-01",
+        date_hi: str = "1996-04-01"):
+    """WITH revenue AS (SELECT l_suppkey AS supplier_no,
+    sum(l_extendedprice*(1-l_discount)) AS total_revenue FROM lineitem
+    WHERE l_shipdate >= :lo AND l_shipdate < :hi GROUP BY l_suppkey)
+    SELECT s_suppkey, s_name, total_revenue FROM supplier, revenue WHERE
+    s_suppkey = supplier_no AND total_revenue = (SELECT max(...) FROM
+    revenue) ORDER BY s_suppkey.  The equi-select compares the view's
+    own values against its own max — exact by construction."""
+    l = dfs["lineitem"]
+    l = l[(l["l_shipdate"] >= _ts(date_lo))
+          & (l["l_shipdate"] < _ts(date_hi))]
+    l["total_revenue"] = l["l_extendedprice"] * (1.0 - l["l_discount"])
+    rev = l.groupby(["l_suppkey"], env=env)[["total_revenue"]].sum()
+    top = float(rev["total_revenue"].max())
+    best = rev[rev["total_revenue"] >= top]
+    j = dfs["supplier"].merge(best, left_on="s_suppkey",
+                              right_on="l_suppkey", env=env)
+    return j.sort_values("s_suppkey", env=env)[
+        ["s_suppkey", "s_name", "total_revenue"]]
+
+
+def q15_pandas(pdfs: dict, date_lo: str = "1996-01-01",
+               date_hi: str = "1996-04-01") -> pd.DataFrame:
+    l = pdfs["lineitem"]
+    l = l[(l.l_shipdate >= pd.Timestamp(date_lo))
+          & (l.l_shipdate < pd.Timestamp(date_hi))].copy()
+    l["total_revenue"] = l.l_extendedprice * (1.0 - l.l_discount)
+    rev = l.groupby("l_suppkey", as_index=False)["total_revenue"].sum()
+    top = float(rev.total_revenue.max())
+    best = rev[rev.total_revenue >= top]
+    j = pdfs["supplier"].merge(best, left_on="s_suppkey",
+                               right_on="l_suppkey")
+    return j.sort_values("s_suppkey")[
+        ["s_suppkey", "s_name", "total_revenue"]].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q17 — small-quantity-order revenue (correlated avg subquery)
+# ---------------------------------------------------------------------------
+
+def q17(dfs: dict, env=None, brand: str = "Brand#23",
+        container: str = "MED BOX") -> float:
+    """SELECT sum(l_extendedprice) / 7.0 AS avg_yearly FROM lineitem,
+    part WHERE p_partkey = l_partkey AND p_brand = :brand AND
+    p_container = :container AND l_quantity < (SELECT 0.2*avg(l_quantity)
+    FROM lineitem WHERE l_partkey = p_partkey).  The correlated avg
+    decomposes into a per-part groupby mean joined back onto the lines
+    (reference pattern: DistributedHashGroupBy then DistributedJoin)."""
+    p = dfs["part"]
+    p = p[(p["p_brand"] == brand)
+          & (p["p_container"] == container)][["p_partkey"]]
+    j = dfs["lineitem"].merge(p, left_on="l_partkey", right_on="p_partkey",
+                              env=env)
+    avg = j.groupby(["l_partkey"], env=env).agg([("l_quantity", "mean")])
+    j2 = j.merge(avg, on="l_partkey", env=env)
+    f = j2[j2["l_quantity"].astype("float64")
+           < j2["l_quantity_mean"] * 0.2]
+    return float(f["l_extendedprice"].sum()) / 7.0
+
+
+def q17_pandas(pdfs: dict, brand: str = "Brand#23",
+               container: str = "MED BOX") -> float:
+    p = pdfs["part"]
+    p = p[(p.p_brand == brand)
+          & (p.p_container == container)][["p_partkey"]]
+    j = pdfs["lineitem"].merge(p, left_on="l_partkey",
+                               right_on="p_partkey")
+    avg = (j.groupby("l_partkey", as_index=False)
+           .agg(l_quantity_mean=("l_quantity", "mean")))
+    j2 = j.merge(avg, on="l_partkey")
+    f = j2[j2.l_quantity.astype(np.float64) < j2.l_quantity_mean * 0.2]
+    return float(f.l_extendedprice.sum()) / 7.0
+
+
+# ---------------------------------------------------------------------------
 # bench entry (bench.py --tpch)
 # ---------------------------------------------------------------------------
 
@@ -831,7 +962,7 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
     deploy story for SF10+ is a pod slice, deploy/README.md)."""
     import jax
 
-    from cylon_tpu.exec import checkpoint, memory, recovery
+    from cylon_tpu.exec import checkpoint, recovery, scheduler
     from cylon_tpu.status import Code, PredictedResourceExhausted
     # the detail block reports THIS bench invocation's recoveries only
     # (including failed-attempt events from the halving loop below)
@@ -850,7 +981,7 @@ def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
                 raise
             predicted = isinstance(fault, PredictedResourceExhausted)
             if predicted and scale not in spilled_scales \
-                    and memory.spill_for_retry() > 0:
+                    and scheduler.spill_retry() > 0:
                 # prefer the SPILL rung over in-process scale-halving:
                 # a predicted guard fired pre-allocation (HBM clean), so
                 # evicting resident state to host and retrying at the
@@ -927,8 +1058,9 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q10": q10, "q12": q12, "q14": q14, "q16": q16, "q18": q18,
-               "q19": q19, "q21": q21, "q22": q22}
+               "q10": q10, "q11": q11, "q12": q12, "q14": q14, "q15": q15,
+               "q16": q16, "q17": q17, "q18": q18, "q19": q19, "q21": q21,
+               "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"

@@ -25,11 +25,29 @@ work, and the caller blocks once at iteration end (bench.py's final
 output sync).  Phase numbers then read as "host time to dispatch": a
 phase that stops dominating dispatch has genuinely left the critical
 path.  Exact per-phase device attribution still needs ``block`` mode.
+
+**Session-scoped attribution.**  The serving tier
+(:mod:`cylon_tpu.exec.scheduler`) interleaves many tenants' queries on
+one mesh, and a single process-global table would blend their phases —
+tenant A's ``pipe.piece_join`` seconds indistinguishable from tenant
+B's.  :func:`attribution_scope` opens a PRIVATE phase table routed by
+thread identity: every :func:`region`/:func:`bump`/:func:`add_bytes` on
+the scoped thread also lands in the scope's table (regions time
+unconditionally inside a scope, independent of ``CYLON_TPU_BENCH`` —
+the fair-share policy needs per-session dispatch seconds even in
+production runs).  Scopes on different threads are DISJOINT by
+construction — no cross-tenant attribution bleed — while the
+process-global table keeps accumulating the union exactly as before
+(``bench.py``'s snapshot is unchanged).  :func:`last_region` is
+likewise scope-local when a scope is active, so a watchdog fault raised
+on one tenant's thread carries that tenant's phase breadcrumb, not a
+neighbor's.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 from .. import config
@@ -42,17 +60,103 @@ _ACCUM: dict[str, list] = {}
 #: timings off: one list-slot store per region)
 _LAST_REGION = [""]
 
+#: per-thread stack of active AttributionScopes (serving sessions run on
+#: their own threads, so thread identity IS session identity here)
+_SCOPE_TLS = threading.local()
+
+
+class AttributionScope:
+    """One session's private phase table — see module docstring.  Obtain
+    via :func:`attribution_scope`; read with :meth:`snapshot` (same shape
+    as the module-level :func:`snapshot`) and :meth:`total_seconds` (the
+    fair-share policy's accumulated-dispatch-time input)."""
+
+    __slots__ = ("tag", "last", "_accum", "_bytes", "_excluded")
+
+    def __init__(self, tag: str = ""):
+        self.tag = tag
+        self.last = ""
+        self._accum: dict[str, list] = {}
+        self._bytes: dict[str, int] = {}
+        #: cumulative seconds this thread spent parked at the serving
+        #: baton (scheduler._yield_turn) — subtracted from any region
+        #: whose window contains the park, so a yield INSIDE a region
+        #: (join.shuffle, pipe.consume) never charges co-tenants' slices
+        #: to this scope's phase table or fair-share clock
+        self._excluded = 0.0
+
+    def _add(self, name: str, dt: float, n: int = 1) -> None:
+        acc = self._accum.setdefault(name, [0.0, 0])
+        acc[0] += dt
+        acc[1] += n
+
+    def _add_bytes(self, name: str, nbytes: int) -> None:
+        self._bytes[name] = self._bytes.get(name, 0) + int(nbytes)
+        self._accum.setdefault(name, [0.0, 0])
+
+    def total_seconds(self) -> float:
+        return sum(v[0] for v in self._accum.values())
+
+    def snapshot(self) -> dict:
+        out = {}
+        for k, v in sorted(self._accum.items(), key=lambda kv: -kv[1][0]):
+            ent = {"s": round(v[0], 4), "n": v[1]}
+            if self._bytes.get(k):
+                ent["b"] = self._bytes[k]
+            out[k] = ent
+        return out
+
+
+def _scope() -> AttributionScope | None:
+    stack = getattr(_SCOPE_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+def exclude_from_scope(seconds: float) -> None:
+    """Mark ``seconds`` of the current thread's wall time as NOT this
+    scope's work — the serving scheduler calls this with the time a
+    session spent parked at the baton, so regions spanning a yield point
+    attribute only the tenant's own dispatch time (no co-tenant bleed
+    into phase tables or the fair-share clock).  No-op outside a
+    scope."""
+    sc = _scope()
+    if sc is not None:
+        sc._excluded += float(seconds)
+
+
+@contextlib.contextmanager
+def attribution_scope(tag: str = ""):
+    """Route this THREAD's regions/bumps/byte attributions into a private
+    :class:`AttributionScope` (in addition to the process-global table)
+    until exit.  Nested scopes shadow (innermost wins).  Yields the
+    scope; its table survives the exit for later snapshots."""
+    sc = AttributionScope(tag)
+    stack = getattr(_SCOPE_TLS, "stack", None)
+    if stack is None:
+        stack = _SCOPE_TLS.stack = []
+    stack.append(sc)
+    try:
+        yield sc
+    finally:
+        stack.pop()
+
 
 @contextlib.contextmanager
 def region(name: str, block=None):
-    """Time a named region (when ``config.BENCH_TIMINGS``).  ``block`` may be
+    """Time a named region (when ``config.BENCH_TIMINGS`` — or always,
+    scope-locally, inside an :func:`attribution_scope`).  ``block`` may be
     a jax array (or pytree leaf list) to block_until_ready before stopping
     the clock, charging async device work to this region."""
-    _LAST_REGION[0] = name
-    if not config.BENCH_TIMINGS:
+    sc = _scope()
+    if sc is not None:
+        sc.last = name
+    else:
+        _LAST_REGION[0] = name
+    if not config.BENCH_TIMINGS and sc is None:
         yield
         return
     t0 = time.perf_counter()
+    ex0 = sc._excluded if sc is not None else 0.0
     try:
         yield
     finally:
@@ -60,9 +164,15 @@ def region(name: str, block=None):
             import jax
             jax.block_until_ready(block)
         dt = time.perf_counter() - t0
-        acc = _ACCUM.setdefault(name, [0.0, 0])
-        acc[0] += dt
-        acc[1] += 1
+        if config.BENCH_TIMINGS:
+            acc = _ACCUM.setdefault(name, [0.0, 0])
+            acc[0] += dt
+            acc[1] += 1
+        if sc is not None:
+            # baton-park time that fell inside this region's window is
+            # not this tenant's work (exclude_from_scope); the cumulative
+            # counter nets out correctly under nesting
+            sc._add(name, max(dt - (sc._excluded - ex0), 0.0))
 
 
 #: snapshot-key suffix marking a BLOCKING host-sync region — the
@@ -118,7 +228,13 @@ def maybe_block(x) -> None:
 
 def last_region() -> str:
     """Name of the most recently entered region ("" before the first) —
-    the failure-recovery watchdog's last-known-phase breadcrumb."""
+    the failure-recovery watchdog's last-known-phase breadcrumb.  Inside
+    an :func:`attribution_scope` this is the SCOPE's last region, so a
+    fault on one serving session's thread never reports a co-tenant's
+    phase."""
+    sc = _scope()
+    if sc is not None:
+        return sc.last
     return _LAST_REGION[0]
 
 
@@ -129,6 +245,9 @@ def bump(name: str) -> None:
     be countable even without ``CYLON_TPU_BENCH``."""
     acc = _ACCUM.setdefault(name, [0.0, 0])
     acc[1] += 1
+    sc = _scope()
+    if sc is not None:
+        sc._add(name, 0.0)
 
 
 #: name -> bytes moved, the spill tier's phase attribution: seconds alone
@@ -144,6 +263,9 @@ def add_bytes(name: str, nbytes: int) -> None:
     :func:`snapshot` entries."""
     _BYTES[name] = _BYTES.get(name, 0) + int(nbytes)
     _ACCUM.setdefault(name, [0.0, 0])
+    sc = _scope()
+    if sc is not None:
+        sc._add_bytes(name, nbytes)
 
 
 def reset() -> None:

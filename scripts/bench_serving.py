@@ -1,0 +1,325 @@
+"""Closed-loop multi-tenant TPC-H serving bench (exec/scheduler).
+
+The ROADMAP's "millions of users" rung measured: N tenants share ONE
+mesh, each running a closed loop over its own TPC-H query mix (a new
+query is issued the moment the previous one finishes), multiplexed by
+the admission-controlled scheduler — tenants interleave at piece-loop /
+shuffle boundaries, the HBM ledger is the admission controller, cold
+tenants' packed sources spill under pressure, and every tenant's result
+must stay BIT-EQUAL to its solo (single-session) run.
+
+What one run produces (``SERVING_r01.json`` alongside the BENCH_r0x
+series):
+
+* per-tenant p50/p99 query latency, queries and rows/s served;
+* aggregate rows/s across the mix;
+* admission waits (count + seconds) and cross-tenant eviction / spill /
+  recovery event counts — was the number achieved on the happy path or
+  under managed pressure?
+* a ``bit_equal`` verdict: sha256 over every query result vs the solo
+  pass (the acceptance criterion; a serving tier that changes answers
+  under load is not a serving tier).
+
+The default budget ("auto") is sized to ~2.2 tenants' footprints so a
+4-tenant run exercises BOTH acceptance events: later tenants wait at
+admission until earlier ones drain, and concurrent packers evict each
+other's cold sources through the consensus'd admission path.
+
+Usage::
+
+    python scripts/bench_serving.py                    # 4 tenants
+    python scripts/bench_serving.py --tenants 6 --queries 4 \
+        --policy fair --budget-mb 24 --out SERVING_r02.json
+
+Exit status 0 = completed and bit-equal; 1 otherwise.  A trimmed run is
+wired as a slow-marked test (tests/test_scheduler.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import struct
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+#: per-tenant query mixes, cycled tenant i -> MIXES[i % len(MIXES)].
+#: ``qpipe`` is the pipelined join+sink workload (piece-loop interleave
+#: + spillable PieceSource registrations — the tenants that exercise
+#: admission pressure); the rest are tpch.py queries (monolithic plans,
+#: interleaving at shuffle boundaries).
+MIXES = [
+    ("qpipe", "q6", "q1"),
+    ("qpipe", "q12"),
+    ("q3", "q14", "q15"),
+    ("q5", "q17"),
+    ("q1", "q19", "q11"),
+    ("qpipe", "q22"),
+]
+
+#: tables each query reads — the rows/s numerator and the footprint
+#: estimate's input set
+QUERY_TABLES = {
+    "q1": ("lineitem",), "q3": ("customer", "orders", "lineitem"),
+    "q5": ("customer", "orders", "lineitem", "supplier", "nation",
+           "region"),
+    "q6": ("lineitem",), "q11": ("partsupp", "supplier", "nation"),
+    "q12": ("orders", "lineitem"), "q14": ("lineitem", "part"),
+    "q15": ("lineitem", "supplier"), "q17": ("lineitem", "part"),
+    "q19": ("lineitem", "part"), "q22": ("customer", "orders"),
+    "qpipe": ("orders", "lineitem"),
+}
+
+
+def _result_sha(out) -> str:
+    """sha256 over a query result's raw bytes (frames sorted by their
+    columns first so row order is canonical).  Deliberately NOT shared
+    with chaos_soak's hash helper: that one hashes pre-sorted frames of
+    one fixed schema, this one must canonicalize arbitrary query
+    outputs (row order, column names, float scalars) — the digests are
+    only ever compared within this script."""
+    import numpy as np
+    h = hashlib.sha256()
+    if isinstance(out, float):
+        h.update(struct.pack("<d", out))
+        return h.hexdigest()
+    df = out.to_pandas() if hasattr(out, "to_pandas") else out
+    df = df.sort_values(list(df.columns)).reset_index(drop=True)
+    for col in df.columns:
+        h.update(str(col).encode())
+        h.update(np.ascontiguousarray(df[col].to_numpy()).tobytes())
+    return h.hexdigest()
+
+
+def _make_qpipe(env, dfs):
+    """The pipelined sink workload: orders ⋈ lineitem per order key,
+    quantity/price sums — runs through pipelined_join's range loop, so
+    the tenant yields per piece and its PieceSource registrations are
+    the spillable state the admission controller manages."""
+    from cylon_tpu.exec import GroupBySink, pipelined_join
+
+    def qpipe(dfs_, env_=None):
+        sink = GroupBySink("l_orderkey", [("l_quantity", "sum"),
+                                          ("l_extendedprice", "sum")])
+        pipelined_join(dfs_["lineitem"]._table, dfs_["orders"]._table,
+                       "l_orderkey", "o_orderkey", how="inner",
+                       n_chunks=4, sink=sink)
+        return sink.finalize()
+    return qpipe
+
+
+def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record):
+    """Closed loop: cycle the mix for ``queries`` iterations, recording
+    (query, latency, sha) into ``record`` as each completes."""
+    def fn():
+        for k in range(queries):
+            qname = mix[k % len(mix)]
+            t0 = time.perf_counter()
+            out = qfuncs[qname](dfs, env_=env) if qname == "qpipe" \
+                else qfuncs[qname](dfs, env=env)
+            if hasattr(out, "to_pandas"):
+                out = out.to_pandas()
+            record.append({"q": qname,
+                           "latency_s": time.perf_counter() - t0,
+                           "sha": _result_sha(out)})
+        return len(record)
+    return fn
+
+
+def _percentile(xs, p):
+    import numpy as np
+    return float(np.percentile(np.asarray(xs, float), p)) if xs else None
+
+
+def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
+                policy: str = "fair", budget_mb=None, world: int = 4,
+                seed: int = 0) -> dict:
+    """Drive the bench in-process and return the report dict (the CLI
+    wraps this; tests call it directly with trimmed parameters).
+    ``budget_mb``: None = unlimited (no pressure), "auto" = ~2.2 tenant
+    footprints (the acceptance configuration), or explicit MiB."""
+    import jax
+    import cylon_tpu as ct
+    from cylon_tpu import config, tpch
+    from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
+    from cylon_tpu.exec import checkpoint, memory, recovery
+    from cylon_tpu.exec.scheduler import (QueryScheduler,
+                                          estimate_footprint)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    env = ct.CylonEnv(config=TPUConfig() if on_accel
+                      else CPUMeshConfig(world_size=world))
+    dfs = tpch.generate_tables(scale=scale, env=env, seed=seed)
+    row_counts = {k: int(v._table.row_count) for k, v in dfs.items()}
+
+    qfuncs = {k: getattr(tpch, k) for k in
+              {q for mix in MIXES for q in mix} - {"qpipe"}}
+    qfuncs["qpipe"] = _make_qpipe(env, dfs)
+
+    plans = []
+    for i in range(int(tenants)):
+        mix = MIXES[i % len(MIXES)]
+        foot = estimate_footprint(
+            *[dfs[t] for t in sorted({t for q in mix
+                                      for t in QUERY_TABLES[q]})])
+        plans.append({"name": f"t{i}", "mix": mix, "footprint": foot})
+
+    # ---- solo pass: the bit-equality oracle -----------------------------
+    solo = {}
+    for p in plans:
+        rec: list = []
+        _tenant_fn(p["name"], p["mix"], queries, dfs, env, qfuncs, rec)()
+        solo[p["name"]] = rec
+
+    # ---- concurrent pass ------------------------------------------------
+    # Budgets under "auto" (the acceptance configuration): the SCHEDULER
+    # budget admits the two smallest tenants together and makes the
+    # third wait (admission gates on declared footprints); the LEDGER
+    # budget is 1.6x one measured qpipe resident peak, so two
+    # concurrently packing tenants must evict each other's cold sources
+    # through the consensus'd admission path.
+    ledger_budget = 0
+    if budget_mb == "auto":
+        foots = sorted(p["footprint"] for p in plans)
+        budget = int(1.05 * (foots[0] + foots[1])) if len(foots) > 2 \
+            else int(2.2 * foots[-1])
+        memory.reset_stats()
+        qfuncs["qpipe"](dfs, env_=env)
+        peak = memory.stats()["peak_ledger_bytes"]
+        ledger_budget = int(1.6 * peak) if peak else 0
+    elif budget_mb is None:
+        budget = 0
+    else:
+        budget = int(float(budget_mb) * (1 << 20))
+        ledger_budget = budget
+    prev_budget = config.HBM_BUDGET_BYTES
+    memory.reset_stats()
+    recovery.reset_events()
+    checkpoint.reset_stats()
+    records: dict[str, list] = {p["name"]: [] for p in plans}
+    sched = QueryScheduler(env, policy=policy,
+                           budget_bytes=budget or None)
+    if ledger_budget:
+        # the ledger's own allocation-time admission (PieceSource pack)
+        # gates on the config budget
+        config.HBM_BUDGET_BYTES = ledger_budget
+    try:
+        for p in plans:
+            sched.submit(p["name"],
+                         _tenant_fn(p["name"], p["mix"], queries, dfs,
+                                    env, qfuncs, records[p["name"]]),
+                         footprint_bytes=p["footprint"])
+        t0 = time.perf_counter()
+        sessions = sched.run()
+        elapsed = time.perf_counter() - t0
+    finally:
+        config.HBM_BUDGET_BYTES = prev_budget
+
+    # ---- verdicts + metrics ---------------------------------------------
+    failures = []
+    for s in sessions:
+        if s.error is not None:
+            failures.append(f"{s.name}: {type(s.error).__name__}: "
+                            f"{s.error}")
+    bit_equal = True
+    for p in plans:
+        got = records[p["name"]]
+        want = solo[p["name"]]
+        if len(got) != len(want) or any(
+                g["sha"] != w["sha"] or g["q"] != w["q"]
+                for g, w in zip(got, want)):
+            bit_equal = False
+            failures.append(f"{p['name']}: concurrent results diverged "
+                            "from the solo run")
+
+    per_tenant = {}
+    total_rows = 0
+    for s in sessions:
+        rec = records[s.name]
+        lats = [r["latency_s"] for r in rec]
+        rows = sum(sum(row_counts[t] for t in QUERY_TABLES[r["q"]])
+                   for r in rec)
+        total_rows += rows
+        per_tenant[s.name] = {
+            "mix": list(next(p["mix"] for p in plans
+                             if p["name"] == s.name)),
+            "queries": len(rec),
+            "p50_latency_s": round(_percentile(lats, 50) or 0, 4),
+            "p99_latency_s": round(_percentile(lats, 99) or 0, 4),
+            **{k: v for k, v in s.summary().items()
+               if k not in ("name", "tenant", "state")},
+        }
+
+    mem = memory.stats()
+    report = {
+        "metric": f"TPC-H SF{scale:g} serving mix, {tenants} tenants "
+                  f"x {queries} queries ({policy})",
+        "value": round(total_rows / elapsed, 1) if elapsed else 0.0,
+        "unit": "rows/s aggregate",
+        "vs_baseline": 0.0,
+        "detail": {
+            "world": env.world_size,
+            "platform": jax.devices()[0].platform,
+            "scale": scale, "policy": policy,
+            "budget_bytes": budget,
+            "ledger_budget_bytes": ledger_budget,
+            "elapsed_s": round(elapsed, 4),
+            "queries_total": sum(len(r) for r in records.values()),
+            "queries_per_s": round(
+                sum(len(r) for r in records.values()) / elapsed, 3)
+            if elapsed else 0.0,
+            "bit_equal": bit_equal,
+            "failures": failures,
+            "scheduler": sched.stats(),
+            "spill": {k: mem[k] for k in
+                      ("spill_events", "bytes_spilled", "readmit_events",
+                       "cross_session_evictions", "peak_ledger_bytes")},
+            "recovery_events": recovery.drain_events(),
+            "tenants": per_tenant,
+        },
+    }
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--queries", type=int, default=4,
+                    help="closed-loop queries per tenant")
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--policy", default="fair",
+                    choices=["fifo", "priority", "fair"])
+    ap.add_argument("--budget-mb", default="auto",
+                    help='"auto" (acceptance pressure), "none", or MiB')
+    ap.add_argument("--world", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "SERVING_r01.json"))
+    args = ap.parse_args()
+
+    budget = None if args.budget_mb in ("none", "0") else args.budget_mb
+    report = run_serving(tenants=args.tenants, queries=args.queries,
+                         scale=args.scale, policy=args.policy,
+                         budget_mb=budget, world=args.world,
+                         seed=args.seed)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    d = report["detail"]
+    print(f"# {report['metric']}: {report['value']} {report['unit']}")
+    print(f"# bit_equal={d['bit_equal']} "
+          f"admission_waits={d['scheduler']['admission_waits']} "
+          f"cross_session_evictions="
+          f"{d['spill']['cross_session_evictions']} "
+          f"spill_events={d['spill']['spill_events']}")
+    print(f"# wrote {args.out}")
+    return 0 if (d["bit_equal"] and not d["failures"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

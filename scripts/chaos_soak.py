@@ -26,13 +26,23 @@ so the acceptance paths run on every seed; the rest are drawn from
 which has the overlapped scheduler on — every drawn schedule therefore
 also soaks deferred-fault re-raising (exec/pipeline._PieceFuture).
 
+``--concurrent K`` switches to the MULTI-TENANT acceptance flow
+(exec/scheduler): K differently-seeded serving sessions interleave on
+one mesh; the pinned schedule SIGKILLs the process mid-query in tenant
+t0 only (the ``@session`` injector grammar, per-session occurrence
+counting), and the resumed rerun must fast-forward t0's committed
+pieces while EVERY tenant's answer stays bit-equal to its solo
+(single-session) run — crash isolation under multi-tenancy.
+
 Usage::
 
     python scripts/chaos_soak.py --seed 7                 # 20 schedules
     python scripts/chaos_soak.py --seed 7 --schedules 4 --rows 1500
+    python scripts/chaos_soak.py --concurrent 3 --rows 2000
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
-runs in CI as a slow-marked test (tests/test_checkpoint.py).
+runs in CI as a slow-marked test (tests/test_checkpoint.py); the
+concurrent flow as a slow-marked test in tests/test_scheduler.py.
 """
 
 from __future__ import annotations
@@ -74,6 +84,14 @@ RESUMABLE_EXIT = 17
 # worker: one workload run in this process (spawned by the parent)
 # ---------------------------------------------------------------------------
 
+def _result_sha(df) -> str:
+    import numpy as np
+    h = hashlib.sha256()
+    for col in sorted(df.columns):
+        h.update(np.ascontiguousarray(df[col].to_numpy()).tobytes())
+    return h.hexdigest()
+
+
 def worker(args) -> int:
     import numpy as np
 
@@ -88,27 +106,36 @@ def worker(args) -> int:
 
     # TPC-H-shaped: orders ⋈ lineitem on the order key, aggregated per
     # order — integer "money" so every retry/restore path is exactly
-    # bit-comparable.  Seeded: the resumed process rebuilds the
-    # identical inputs, which is what makes the stage plan tokens match.
-    rng = np.random.default_rng(20260803)
-    n_ord = max(args.rows // 4, 64)
-    n_line = args.rows
-    orders = ct.Table.from_pydict(
-        {"o_orderkey": np.arange(n_ord, dtype=np.int64),
-         "o_shippriority": rng.integers(0, 5, n_ord).astype(np.int64)}, env)
-    lineitem = ct.Table.from_pydict(
-        {"l_orderkey": rng.integers(0, n_ord, n_line).astype(np.int64),
-         "l_quantity": rng.integers(1, 51, n_line).astype(np.int64),
-         "l_extendedprice": rng.integers(900_00, 10_500_00,
-                                         n_line).astype(np.int64)}, env)
+    # bit-comparable.  Seeded (per tenant): the resumed process rebuilds
+    # the identical inputs, which is what makes the stage plan tokens
+    # match.
+    def make_workload(seed: int, rows: int):
+        def attempt(nc):
+            rng = np.random.default_rng(seed)
+            n_ord = max(rows // 4, 64)
+            orders = ct.Table.from_pydict(
+                {"o_orderkey": np.arange(n_ord, dtype=np.int64),
+                 "o_shippriority": rng.integers(0, 5,
+                                                n_ord).astype(np.int64)},
+                env)
+            lineitem = ct.Table.from_pydict(
+                {"l_orderkey": rng.integers(0, n_ord,
+                                            rows).astype(np.int64),
+                 "l_quantity": rng.integers(1, 51, rows).astype(np.int64),
+                 "l_extendedprice": rng.integers(900_00, 10_500_00,
+                                                 rows).astype(np.int64)},
+                env)
+            sink = GroupBySink("l_orderkey", [("l_quantity", "sum"),
+                                              ("l_extendedprice", "sum")])
+            pipelined_join(lineitem, orders, "l_orderkey", "o_orderkey",
+                           how="inner", n_chunks=nc, sink=sink)
+            return sink.finalize()
+        return attempt
 
-    def attempt(nc):
-        sink = GroupBySink("l_orderkey", [("l_quantity", "sum"),
-                                          ("l_extendedprice", "sum")])
-        pipelined_join(lineitem, orders, "l_orderkey", "o_orderkey",
-                       how="inner", n_chunks=nc, sink=sink)
-        return sink.finalize()
+    if args.concurrent > 1:
+        return _worker_concurrent(args, env, make_workload)
 
+    attempt = make_workload(20260803, args.rows)
     try:
         out = recovery.run_with_recovery(
             lambda: attempt(args.chunks), True, attempt, "soak", env=env)
@@ -119,13 +146,59 @@ def worker(args) -> int:
         return RESUMABLE_EXIT
 
     df = out.to_pandas().sort_values("l_orderkey").reset_index(drop=True)
-    h = hashlib.sha256()
-    for col in sorted(df.columns):
-        h.update(np.ascontiguousarray(df[col].to_numpy()).tobytes())
     print(json.dumps({
-        "ok": True, "sha": h.hexdigest(), "rows": int(len(df)),
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
         "events": len(recovery.recovery_events()),
         "event_list": recovery.recovery_events(),
+        **checkpoint.stats(),
+    }), flush=True)
+    return 0
+
+
+def _worker_concurrent(args, env, make_workload) -> int:
+    """K concurrent serving sessions over one mesh (exec/scheduler), each
+    a differently-seeded pipelined join+sink tenant.  ``--only i``
+    restricts to one tenant — the SOLO leg whose sha is the concurrent
+    run's bit-equality oracle.  Faults target tenants with the
+    ``@session`` grammar (``ckpt.write::2=kill@t0``); a kill takes the
+    whole process down and the parent reruns with CYLON_TPU_RESUME=1 —
+    the per-session checkpoint stage namespace then fast-forwards the
+    killed tenant while every tenant's answer stays bit-equal to its
+    solo run."""
+    from cylon_tpu.exec import checkpoint, recovery
+    from cylon_tpu.exec.scheduler import QueryScheduler
+    from cylon_tpu.status import ResumableAbort
+
+    def make_fn(i: int):
+        attempt = make_workload(20260803 + 7919 * i, args.rows)
+
+        def fn():
+            out = recovery.run_with_recovery(
+                lambda: attempt(args.chunks), True, attempt,
+                f"soak.t{i}", env=env)
+            return out.to_pandas().sort_values("l_orderkey") \
+                .reset_index(drop=True)
+        return fn
+
+    sched = QueryScheduler(env, policy="fair")
+    idxs = [i for i in range(args.concurrent)
+            if args.only is None or i == args.only]
+    for i in idxs:
+        sched.submit(f"t{i}", make_fn(i))
+    sessions = sched.run()
+    shas, events = {}, {}
+    for s in sessions:
+        if isinstance(s.error, ResumableAbort):
+            print(json.dumps({"resumable": True, "token": s.error.token,
+                              "session": s.name}), flush=True)
+            return RESUMABLE_EXIT
+        if s.error is not None:
+            raise s.error
+        shas[s.name] = _result_sha(s.result)
+        events[s.name] = s.recovery_events()
+    print(json.dumps({
+        "ok": True, "shas": shas, "session_events": events,
+        "events": len(recovery.recovery_events()),
         **checkpoint.stats(),
     }), flush=True)
     return 0
@@ -195,7 +268,8 @@ def _pinned_schedules() -> list[dict]:
 
 
 def _spawn(args, workdir: str, faults: str, resume: bool,
-           extra_env: dict | None = None) -> tuple:
+           extra_env: dict | None = None, concurrent: int = 1,
+           only: int | None = None) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env["JAX_PLATFORMS"] = "cpu"
@@ -208,7 +282,10 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
     else:
         env.pop("CYLON_TPU_RESUME", None)
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
-           f"--rows={args.rows}", f"--chunks={args.chunks}"]
+           f"--rows={args.rows}", f"--chunks={args.chunks}",
+           f"--concurrent={concurrent}"]
+    if only is not None:
+        cmd.append(f"--only={only}")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -264,6 +341,67 @@ def _run_schedule(args, idx: int, sched: dict, baseline_sha: str,
     shutil.rmtree(workdir, ignore_errors=True)
 
 
+def run_concurrent(args) -> int:
+    """The ``--concurrent K`` acceptance flow: K serving sessions on one
+    mesh, a mid-query SIGKILL targeted at tenant t0 (``@session``
+    grammar), and a resumed rerun that must (a) fast-forward t0 past its
+    committed pieces (ffwd > 0) and (b) leave EVERY tenant's answer
+    bit-equal to its solo (single-session) run — crash isolation under
+    multi-tenancy, not just under a single query."""
+    K = args.concurrent
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_conc_")
+    failures: list = []
+
+    # solo legs: each tenant alone on the mesh — the bit-equality oracle
+    solo_shas: dict = {}
+    for i in range(K):
+        p, info = _spawn(args, os.path.join(args.workdir, f"solo{i}"),
+                         "", resume=False, concurrent=K, only=i)
+        if p.returncode != 0 or not info or not info.get("shas"):
+            print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+            print(f"chaos-soak: solo leg t{i} failed", file=sys.stderr)
+            return 1
+        solo_shas.update(info["shas"])
+    print(f"# concurrent acceptance: {K} tenants, solo shas "
+          f"{ {k: v[:12] for k, v in solo_shas.items()} }", flush=True)
+
+    # un-injected concurrent run: interleaving alone must not change
+    # any tenant's answer
+    ckdir = os.path.join(args.workdir, "conc")
+    p, info = _spawn(args, ckdir, "", resume=False, concurrent=K)
+    if p.returncode != 0 or not info or info.get("shas") != solo_shas:
+        failures.append(f"un-injected concurrent run diverged: {info}")
+
+    # the pinned kill schedule: SIGKILL mid-query in tenant t0 after its
+    # 2nd committed piece; every tenant dies with the process
+    killdir = os.path.join(args.workdir, "kill")
+    p, info = _spawn(args, killdir, "ckpt.write::2=kill@t0",
+                     resume=False, concurrent=K)
+    if p.returncode not in (-9, RESUMABLE_EXIT):
+        failures.append(
+            f"targeted kill did not crash the process (rc={p.returncode})")
+    else:
+        p2, info2 = _spawn(args, killdir, "", resume=True, concurrent=K)
+        if p2.returncode != 0 or not info2:
+            failures.append(f"concurrent resume failed rc={p2.returncode}:"
+                            f" {(p2.stdout + p2.stderr)[-2000:]}")
+        elif info2.get("shas") != solo_shas:
+            failures.append(f"resumed concurrent result diverged: {info2}")
+        elif not info2.get("resume_fast_forwarded_pieces"):
+            failures.append(
+                f"resume recomputed t0's committed pieces: {info2}")
+        else:
+            print(f"# kill@t0 + resume -> ok (ffwd="
+                  f"{info2['resume_fast_forwarded_pieces']})", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"concurrent": K, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -272,11 +410,21 @@ def main() -> int:
     ap.add_argument("--chunks", type=int, default=4)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--concurrent", type=int, default=1,
+                    help="K>1: run the K-tenant concurrent acceptance "
+                         "flow (kill one tenant mid-query, resume, "
+                         "assert every tenant bit-equal to its solo run)")
+    ap.add_argument("--only", type=int, default=None,
+                    help="(worker) restrict the concurrent scheduler to "
+                         "one tenant — the solo bit-equality leg")
     args = ap.parse_args()
 
     if args.worker:
         sys.path.insert(0, REPO)
         return worker(args)
+
+    if args.concurrent > 1:
+        return run_concurrent(args)
 
     import numpy as np
     rng = np.random.default_rng(args.seed)

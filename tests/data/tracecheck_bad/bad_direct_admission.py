@@ -1,0 +1,27 @@
+"""TS109 fixture: direct ledger admission/eviction calls outside the
+serving scheduler (``cylon_tpu/exec/scheduler.py``) and the ledger
+module itself (``cylon_tpu/exec/memory.py``).  Admission must be
+scheduler-mediated — ``scheduler.admit_allocation`` / ``free_pressure``
+/ ``spill_retry`` — so the multi-tenant serving tier's per-session
+footprint attribution, admission-wait accounting and cross-tenant
+eviction bookkeeping see every decision (docs/serving.md)."""
+
+
+def pack_without_scheduler(env, memory, nbytes):
+    # TS109: an operator admitting its own allocation bypasses the
+    # serving tier's footprint attribution
+    memory.ensure_headroom(env, nbytes)
+
+
+def guard_without_scheduler(memory, need):
+    # TS109: rank-local eviction shortcut taken behind the scheduler
+    memory.try_free(need)
+    # TS109: the ladder's spill rung invoked directly
+    return memory.spill_for_retry()
+
+
+def evict_by_hand(ledger, budget):
+    # TS109: hand-rolled LRU eviction forks the eviction order away
+    # from the consensus'd admission path
+    ledger.evict_n(2)
+    ledger.evict_until(1 << 20, budget)

@@ -232,6 +232,30 @@ def test_findings_carry_file_and_line():
 # the gate starts green: the whole package lints clean
 # ---------------------------------------------------------------------------
 
+def test_ts109_direct_admission_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_direct_admission.py")) if f.rule == "TS109"]
+    # ensure_headroom, try_free, spill_for_retry, evict_n, evict_until
+    assert len(found) == 5
+    assert all("scheduler-mediated" in f.message for f in found)
+
+
+def test_ts109_sanctioned_modules_exempt():
+    src = ("def admit(env, memory, n):\n"
+           "    memory.ensure_headroom(env, n)\n"
+           "    memory.try_free(n)\n")
+    # the serving scheduler and the ledger itself are the two sanctioned
+    # callers; anywhere else in the package fires
+    assert not any(f.rule == "TS109" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/scheduler.py", src))
+    assert not any(f.rule == "TS109" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/memory.py", src))
+    assert any(f.rule == "TS109" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/piece.py", src))
+    assert any(f.rule == "TS109" for f in ast_lint.lint_source(
+        "cylon_tpu/tpch.py", src))
+
+
 def test_package_lints_clean():
     found = ast_lint.lint_paths([PKG])
     assert found == [], "\n".join(map(str, found))
@@ -240,7 +264,8 @@ def test_package_lints_clean():
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
-                                       "TS105", "TS106", "TS107", "TS108"}
+                                       "TS105", "TS106", "TS107", "TS108",
+                                       "TS109"}
 
 
 # ---------------------------------------------------------------------------
