@@ -58,6 +58,17 @@ function within the same module) — and flags:
   statically visible (a variable ``donate=donate``) are not tracked —
   the rule under-approximates, like the rest of this pass.
 
+* **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
+  a GroupBySink's private partial state written or list-mutated
+  directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
+  bypassing the absorb/snapshot API desynchronizes every live
+  incremental view's ``read()`` — or the window-lifetime ledger entry
+  points (``register_window``/``evict_release``) called outside the
+  stream package, bypassing the watermark close lifecycle
+  (device → host → released) whose accounting the streaming bench's
+  eviction deltas assert.  The defining modules (``exec/pipeline.py``,
+  ``exec/memory.py``) are exempt by construction.
+
 The pass is heuristic by design (a linter, not a verifier): it
 under-approximates taint (module-local call graph only) and exempts
 provably-static derivations; residual false positives are silenced with
@@ -113,6 +124,19 @@ _ADMISSION_OK_FILES = ("exec/scheduler.py", "exec/memory.py")
 _DONATE_DIRS = ("relational", "exec")
 #: keyword names that declare donated positions on a builder/jit call
 _DONATE_KWS = {"donate", "donate_argnums"}
+
+#: streaming state owned by the stream package (TS110): a GroupBySink's
+#: private partial-aggregate state — mutating it outside the sink's own
+#: absorb/snapshot API desynchronizes every live streaming view's
+#: ``read()`` from the rows actually absorbed — and the window-lifetime
+#: ledger entry points, whose close lifecycle (device → host →
+#: released) is what makes ``memory.stats()`` describe reality.  The
+#: defining modules (exec/pipeline.py for the sink, exec/memory.py for
+#: the ledger) are exempt by construction.
+_SINK_STATE_ATTRS = {"_parts", "_regs", "_adopted", "_pending"}
+_SINK_MUTATORS = {"append", "extend", "insert", "clear", "pop", "remove"}
+_WINDOW_LIFETIME_FUNCS = {"register_window", "evict_release"}
+_STREAM_OK_FILES = ("exec/pipeline.py", "exec/memory.py")
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -375,6 +399,7 @@ class _ModuleLint:
         self._check_ckpt_artifacts()
         self._check_use_after_donate()
         self._check_direct_admission()
+        self._check_stream_state()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -562,6 +587,55 @@ class _ModuleLint:
                     "admit_allocation / free_pressure / spill_retry) so "
                     "per-tenant footprints, admission waits and cross-"
                     "tenant evictions stay attributed and rank-coherent")
+
+    def _check_stream_state(self) -> None:
+        """TS110: streaming state transitions outside the stream package
+        — (a) a write (or list mutation) of a GroupBySink's private
+        partial state (``X._parts`` / ``X._regs`` / ``X._adopted`` /
+        ``X._pending``) bypasses the absorb/snapshot API that keeps a
+        live view's ``read()`` consistent with the rows absorbed; (b) a
+        call of the window-lifetime ledger entry points
+        (``register_window`` / ``evict_release``) bypasses the
+        watermark-close lifecycle (device → host → released) that drains
+        the ledger.  Sanctioned: ``cylon_tpu/stream/`` plus the defining
+        modules (exec/pipeline.py, exec/memory.py)."""
+        norm = self.path.replace(os.sep, "/")
+        if "stream" in norm.split("/") or norm.endswith(_STREAM_OK_FILES):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and tgt.attr in _SINK_STATE_ATTRS):
+                        self._emit(
+                            "TS110", node,
+                            f"write to sink partial state `.{tgt.attr}` "
+                            "outside cylon_tpu/stream/ — mutate through "
+                            "the GroupBySink absorb/snapshot API so live "
+                            "streaming views stay consistent")
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name(node.func)
+            if fname.split(".")[-1] in _WINDOW_LIFETIME_FUNCS:
+                self._emit(
+                    "TS110", node,
+                    f"`{fname}` manages window-lifetime ledger state "
+                    "outside cylon_tpu/stream/ — window buffers are "
+                    "registered at append and retired by the watermark "
+                    "close (device → host → released); a direct call "
+                    "bypasses that lifecycle's eviction accounting")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _SINK_MUTATORS
+                  and isinstance(node.func.value, ast.Attribute)
+                  and node.func.value.attr in _SINK_STATE_ATTRS):
+                self._emit(
+                    "TS110", node,
+                    f"mutation of sink partial state "
+                    f"`.{node.func.value.attr}.{node.func.attr}()` "
+                    "outside cylon_tpu/stream/ — route through the "
+                    "GroupBySink absorb/snapshot API")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

@@ -32,6 +32,7 @@ BUILDER_MODULES = (
     "cylon_tpu.relational.repart",
     "cylon_tpu.exec.pipeline",
     "cylon_tpu.exec.recovery",
+    "cylon_tpu.stream.window",
 )
 
 #: default bound on distinct compiled programs per builder per session

@@ -44,6 +44,12 @@ RULES = {
              "exec/scheduler.py and exec/memory.py (admission must be "
              "scheduler-mediated so multi-tenant footprints and "
              "cross-tenant evictions stay attributed)",
+    "TS110": "GroupBySink partials mutated or window-lifetime state "
+             "registered/evicted outside cylon_tpu/stream/ (and the "
+             "defining modules) — streaming state transitions must ride "
+             "the sink absorb/snapshot API and the window close "
+             "lifecycle so snapshots stay consistent and the ledger "
+             "drains at close",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

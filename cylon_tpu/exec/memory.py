@@ -519,6 +519,52 @@ def spill_for_retry() -> int:
     return freed
 
 
+# ---------------------------------------------------------------------------
+# window-lifetime residency (cylon_tpu/stream): buffered event-time window
+# state lives exactly from first append to watermark close
+# ---------------------------------------------------------------------------
+
+def register_window(base: str, arrays, sharding=None,
+                    anchor=None) -> Registration:
+    """Register one event-time window buffer's arrays as a SPILLABLE
+    resident allocation — the streaming tier's window-lifetime eviction
+    class: a cold (not-yet-closable) window is a first-class LRU spill
+    candidate exactly like a cold tenant's packed source, and the
+    watermark close retires it through :func:`evict_release`.  Only the
+    stream package (and this module) may call this — lint rule TS110
+    (docs/trace_safety.md): window state mutated elsewhere would bypass
+    the close lifecycle's accounting."""
+    return _LEDGER.register(base, arrays, spillable=True,
+                            sharding=sharding, anchor=anchor)
+
+
+def evict_release(reg: Registration | None) -> int:
+    """The window-close lifecycle: device → host → released.  A closed
+    window's buffered state is first EVICTED through the spill tier — a
+    bit-exact per-shard host pull through the same sanctioned,
+    watchdogged transport as any other eviction — then the registration
+    is RELEASED and the host copy freed with it; the ledger balance
+    drains by the window's full byte count (asserted via
+    ``memory.stats()`` deltas in tests/test_stream.py).  The host hop is
+    the DELIBERATE cost of the lifecycle contract (docs/streaming.md): a
+    closed window's final state takes the identical audited exit path as
+    every other residency transition — one ``spill_events`` +
+    ``window_evictions`` record with the watchdog covering the pull —
+    rather than a silent drop (``release`` alone would also free the
+    device references, without the audit record).  A window that ledger
+    pressure already spilled skips straight to release.  Returns the
+    bytes retired.  TS110-guarded like :func:`register_window`."""
+    if reg is None or not reg.live:
+        return 0
+    nbytes = reg.nbytes
+    if not reg.spilled:
+        _LEDGER.evict(reg)
+    _LEDGER.release(reg)
+    _STATS["window_evictions"] += 1
+    timing.bump("stream.window_evicted")
+    return nbytes
+
+
 def prefetch_depth(window_pair_bytes: int) -> int:
     """Double-buffer depth for the pipelined join's spilled-window
     uploads: 2 (upload piece r+1 while piece r computes) when the
@@ -651,7 +697,8 @@ def upload_window(reg: Registration, starts, window: int):
 
 _STATS = {"spill_events": 0, "bytes_spilled": 0,
           "readmit_events": 0, "bytes_readmitted": 0,
-          "donated_bytes_reused": 0, "cross_session_evictions": 0}
+          "donated_bytes_reused": 0, "cross_session_evictions": 0,
+          "window_evictions": 0}
 
 #: owners in eviction order since the last reset — the multihost driver
 #: asserts this sequence is IDENTICAL across ranks
@@ -680,7 +727,9 @@ def stats() -> dict:
     ``donated_bytes_reused`` (admission credit for buffers donated into
     the allocating program — bytes the ledger did NOT double-count),
     ``cross_session_evictions`` (one tenant's registrations evicted under
-    another tenant's — or the scheduler's — admission pressure) and
+    another tenant's — or the scheduler's — admission pressure),
+    ``window_evictions`` (closed event-time windows retired through the
+    device→host→released lifecycle, :func:`evict_release`) and
     ``peak_ledger_bytes`` (high-water resident balance)."""
     return dict(_STATS, peak_ledger_bytes=_LEDGER.peak,
                 ledger_bytes=_LEDGER.balance())

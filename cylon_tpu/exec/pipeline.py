@@ -320,62 +320,78 @@ class GroupBySink:
             out = groupby_aggregate(chunk, self.by, list(self._chunk_aggs))
         self._adopt(out)
 
-    def finalize(self) -> Table:
+    #: public alias of the consume path — the streaming view's verb
+    #: (cylon_tpu/stream.view absorbs one micro-batch per call)
+    def absorb(self, chunk: Table) -> None:
+        self(chunk)
+
+    def compact(self) -> None:
+        """Fold the adopted partials into ONE combined partial — bounded
+        sink state for unbounded streams.  The combine groupby's summed
+        intermediates, renamed back to the partial schema, ARE a valid
+        partial (re-summing an already-summed intermediate is the same
+        associative fold), so under the streaming exactness contract
+        (integer-exact partial sums — docs/streaming.md) a compacted
+        sink's snapshot stays bit-equal to the uncompacted one.  Without
+        compaction every ``snapshot()`` re-combines one partial per
+        absorbed chunk: O(batches) state and per-read cost, quadratic
+        over a stream's lifetime.  No-op for 0/1 partials and for
+        key-disjoint sinks (their partials are already final groups)."""
         from ..relational.groupby import groupby_aggregate
+        while self._pending:
+            self._settle(self._pending.pop(0))
+        if len(self._parts) <= 1 or self._disjoint:
+            return
+        partial = concat_tables(self._parts)
+        combine = [(f"{c}_{i}", self._COMBINE[i])
+                   for c, i in self._chunk_aggs]
+        comb = groupby_aggregate(partial, self.by, combine)
+        from ..frame import DataFrame
+        df = DataFrame(_table=comb).rename(
+            {f"{c}_{i}_{self._COMBINE[i]}": f"{c}_{i}"
+             for c, i in self._chunk_aggs})
+        folded = df[self.by
+                    + [f"{c}_{i}" for c, i in self._chunk_aggs]]._table
+        from . import memory
+        for reg in self._regs:
+            memory.release(reg)
+        self._parts = [folded]
+        self._regs = [memory.register_table("sink_part", folded)]
+
+    def snapshot(self) -> Table:
+        """A consistent finalized aggregate over every chunk absorbed SO
+        FAR, without disturbing the partials: pending deferred chunks
+        are settled (they were already absorbed — settling is part of
+        consumption, not a mutation), then the partials combine through
+        the shared sink-combine path
+        (:func:`cylon_tpu.relational.groupby.combine_sink_partials`)
+        while staying adopted — the sink keeps absorbing afterwards.
+        This is the streaming ``read()`` primitive
+        (:mod:`cylon_tpu.stream.view`): snapshot(k batches) is bit-equal
+        to finalize() of a fresh sink fed the same k batches."""
+        return self._combine(drain=False)
+
+    def finalize(self) -> Table:
+        return self._combine(drain=True)
+
+    def _combine(self, drain: bool) -> Table:
+        from ..relational.groupby import combine_sink_partials
         while self._pending:
             self._settle(self._pending.pop(0))
         if not self._parts:
             raise InvalidError("GroupBySink saw no chunks")
         partial = concat_tables(self._parts) if len(self._parts) > 1 \
             else self._parts[0]
-        self._parts = []
-        from . import memory
-        for reg in self._regs:
-            memory.release(reg)
-        self._regs = []
-        if self._disjoint:
-            # key-disjoint chunks: the partials are already the final
-            # groups; intermediate column names carry no combine suffix
-            comb = partial
-
-            def part_name(col, i):
-                return f"{col}_{i}"
-        else:
-            combine = [(f"{c}_{i}", self._COMBINE[i]) for c, i in
-                       self._chunk_aggs]
-            comb = groupby_aggregate(partial, self.by, combine)
-
-            def part_name(col, i):
-                return f"{col}_{i}_{self._COMBINE[i]}"
-        # final columns in requested order, renamed to the public contract
-        from ..frame import DataFrame
-        df = DataFrame(_table=comb)
-        out_cols = list(self.by)
-        # derived ops first: they READ intermediates that a sibling
-        # sum/count agg over the same column will rename away below
-        for col, op, *_ in self.aggs:
-            if op == "mean":
-                df[f"{col}_mean"] = (df[part_name(col, "sum")]
-                                     / df[part_name(col, "count")])
-            elif op in ("var", "std"):
-                # E[x^2] - E[x]^2 scaled to the ddof denominator — the same
-                # closed form (and cnt>ddof validity) as
-                # ops/groupby.finalize
-                cnt = df[part_name(col, "count")]
-                mean = df[part_name(col, "sum")] / cnt
-                varp = df[part_name(col, "sumsq")] / cnt - mean * mean
-                varp = varp.where(varp >= 0.0, 0.0)  # cancellation guard
-                var = (varp * cnt / (cnt - self.ddof)).where(cnt > self.ddof)
-                df[f"{col}_{op}"] = var ** 0.5 if op == "std" else var
-        for col, op, *_ in self.aggs:
-            name = f"{col}_{op}"
-            if op not in ("mean", "var", "std"):
-                i = self._DECOMP[op][0]
-                df = df.rename({part_name(col, i): name})
-            out_cols.append(name)
-        out = df[out_cols]._table
-        out.grouped_by = None  # combine order is chunk-partial order
-        return out
+        if drain:
+            self._parts = []
+            from . import memory
+            for reg in self._regs:
+                memory.release(reg)
+            self._regs = []
+        return combine_sink_partials(partial, self.by, self.aggs,
+                                     self._chunk_aggs, self._COMBINE,
+                                     ddof=self.ddof,
+                                     disjoint=self._disjoint)
 
 
 # ---------------------------------------------------------------------------

@@ -106,10 +106,15 @@ shard_map = jax.shard_map
 #: ``pipe.phase_sync`` is the overlap scheduler's designated pre-loop
 #: batched pull (exec/pipeline._pull_phase_outputs) — injecting there
 #: proves deferred-phase faults surface typed at the consensus-coherent
-#: sync point, not inside an arbitrary later pull.
+#: sync point, not inside an arbitrary later pull.  The stream sites
+#: (cylon_tpu/stream): ``stream.append`` wraps one micro-batch's ingest
+#: (shuffle + ledger admission + sink absorb) — ``kill`` there is the
+#: chaos harness's mid-ingest crash — and ``stream.watermark`` wraps the
+#: watermark min-vote that closes event-time windows.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
-         "ckpt.write", "ckpt.load", "pipe.phase_sync")
+         "ckpt.write", "ckpt.load", "pipe.phase_sync",
+         "stream.append", "stream.watermark")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
@@ -692,6 +697,31 @@ def ckpt_commit_consensus(mesh: Mesh | None, epoch: int) -> int:
             "are checkpointing different pieces", site="ckpt.commit",
             phase=_last_phase())
     return epoch
+
+
+def watermark_consensus(mesh: Mesh | None, n: int) -> int:
+    """Min-agree the streaming watermark across ranks (the event-time
+    window-close vote, :mod:`cylon_tpu.stream.window`).  ``n`` is this
+    rank's CLOSABLE-WINDOW count — the number of tumbling windows whose
+    end its local (monotone, per-rank) watermark has passed; window
+    ordinals stay far below the wire width, unlike raw int64 event-time
+    nanoseconds.  Every rank then closes exactly the agreed MINIMUM — a
+    rank that has not yet seen events past a window's end holds the
+    whole session's close back, because closing rank-locally would emit
+    (and evict) different window state per rank, the desync this module
+    exists to prevent.  Rides the pmax transport complemented (max of
+    the complement = complement of the min — the ckpt-resume trick) and
+    is session-namespaced like every other wire, so a streaming tenant's
+    vote can never satisfy another tenant's poll."""
+    n = int(n)
+    if not 0 <= n < _CKPT_EPOCH_BASE:
+        raise ValueError(f"watermark window count {n} out of wire range")
+    if mesh is None or jax.process_count() == 1:
+        return n
+    wire = _CKPT_EPOCH_BASE - 1 - n
+    return _CKPT_EPOCH_BASE - 1 - (
+        _ns_consensus(mesh, wire, 1 << 20, "stream.watermark")
+        % _CKPT_EPOCH_BASE)
 
 
 def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:

@@ -241,16 +241,26 @@ class QueryScheduler:
     # -- submission --------------------------------------------------------
     def submit(self, name: str, fn, *, footprint_bytes: int = 0,
                priority: int = 0, weight: float = 1.0,
-               tenant: str | None = None) -> QuerySession:
+               tenant: str | None = None,
+               kind: str = "query") -> QuerySession:
         """Queue one query.  ``fn`` is a zero-arg callable executed on
         the session's thread under the baton; its return value lands in
         ``session.result``.  ``footprint_bytes`` is the pack-time HBM
-        estimate admission gates on (:func:`estimate_footprint`)."""
+        estimate admission gates on (:func:`estimate_footprint`).
+
+        ``kind="stream"`` marks a STREAMING session — a long-lived
+        ingest loop (:mod:`cylon_tpu.stream`) whose interleave points
+        are its own micro-batch appends, watermark votes and window
+        closes rather than piece-loop boundaries; admission, policies
+        and isolation treat it exactly like a query tenant, so
+        continuous ingest coexists with the TPC-H mix on one mesh
+        (docs/streaming.md)."""
         if any(s.name == name for s in self.sessions):
             raise InvalidError(f"duplicate session name {name!r}")
         sess = QuerySession(name, fn, len(self.sessions),
                             footprint_bytes=footprint_bytes,
-                            priority=priority, weight=weight, tenant=tenant)
+                            priority=priority, weight=weight, tenant=tenant,
+                            kind=kind)
         self.sessions.append(sess)
         return sess
 
@@ -516,6 +526,8 @@ class QueryScheduler:
         return {
             "policy": self.policy,
             "sessions": len(self.sessions),
+            "stream_sessions": sum(1 for s in self.sessions
+                                   if s.kind == "stream"),
             "completed": sum(1 for s in self.sessions if s.state == DONE),
             "failed": sum(1 for s in self.sessions if s.state == FAILED),
             "admission_waits": sum(s.admission_waits

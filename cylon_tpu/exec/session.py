@@ -42,14 +42,27 @@ class QuerySession:
     :meth:`cylon_tpu.exec.scheduler.QueryScheduler.submit`; read-only
     for callers (the scheduler owns the state transitions)."""
 
+    #: session kinds: a ``query`` runs to completion and returns its
+    #: result; a ``stream`` session is a LONG-LIVED ingest loop
+    #: (cylon_tpu/stream) that yields at its own interleave points —
+    #: per micro-batch append, per watermark vote, per window close —
+    #: so continuous ingestion coexists with the query tenant mix on
+    #: one mesh (docs/streaming.md, docs/serving.md)
+    KINDS = ("query", "stream")
+
     def __init__(self, name: str, fn, ordinal: int, *,
                  footprint_bytes: int = 0, priority: int = 0,
-                 weight: float = 1.0, tenant: str | None = None):
+                 weight: float = 1.0, tenant: str | None = None,
+                 kind: str = "query"):
         if "/" in name or name != name.strip() or not name:
             raise ValueError(
                 f"session name {name!r} must be a non-empty path-safe "
                 "token (it namespaces checkpoint stage directories)")
+        if kind not in self.KINDS:
+            raise ValueError(
+                f"session kind {kind!r} must be one of {self.KINDS}")
         self.name = name
+        self.kind = kind
         self.fn = fn
         self.ordinal = int(ordinal)
         self.footprint_bytes = int(footprint_bytes)
@@ -113,6 +126,7 @@ class QuerySession:
         """Serving metrics for bench JSON detail."""
         return {
             "name": self.name, "tenant": self.tenant, "state": self.state,
+            "kind": self.kind,
             "priority": self.priority, "weight": self.weight,
             "footprint_bytes": self.footprint_bytes,
             "admission_waits": self.admission_waits,

@@ -258,12 +258,17 @@ def exchange_block_cap(total: int, w: int) -> int:
 
 
 def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
-             guard: bool = False):
+             guard: bool = False, owner: str = "shuffle.recv"):
     """Run the (possibly multi-round) padded all-to-all for every array in
     ``cols`` (payload-agnostic: callers pre-pack laneable columns into one
     (cap, L) u32 lane matrix — relational/repart._flatten_for_exchange —
     so the per-round scatter/all_to_all/scatter chain runs once per ARRAY,
     and a whole table is typically one matrix + f64 side arrays).
+
+    ``owner`` names the ledger registration of the guarded receive
+    buffers — streaming ingest appends pass ``stream.recv`` so the
+    serving tier's budget decisions can tell long-lived ingest state from
+    transient query shuffles (cylon_tpu/stream, docs/streaming.md).
 
     Returns (new_cols tuple, new_valid_counts np (W,)).  Capacities are
     bucketed (config.pow2ceil) so the family of compiled programs stays
@@ -373,7 +378,7 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
         # cheap re-entry path.
         from ..exec import memory
         for arr in outs:
-            memory.register("shuffle.recv", (arr,), anchor=arr)
+            memory.register(owner, (arr,), anchor=arr)
     return outs, per_dest.astype(np.int64)
 
 

@@ -558,6 +558,106 @@ def _result_table(env, by_names, by_cols, key_out, kval_out, res_names,
     return Table(cols, env, np.asarray(n_groups, np.int64))
 
 
+@program_cache()
+def _sink_finalize_fn(mesh: Mesh, ops: tuple, ddof: int):
+    """Per-shard finalize of a sink combine's DERIVED ops (mean/var/std)
+    over the summed (count, sum[, sumsq]) intermediate columns — the
+    IDENTICAL :func:`cylon_tpu.ops.groupby.finalize` expressions,
+    compiled by the same backend in one program, so FMA-contraction
+    decisions match the batch groupby's in-jit finalize and the
+    streaming bit-equality contract extends to var/std (an eager
+    host-side ``sumsq/c - mean·mean`` computes the multiply and
+    subtract as separate dispatches, which XLA would have contracted —
+    a 1-ulp fork measured on the CPU rig)."""
+
+    def per_shard(*arrs):
+        outs = []
+        i = 0
+        for op in ops:
+            inter = {"count": arrs[i], "sum": arrs[i + 1]}
+            i += 2
+            if op != "mean":
+                inter["sumsq"] = arrs[i]
+                i += 1
+            d, v = gbk.finalize(op, inter, ddof)
+            outs.append(d)
+            outs.append(v)
+        return tuple(outs)
+
+    n_in = sum(2 if op == "mean" else 3 for op in ops)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW,) * n_in,
+                             out_specs=(ROW,) * (2 * len(ops))))
+
+
+def combine_sink_partials(partial: Table, by, aggs, chunk_aggs,
+                          combine_ops, ddof: int = 1,
+                          disjoint: bool = False) -> Table:
+    """The sink snapshot/absorb API's COMBINE step: fold a table of
+    per-chunk partial aggregates (one row per (chunk, group) — the
+    concatenation of a :class:`~cylon_tpu.exec.pipeline.GroupBySink`'s
+    adopted partials) into the final public aggregate table, without
+    touching the partials themselves — so a streaming view's
+    ``read()`` can snapshot a LIVE sink repeatedly while ingestion
+    continues (:mod:`cylon_tpu.stream.view`).
+
+    ``chunk_aggs``: the sorted distinct (col, intermediate-op) pairs the
+    sink maintains; ``combine_ops``: intermediate-op → combining op
+    (sum/min/max); ``disjoint``: the partials' key sets are pairwise
+    disjoint (range-partitioned pipelines), so the cross-chunk combine
+    groupby is skipped and the partials ARE the final groups.
+
+    Derived ops (mean/var/std) finalize ON DEVICE through the very
+    :func:`cylon_tpu.ops.groupby.finalize` the monolithic groupby jits
+    (:func:`_sink_finalize_fn`), so whenever the partial sums are EXACT
+    (integer payloads, or integer-valued f64 below 2^53 — the
+    fixed-point money representation) the combined result is bit-equal
+    to a from-scratch batch groupby over all rows, var/std included
+    (docs/streaming.md "exactness contract")."""
+    env = partial.env
+    if disjoint:
+        comb = partial
+
+        def part_name(col, i):
+            return f"{col}_{i}"
+    else:
+        combine = [(f"{c}_{i}", combine_ops[i]) for c, i in chunk_aggs]
+        comb = groupby_aggregate(partial, by, combine)
+
+        def part_name(col, i):
+            return f"{col}_{i}_{combine_ops[i]}"
+    # derived ops: one shared device finalize over the summed
+    # intermediates (count/sum[/sumsq] per derived column)
+    derived = [(col, op) for col, op, *_ in aggs
+               if op in ("mean", "var", "std")]
+    dev_out: dict[tuple, tuple] = {}
+    if derived:
+        arrs = []
+        for col, op in derived:
+            arrs.append(comb.column(part_name(col, "count")).data)
+            arrs.append(comb.column(part_name(col, "sum")).data)
+            if op != "mean":
+                arrs.append(comb.column(part_name(col, "sumsq")).data)
+        outs = _sink_finalize_fn(env.mesh, tuple(op for _, op in derived),
+                                 int(ddof))(*arrs)
+        for j, key in enumerate(derived):
+            dev_out[key] = (outs[2 * j], outs[2 * j + 1])
+    cols = {}
+    for n in by:
+        cols[n] = comb.column(n)
+    for col, op, *_ in aggs:
+        name = f"{col}_{op}"
+        if (col, op) in dev_out:
+            d, v = dev_out[(col, op)]
+            cols[name] = Column(d, from_numpy_dtype(np.dtype(d.dtype)), v)
+        else:
+            # non-derived ops (sum/count/min/max) ARE their own single
+            # intermediate — the combined column passes through renamed
+            cols[name] = comb.column(part_name(col, op))
+    out = Table(cols, env, np.asarray(comb.valid_counts, np.int64))
+    out.grouped_by = None  # combine order is chunk-partial order
+    return out
+
+
 def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     """Group ``table`` by key columns ``by`` and aggregate.
 
@@ -852,8 +952,18 @@ def _trace_shrink(mesh):
     return jax.make_jaxpr(fn)(S((w * 1024,), np.float64))
 
 
+def _trace_sink_finalize(mesh):
+    w, S, _vc, _k, _v, _vals = _decl_args(mesh)
+    fn = _unwrap(_sink_finalize_fn(mesh, ("mean", "var"), 1))
+    cnt = S((w * 1024,), np.int64)
+    f = S((w * 1024,), np.float64)
+    return jax.make_jaxpr(fn)(cnt, f, cnt, f, f)
+
+
 from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
 
 declare_builder(f"{__name__}._combine_fn", _trace_combine,
                 tags=("groupby",))
 declare_builder(f"{__name__}._shrink_fn", _trace_shrink, tags=("groupby",))
+declare_builder(f"{__name__}._sink_finalize_fn", _trace_sink_finalize,
+                tags=("groupby", "stream"))

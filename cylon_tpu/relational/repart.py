@@ -125,9 +125,12 @@ def _rebuild(recipe, new_flat, valid_counts, env: CylonEnv) -> Table:
 # hash shuffle (reference Shuffle, table.cpp:1298)
 # ---------------------------------------------------------------------------
 
-def shuffle_table(table: Table, key_names) -> Table:
+def shuffle_table(table: Table, key_names,
+                  owner: str = "shuffle.recv") -> Table:
     """Redistribute rows so equal keys land on the same shard (hash
-    partitioning, reference MapToHashPartitions + ArrowAllToAll)."""
+    partitioning, reference MapToHashPartitions + ArrowAllToAll).
+    ``owner`` labels the receive buffers' ledger registration —
+    streaming appends pass ``stream.recv`` (cylon_tpu/stream)."""
     env = table.env
     # every distributed op shuffles, so this is the serving tier's
     # coarse interleave point for monolithic (non-pipelined) plans —
@@ -144,7 +147,7 @@ def shuffle_table(table: Table, key_names) -> Table:
     # hash shuffles run under join/groupby/setops OOM fallbacks: the
     # receive-budget guard may preempt a doomed allocation
     new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat,
-                                           guard=True)
+                                           guard=True, owner=owner)
     return _rebuild(recipe, new_flat, new_valid, env)
 
 

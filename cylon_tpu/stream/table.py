@@ -1,0 +1,134 @@
+"""StreamTable: the append-only distributed ingest table.
+
+One micro-batch append is: interleave (the serving tier's streaming
+yield point) → injector probe (``stream.append``) → host batch → device
+Table → hash shuffle on the stream key (the SAME exchange engine every
+relational operator uses; receive buffers ledger-labelled
+``stream.recv``) → scheduler-mediated admission (TS109) → chunk
+accumulation + subscriber notification.  The accumulated chunks are
+ordinary Tables — ``snapshot()`` is their concatenation, and the
+dispatch-on-demand property the pipelined ops rely on
+(:func:`~cylon_tpu.exec.pipeline.chunk_table`) holds per append: no
+chunk is sliced or copied until a consumer reads it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.table import Table
+from ..relational.repart import concat_tables, shuffle_table
+from ..status import InvalidError
+
+
+def _as_table(batch, env) -> Table:
+    """Accept a pandas DataFrame, a dict of numpy arrays, a
+    cylon DataFrame or a Table as one micro-batch."""
+    if isinstance(batch, Table):
+        return batch
+    inner = getattr(batch, "_table", None)
+    if isinstance(inner, Table):
+        return inner
+    if isinstance(batch, dict):
+        return Table.from_pydict(batch, env)
+    return Table.from_pandas(batch, env)
+
+
+def _table_nbytes(table: Table) -> int:
+    total = 0
+    for c in table.columns.values():
+        total += int(c.data.nbytes)
+        if c.validity is not None:
+            total += int(c.validity.nbytes)
+    return total
+
+
+class StreamTable:
+    """Append-only distributed table fed by micro-batches.
+
+    Usage::
+
+        st = StreamTable(env, key="k", name="orders")
+        view = IncrementalView(st, "k", [("v", "sum"), ("v", "mean")])
+        st.append(batch_df)          # shuffled, admitted, absorbed
+        view.read()                  # consistent snapshot, ingest live
+
+    ``key``: the hash-shuffle column(s) — equal keys land on the same
+    shard on arrival, so every downstream groupby/join starts
+    co-located.  Appends register their bytes with the HBM ledger under
+    ``<name>.chunk`` owners (anchored to the chunk tables, so GC drains
+    the balance) and run admission through the scheduler facade; under
+    budget pressure cold tenants (or cold stream windows) evict first.
+    """
+
+    def __init__(self, env, key, name: str = "stream"):
+        self.env = env
+        self.key = [key] if isinstance(key, str) else list(key)
+        self.name = str(name)
+        self.chunks: list[Table] = []
+        self._regs: list = []
+        self._subscribers: list = []
+        self.rows_appended = 0
+        self.bytes_appended = 0
+        self.batches_appended = 0
+
+    def subscribe(self, consumer) -> None:
+        """``consumer(batch_table)`` is called with every appended
+        (post-shuffle) batch — how an :class:`~cylon_tpu.stream.view.
+        IncrementalView` rides the ingest path."""
+        self._subscribers.append(consumer)
+
+    def append(self, batch) -> Table:
+        """Ingest one micro-batch; returns the shuffled device-resident
+        batch Table (the unit subscribers absorbed)."""
+        from ..exec import memory, recovery, scheduler
+        from ..utils import timing
+        # the streaming session's interleave point: one append per baton
+        # slice, so continuous ingest coexists with the query tenant mix
+        scheduler.maybe_yield()
+        recovery.maybe_inject("stream.append")
+        with timing.region("stream.append"):
+            tbl = _as_table(batch, self.env)
+            if self.env.world_size > 1:
+                tbl = shuffle_table(tbl, self.key, owner="stream.recv")
+            nbytes = _table_nbytes(tbl)
+            # scheduler-mediated admission (TS109): ingest state counts
+            # against the mesh budget like any tenant's resident state
+            scheduler.admit_allocation(self.env, nbytes)
+            self._regs.append(
+                memory.register_table(f"{self.name}.chunk", tbl))
+            self.chunks.append(tbl)
+        self.rows_appended += int(tbl.row_count)
+        self.bytes_appended += nbytes
+        self.batches_appended += 1
+        timing.bump("stream.batch_appended")
+        for consumer in self._subscribers:
+            consumer(tbl)
+        return tbl
+
+    def snapshot(self) -> Table:
+        """All rows appended so far as one Table (per-shard order =
+        append order — the batch-recompute oracle's input)."""
+        if not self.chunks:
+            raise InvalidError(f"stream {self.name!r} has no batches")
+        return concat_tables(self.chunks) if len(self.chunks) > 1 \
+            else self.chunks[0]
+
+    def release(self) -> None:
+        """Drop the accumulated chunks and drain their ledger balance."""
+        from ..exec import memory
+        for reg in self._regs:
+            memory.release(reg)
+        self._regs = []
+        self.chunks = []
+
+    def stats(self) -> dict:
+        return {"name": self.name, "batches": self.batches_appended,
+                "rows": self.rows_appended,
+                "bytes": self.bytes_appended,
+                "valid_counts": (np.asarray(self.chunks[-1].valid_counts)
+                                 .tolist() if self.chunks else [])}
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"StreamTable({self.name!r}, batches="
+                f"{self.batches_appended}, rows={self.rows_appended})")

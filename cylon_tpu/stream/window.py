@@ -1,0 +1,397 @@
+"""Event-time tumbling windows with consensus watermarks + spill-tier
+eviction of closed state.
+
+The Dataflow/Flink-style contract (PAPERS.md): rows carry an event time,
+window ``w`` covers ``[w·window, (w+1)·window)``, and a WATERMARK —
+``max event time seen − allowed lateness`` — decides when a window's
+contents are complete.  Distributed, the watermark is rank-local (each
+rank advances it from its own shards' event times, monotone by
+construction), so window CLOSE is a collective decision: every rank
+votes its closable-window count and the agreed MINIMUM closes
+(:func:`cylon_tpu.exec.recovery.watermark_consensus` — the pmax wire
+complemented, session-namespaced, registered with the jaxpr gate), so
+every rank finalizes the same window at the same step.  A rank-local
+close would emit and evict different state per rank — the same desync a
+rank-local retry causes.
+
+Closed windows take the as-of/broadcast join path: buffered probe rows
+join the CURRENT build-side snapshot (a slowly-changing small dimension
+table — the existing broadcast-join route replicates it, so the
+pre-shuffled probe rows never move again), the result is emitted, and
+the buffered state retires through the spill tier — device → host →
+released (:func:`cylon_tpu.exec.memory.evict_release`, the
+window-lifetime eviction class).  While open, window buffers are
+ordinary SPILLABLE ledger registrations: a cold window under budget
+pressure evicts to host like any cold tenant's packed source and
+re-enters bit-exactly at close.
+
+Late rows (event time in an already-closed window) follow the
+configured policy: ``drop`` (counted) or ``clamp`` (land in the oldest
+still-open window).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.cache import program_cache
+from ..core.column import Column
+from ..core.table import Table
+from ..ctx.context import ROW_AXIS
+from ..relational.common import REP, ROW
+from ..relational.join import join_tables
+from ..relational.repart import concat_tables, shuffle_table
+from ..status import InvalidError
+from ..utils.host import host_array
+from .table import _as_table, _table_nbytes
+
+shard_map = jax.shard_map
+
+#: event-time sentinel for empty shards (min/max fold identities)
+_T_MAX = np.int64(2**62)
+_T_MIN = np.int64(-(2**62))
+
+
+@program_cache()
+def _event_bounds_fn(mesh: Mesh, cap: int):
+    """Per-shard (min, max) event time over the live prefix — the
+    append path's device-side watermark input: the post-shuffle resident
+    time column is the authoritative copy, and in a multiprocess session
+    each rank reads only its addressable shards, which is exactly the
+    rank-local watermark the consensus min-vote reconciles.  Pure-local
+    program (no collective) — jaxpr-gate registered."""
+
+    def per_shard(vc, t):
+        my = jax.lax.axis_index(ROW_AXIS)
+        n = vc[my]
+        mask = jnp.arange(cap, dtype=jnp.int32) < n
+        lo = jnp.min(jnp.where(mask, t, jnp.int64(_T_MAX))).reshape(1)
+        hi = jnp.max(jnp.where(mask, t, jnp.int64(_T_MIN))).reshape(1)
+        return lo, hi
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
+                             out_specs=(ROW, ROW)))
+
+
+def event_bounds(table: Table, time_col: str) -> tuple[int, int]:
+    """(min, max) event time over a table's live rows (this process's
+    addressable shards), or (T_MAX, T_MIN) identities when empty."""
+    col = table.column(time_col)
+    vc = np.asarray(table.valid_counts, np.int32)
+    lo, hi = _event_bounds_fn(table.env.mesh, max(table.capacity, 1))(
+        vc, col.data)
+    lo = host_array(lo)
+    hi = host_array(hi)
+    return int(lo.min()), int(hi.max())
+
+
+class _WindowBuffer:
+    """One appended micro-batch's buffered rows for one open window:
+    the COLUMN ARRAYS live only inside a spillable window-lifetime
+    ledger registration (plus a host-side rebuild recipe), so an
+    eviction — under budget pressure while open, or the close
+    lifecycle's device→host→released retirement — genuinely drops the
+    device references."""
+
+    __slots__ = ("env", "reg", "_names", "_types", "_dicts", "_bounds",
+                 "_has_valid", "_valid_counts", "rows")
+
+    def __init__(self, table: Table, env, owner: str):
+        from ..exec import memory
+        self.env = env
+        arrays, self._names, self._types = [], [], []
+        self._dicts, self._bounds, self._has_valid = [], [], []
+        for name, c in table.columns.items():
+            self._names.append(name)
+            self._types.append(c.type)
+            self._dicts.append(c.dictionary)
+            self._bounds.append(c.bounds)
+            self._has_valid.append(c.validity is not None)
+            arrays.append(c.data)
+            if c.validity is not None:
+                arrays.append(c.validity)
+        self._valid_counts = np.asarray(table.valid_counts, np.int64)
+        self.rows = int(table.row_count)
+        self.reg = memory.register_window(
+            owner, arrays,
+            sharding=env.sharding() if env.world_size > 1 else None)
+
+    def table(self) -> Table:
+        """Rebuild the buffered rows as a Table — re-uploading through
+        the spill tier first when budget pressure evicted this window
+        while it was open (bit-exact round trip)."""
+        from ..exec import memory
+        memory.touch(self.reg)
+        arrays = memory.device_arrays(self.reg)
+        if arrays is None:
+            arrays = memory.readmit(self.reg)
+        it = iter(arrays)
+        cols = {}
+        for i, name in enumerate(self._names):
+            data = next(it)
+            valid = next(it) if self._has_valid[i] else None
+            cols[name] = Column(data, self._types[i], valid,
+                                self._dicts[i], bounds=self._bounds[i])
+        return Table(cols, self.env, self._valid_counts)
+
+
+class TumblingWindowJoin:
+    """Windowed + as-of join of an event-time stream against a
+    slowly-changing small build side.
+
+    Usage::
+
+        wj = TumblingWindowJoin(env, key="k", time_col="t", window=100,
+                                build=dims, build_on="k", lateness=50)
+        wj.append(batch)          # buffered per window, watermark advances
+        closed = wj.watermark()   # consensus vote; closes ready windows
+        wj.closed                 # [(window_id, joined Table), ...]
+        wj.pop_closed()           # drain emitted results (+ their ledger)
+
+    ``window``: tumbling width in event-time units; ``lateness``:
+    allowed out-of-orderness subtracted from the max event time seen;
+    ``late_policy``: ``"drop"`` (late rows counted and discarded) or
+    ``"clamp"`` (late rows land in the oldest still-open window).
+    ``emit``: optional callback ``emit(window_id, table)`` per close.
+    ``set_build`` swaps the build side (as-of: a window joins the build
+    version current at ITS close)."""
+
+    def __init__(self, env, key, time_col: str, window: int, build,
+                 build_on, *, lateness: int = 0,
+                 late_policy: str = "drop", name: str = "wjoin",
+                 how: str = "inner", origin: int = 0, emit=None):
+        if late_policy not in ("drop", "clamp"):
+            raise InvalidError(
+                f"late_policy {late_policy!r} must be 'drop' or 'clamp'")
+        if int(window) <= 0:
+            raise InvalidError("window width must be positive")
+        self.env = env
+        self.key = [key] if isinstance(key, str) else list(key)
+        self.time_col = str(time_col)
+        self.window = int(window)
+        #: event-time origin — window ordinals are counted from here, so
+        #: absolute timestamps (epoch nanoseconds) stay inside the
+        #: consensus wire's 2^20 window-ordinal width
+        self.origin = int(origin)
+        self.build_on = [build_on] if isinstance(build_on, str) \
+            else list(build_on)
+        self.lateness = int(lateness)
+        self.late_policy = late_policy
+        self.name = str(name)
+        self.how = how
+        self.emit = emit
+        self.build = _as_table(build, env)
+        #: open window id -> list[_WindowBuffer]
+        self._open: dict[int, list[_WindowBuffer]] = {}
+        #: windows [0, _closed_through) are closed — the agreed count
+        self._closed_through = 0
+        self._local_wm = int(_T_MIN)   # monotone per-rank watermark
+        self.closed: list[tuple[int, Table]] = []
+        self._closed_regs: list = []   # ledger entries of emitted results
+        self.windows_closed = 0
+        self.late_dropped = 0
+        self.late_clamped = 0
+        self.rows_buffered = 0
+
+    # -- build side (as-of) ------------------------------------------------
+    def set_build(self, build) -> None:
+        """Swap the slowly-changing build side; windows closed after
+        this join the new version (as-of-close semantics)."""
+        self.build = _as_table(build, self.env)
+
+    # -- ingest ------------------------------------------------------------
+    def append(self, batch) -> None:
+        """Buffer one micro-batch into its event-time windows.  Host
+        rows split per window id, each sub-batch hash-shuffles on the
+        join key (arrival co-location like StreamTable), is admitted
+        through the scheduler facade and registers as a spillable
+        window-lifetime allocation; the device-resident time column then
+        advances this rank's watermark."""
+        from ..exec import recovery, scheduler
+        from ..utils import timing
+        scheduler.maybe_yield()
+        recovery.maybe_inject("stream.append")
+        cols = self._host_columns(batch)
+        times = np.asarray(cols[self.time_col], np.int64)
+        if times.size == 0:
+            return
+        wid = (times - self.origin) // self.window
+        if (wid < 0).any():
+            # pre-origin events are invalid input, NOT late rows: no
+            # window before the origin ever existed (or closed), so
+            # silently applying the late policy would discard data the
+            # contract never covered — fail loud instead
+            raise InvalidError(
+                f"{self.name}: {int((wid < 0).sum())} event(s) before "
+                f"the stream origin {self.origin} — window ordinals are "
+                "counted from `origin`; construct the join with an "
+                "origin at or below the earliest event time")
+        late = wid < self._closed_through
+        if late.any():
+            if self.late_policy == "drop":
+                self.late_dropped += int(late.sum())
+                keep = ~late
+                cols = {k: np.asarray(v)[keep] for k, v in cols.items()}
+                wid = wid[keep]
+            else:   # clamp: land in the oldest still-open window
+                self.late_clamped += int(late.sum())
+                wid = np.maximum(wid, self._closed_through)
+        if wid.size == 0:
+            return
+        with timing.region("stream.window_append"):
+            for w in np.unique(wid):
+                sel = wid == w
+                sub = {k: np.asarray(v)[sel] for k, v in cols.items()}
+                tbl = Table.from_pydict(sub, self.env)
+                if self.env.world_size > 1:
+                    tbl = shuffle_table(tbl, self.key, owner="stream.recv")
+                scheduler.admit_allocation(self.env, _table_nbytes(tbl))
+                _lo, hi = event_bounds(tbl, self.time_col)
+                buf = _WindowBuffer(tbl, self.env,
+                                    f"{self.name}.w{int(w)}")
+                del tbl     # the registration is the only device ref
+                self._open.setdefault(int(w), []).append(buf)
+                self.rows_buffered += buf.rows
+                # monotone per-rank advance from the authoritative
+                # (post-shuffle, device-resident) time column
+                self._local_wm = max(self._local_wm,
+                                     int(hi) - self.lateness)
+
+    def _host_columns(self, batch) -> dict:
+        if isinstance(batch, dict):
+            return dict(batch)
+        pdf = batch.to_pandas() if hasattr(batch, "to_pandas") else batch
+        return {str(k): pdf[k].to_numpy() for k in pdf.columns}
+
+    # -- watermark + close -------------------------------------------------
+    def local_watermark(self) -> int:
+        return self._local_wm
+
+    def closable_count(self) -> int:
+        """This rank's vote: how many windows [0, n) its local watermark
+        has passed (window w closes when wm >= origin + (w+1)·window)."""
+        rel = self._local_wm - self.origin
+        if self._local_wm == int(_T_MIN) or rel < 0:
+            return self._closed_through
+        return max(int(rel) // self.window, self._closed_through)
+
+    def watermark(self) -> int:
+        """Agree the watermark across ranks and close every ready
+        window.  Returns the agreed closable-window count (the agreed
+        watermark is ``origin + count · window``).  Every rank closes the
+        identical windows in the identical order — the min-vote holds
+        the close back to the slowest rank's watermark.
+
+        The wire carries the DELTA of newly-closable windows, not the
+        cumulative ordinal: ``_closed_through`` advances only by agreed
+        amounts, so it is identical on every rank and the cumulative
+        count reconstructs exactly — while a forever-running stream (or
+        a stream whose first batch sits billions of windows past the
+        origin, e.g. epoch timestamps with the default origin) never
+        outgrows the consensus wire's 2^20 width.  A jump wider than
+        the wire votes in saturating rounds: the loop repeats exactly
+        while the AGREED delta saturates the clamp — a rank-uniform
+        value, so every rank takes the identical number of voting
+        rounds.  Windows nothing was buffered into are skipped in
+        O(open windows) — an idle stream closing a large time range
+        records nothing."""
+        from ..exec import recovery, scheduler
+        scheduler.maybe_yield()
+        recovery.maybe_inject("stream.watermark")
+        mesh = getattr(self.env, "mesh", None) \
+            if self.env.world_size > 1 else None
+        wire_max = (1 << 20) - 1
+        while True:
+            delta = min(self.closable_count() - self._closed_through,
+                        wire_max)
+            agreed_delta = recovery.watermark_consensus(mesh, delta)
+            agreed = self._closed_through + agreed_delta
+            for wid in sorted(w for w in self._open if w < agreed):
+                self._close(wid)
+            self._closed_through = agreed
+            if agreed_delta < wire_max:
+                return agreed
+
+    def _close(self, wid: int) -> None:
+        """Finalize one window: concat its buffered rows, join the
+        CURRENT build side (broadcast route for a small build — the
+        probe rows never move again), emit, then retire the buffers
+        through the spill tier (device → host → released — the ledger
+        balance drains by the window's full byte count)."""
+        from ..exec import memory
+        from ..utils import timing
+        bufs = self._open.pop(wid)
+        with timing.region("stream.window_close"):
+            parts = [b.table() for b in bufs]
+            probe = concat_tables(parts) if len(parts) > 1 else parts[0]
+            out = join_tables(probe, self.build, self.key, self.build_on,
+                              how=self.how, allow_defer=False)
+            del probe, parts
+            for b in bufs:
+                memory.evict_release(b.reg)
+        # the emitted result is itself long-lived resident state while
+        # it sits in `closed` — accounted (anchored to the table, so
+        # pop_closed()/GC drains the balance), never ledger-invisible
+        self._closed_regs.append(
+            memory.register_table(f"{self.name}.closed", out))
+        self.closed.append((wid, out))
+        self.windows_closed += 1
+        timing.bump("stream.window_closed")
+        if self.emit is not None:
+            self.emit(wid, out)
+
+    def pop_closed(self) -> list[tuple[int, Table]]:
+        """Drain the emitted results (and their ledger registrations) —
+        the long-running consumer's hand-off point: a stream that closes
+        windows forever must pop (or consume via ``emit=`` and pop) so
+        retained results do not accumulate."""
+        from ..exec import memory
+        out, self.closed = self.closed, []
+        for reg in self._closed_regs:
+            memory.release(reg)
+        self._closed_regs = []
+        return out
+
+    def stats(self) -> dict:
+        return {"name": self.name, "windows_closed": self.windows_closed,
+                "open_windows": len(self._open),
+                "closed_through": self._closed_through,
+                "late_dropped": self.late_dropped,
+                "late_clamped": self.late_clamped,
+                "rows_buffered": self.rows_buffered,
+                "local_watermark": self._local_wm}
+
+
+# ---------------------------------------------------------------------------
+# trace-safety declarations (cylon_tpu.analysis.registry): the event-bounds
+# program is pure-local (each rank reads only its shards — the watermark's
+# rank-local half); the watermark VOTE rides the already-verified one-pmax
+# consensus program, declared here under its stream alias so the gate
+# covers the streaming use.  docs/trace_safety.md.
+# ---------------------------------------------------------------------------
+
+def _trace_event_bounds(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_event_bounds_fn(mesh, cap))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64))
+
+
+def _trace_watermark_consensus(mesh):
+    from ..exec.recovery import _consensus_fn
+    w = int(mesh.devices.size)
+    fn = _unwrap(_consensus_fn(mesh, w))
+    return jax.make_jaxpr(fn)(jax.ShapeDtypeStruct((w,), np.int32))
+
+
+from ..analysis.registry import (declare_builder,  # noqa: E402
+                                 decl_shapes as _decl_shapes,
+                                 unwrap as _unwrap)
+
+declare_builder(f"{__name__}._event_bounds_fn", _trace_event_bounds,
+                tags=("stream",))
+declare_builder("cylon_tpu.exec.recovery._consensus_fn[stream.watermark]",
+                _trace_watermark_consensus, collectives={"pmax"},
+                tags=("stream", "recovery"))

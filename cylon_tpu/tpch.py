@@ -1,5 +1,5 @@
-"""TPC-H subset: data generator + a 16-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q14 Q15 Q16 Q17 Q18 Q19 Q21 Q22).
+"""TPC-H subset: data generator + a 17-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -16,9 +16,11 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   a user would port them — together they cover join+conditional-agg
   (Q14), groupby-HAVING semi-join (Q18), disjunctive multi-attribute
   filters (Q19), the round-5 NOT-EXISTS family on true SEMI/ANTI joins
-  (Q16 Q21 Q22), and — round 7, for the serving tier's mixed-traffic
+  (Q16 Q21 Q22), — round 7, for the serving tier's mixed-traffic
   plan shapes — scalar-subquery HAVING (Q11), an aggregate view with a
-  scalar-max equi-select (Q15) and a correlated-avg subquery (Q17);
+  scalar-max equi-select (Q15) and a correlated-avg subquery (Q17), and
+  — round 9, alongside the streaming ingest tier — Q20's nested
+  IN-subqueries over streaming-friendly partsupp semantics;
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -60,6 +62,13 @@ CONTAINERS = np.asarray([f"{s} {c}" for s in ("SM", "MED", "LG", "JUMBO",
                                               "WRAP")
                          for c in ("CASE", "BOX", "BAG", "JAR", "PKG",
                                    "PACK", "CAN", "DRUM")])
+#: closed p_name vocabulary (Q20's ``p_name LIKE 'forest%'`` becomes an
+#: exact-value IN over the forest-prefixed entries — the engine has no
+#: device-side substring, same documented simplification as Q22's phone
+#: prefix)
+PNAME_ADJ = ("almond", "antique", "azure", "forest", "frosted", "lavender")
+PNAME_NOUN = ("beige", "blush", "cream", "linen", "misty")
+PNAMES = np.asarray([f"{a} {n}" for a in PNAME_ADJ for n in PNAME_NOUN])
 
 
 def _ts(date: str) -> int:
@@ -195,6 +204,11 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
     rng4 = np.random.default_rng(seed + 15485863)
     partsupp["ps_supplycost"] = np.round(
         rng4.uniform(1.0, 1000.0, len(ps_partkey)), 2)
+    # Q20 addition (round 9) draws from a FIFTH independent stream so
+    # every earlier table/column stays byte-identical (same regression-
+    # baseline rule as the rng2/rng3/rng4 blocks above)
+    rng5 = np.random.default_rng(seed + 32452843)
+    part["p_name"] = PNAMES[rng5.integers(0, len(PNAMES), n_part)]
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
             "part": part, "partsupp": partsupp}
@@ -951,6 +965,76 @@ def q17_pandas(pdfs: dict, brand: str = "Brand#23",
 
 
 # ---------------------------------------------------------------------------
+# Q20 — potential part promotion (nested IN-subqueries over partsupp)
+# ---------------------------------------------------------------------------
+
+def q20(dfs: dict, env=None, name_prefix: str = "forest",
+        nation: str = "CANADA", date_lo: str = "1994-01-01",
+        date_hi: str = "1995-01-01"):
+    """SELECT s_name FROM supplier, nation WHERE s_suppkey IN (SELECT
+    ps_suppkey FROM partsupp WHERE ps_partkey IN (SELECT p_partkey FROM
+    part WHERE p_name LIKE :prefix%) AND ps_availqty > (SELECT
+    0.5*sum(l_quantity) FROM lineitem WHERE l_partkey = ps_partkey AND
+    l_suppkey = ps_suppkey AND l_shipdate IN [:lo, :hi))) AND
+    s_nationkey = n_nationkey AND n_name = :nation ORDER BY s_name.
+
+    The streaming-friendly partsupp semantics: the correlated half-sum
+    subquery decomposes into a two-key groupby over the date-filtered
+    lineitem joined back onto partsupp (an empty inner sum is NULL in
+    SQL — comparison false — which the inner join reproduces), the
+    nested INs become a filter + two SEMI joins, and LIKE 'forest%'
+    rides the closed p_name vocabulary as exact-value equality
+    (documented simplification, same as Q22's phone prefix; the pandas
+    oracle uses a real str.startswith)."""
+    p = dfs["part"]
+    forest = [v for v in PNAMES.tolist() if v.startswith(name_prefix)]
+    p = p[_isin(p["p_name"], forest)][["p_partkey"]]
+    l = dfs["lineitem"]
+    l = l[(l["l_shipdate"] >= _ts(date_lo))
+          & (l["l_shipdate"] < _ts(date_hi))]
+    half = (l.groupby(["l_partkey", "l_suppkey"], env=env)
+            .agg([("l_quantity", "sum")]))
+    ps = dfs["partsupp"].merge(p, how="semi", left_on="ps_partkey",
+                               right_on="p_partkey", env=env)
+    j = ps.merge(half, left_on=["ps_partkey", "ps_suppkey"],
+                 right_on=["l_partkey", "l_suppkey"], env=env)
+    f = j[j["ps_availqty"].astype("float64")
+          > 0.5 * j["l_quantity_sum"].astype("float64")]
+    s = dfs["supplier"].merge(f[["ps_suppkey"]], how="semi",
+                              left_on="s_suppkey", right_on="ps_suppkey",
+                              env=env)
+    n = dfs["nation"]
+    n = n[n["n_name"] == nation]
+    out = s.merge(n, left_on="s_nationkey", right_on="n_nationkey",
+                  env=env)
+    return out.sort_values("s_name", env=env)[["s_name"]]
+
+
+def q20_pandas(pdfs: dict, name_prefix: str = "forest",
+               nation: str = "CANADA", date_lo: str = "1994-01-01",
+               date_hi: str = "1995-01-01") -> pd.DataFrame:
+    p = pdfs["part"]
+    pk = set(p[p.p_name.str.startswith(name_prefix)].p_partkey)
+    l = pdfs["lineitem"]
+    l = l[(l.l_shipdate >= pd.Timestamp(date_lo))
+          & (l.l_shipdate < pd.Timestamp(date_hi))]
+    half = (l.groupby(["l_partkey", "l_suppkey"], as_index=False)
+            .agg(l_quantity_sum=("l_quantity", "sum")))
+    ps = pdfs["partsupp"]
+    ps = ps[ps.ps_partkey.isin(pk)]
+    j = ps.merge(half, left_on=["ps_partkey", "ps_suppkey"],
+                 right_on=["l_partkey", "l_suppkey"])
+    sk = set(j[j.ps_availqty.astype(np.float64)
+               > 0.5 * j.l_quantity_sum.astype(np.float64)].ps_suppkey)
+    s = pdfs["supplier"]
+    s = s[s.s_suppkey.isin(sk)]
+    n = pdfs["nation"]
+    s = s.merge(n[n.n_name == nation], left_on="s_nationkey",
+                right_on="n_nationkey")
+    return s.sort_values("s_name")[["s_name"]].reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
 # bench entry (bench.py --tpch)
 # ---------------------------------------------------------------------------
 
@@ -1059,8 +1143,8 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
                "q10": q10, "q11": q11, "q12": q12, "q14": q14, "q15": q15,
-               "q16": q16, "q17": q17, "q18": q18, "q19": q19, "q21": q21,
-               "q22": q22}
+               "q16": q16, "q17": q17, "q18": q18, "q19": q19, "q20": q20,
+               "q21": q21, "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"

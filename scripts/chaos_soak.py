@@ -26,6 +26,15 @@ so the acceptance paths run on every seed; the rest are drawn from
 which has the overlapped scheduler on — every drawn schedule therefore
 also soaks deferred-fault re-raising (exec/pipeline._PieceFuture).
 
+``--stream`` switches to the STREAMING-INGEST acceptance flow
+(cylon_tpu/stream): a seeded micro-batch stream feeds a StreamTable +
+IncrementalView whose absorbed partials commit durably per batch; the
+pinned schedules SIGKILL the process mid-ingest (``stream.append::3=
+kill`` and a kill during the view's ckpt.write) and the resumed rerun
+must fast-forward the committed stream-view state (ffwd > 0; the
+per-batch partials are the durable unit — windowed-join buffers replay
+from upstream) with the final view bit-equal to the baseline.
+
 ``--concurrent K`` switches to the MULTI-TENANT acceptance flow
 (exec/scheduler): K differently-seeded serving sessions interleave on
 one mesh; the pinned schedule SIGKILLs the process mid-query in tenant
@@ -132,6 +141,9 @@ def worker(args) -> int:
             return sink.finalize()
         return attempt
 
+    if args.stream:
+        return _worker_stream(args, env)
+
     if args.concurrent > 1:
         return _worker_concurrent(args, env, make_workload)
 
@@ -153,6 +165,114 @@ def worker(args) -> int:
         **checkpoint.stats(),
     }), flush=True)
     return 0
+
+
+def _worker_stream(args, env) -> int:
+    """The streaming-ingest acceptance workload (cylon_tpu/stream): a
+    seeded micro-batch stream appended into a StreamTable + an
+    IncrementalView whose absorbed partials commit durably per batch
+    (one checkpoint piece per append with CYLON_TPU_CKPT_DIR armed).  A
+    SIGKILL mid-ingest (``stream.append::N=kill`` or a kill during the
+    view's ckpt.write) crashes the process between commits; the resumed
+    rerun replays the SAME seeded stream, fast-forwards the committed
+    stream-view state — the durable per-batch partials, the only
+    checkpointed streaming state (windowed-join buffers replay from
+    upstream; docs/streaming.md) — with ffwd > 0, and the final view
+    must be bit-equal to the uninterrupted run."""
+    import numpy as np
+
+    from cylon_tpu.exec import checkpoint, recovery
+    from cylon_tpu.stream import IncrementalView, StreamTable
+
+    rng = np.random.default_rng(20260804)
+    st = StreamTable(env, key="k", name="soak")
+    view = IncrementalView(
+        st, "k", [("v", "sum"), ("v", "mean"), ("v", "var")],
+        name="soak_view", env=env)
+    n_batches = max(args.rows // 500, 6)
+    for _ in range(n_batches):
+        st.append({"k": rng.integers(0, 64, 500).astype(np.int64),
+                   "v": rng.integers(-100, 100, 500).astype(np.float64)})
+    df = view.read().to_pandas().sort_values("k").reset_index(drop=True)
+    print(json.dumps({
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
+        "batches": n_batches, "ffwd": view.fast_forwarded,
+        "events": len(recovery.recovery_events()),
+        **checkpoint.stats(),
+    }), flush=True)
+    return 0
+
+
+def run_stream(args) -> int:
+    """The ``--stream`` acceptance flow (pinned, not drawn): baseline →
+    SIGKILL mid-ingest with checkpointing armed → resume.  The resume
+    must fast-forward the committed stream-view state (ffwd > 0 —
+    restored per-batch partials, not recomputed appends; windowed-join
+    buffers are not checkpointed and replay from upstream) and end
+    bit-equal to the uninterrupted baseline."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_stream_")
+    failures: list = []
+
+    base_p, base = _spawn(args, os.path.join(args.workdir, "base"), "",
+                          resume=False, stream=True)
+    if base_p.returncode != 0 or not base or not base.get("sha"):
+        print((base_p.stdout + base_p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: stream baseline failed", file=sys.stderr)
+        return 1
+    print(f"# stream baseline sha={base['sha'][:16]} "
+          f"batches={base['batches']}", flush=True)
+
+    # pinned schedules: a hard kill at the Nth append, and one during
+    # the view's checkpoint write — both mid-ingest, both must resume
+    for faults in ("stream.append::3=kill", "ckpt.write::2=kill"):
+        killdir = os.path.join(args.workdir,
+                               faults.split("=")[0].replace(":", "_"))
+        p, info = _spawn(args, killdir, faults, resume=False, stream=True)
+        if p.returncode != -9:
+            failures.append(
+                f"stream kill ({faults!r}) did not crash the process "
+                f"(rc={p.returncode})")
+            continue
+        p2, info2 = _spawn(args, killdir, "", resume=True, stream=True)
+        if p2.returncode != 0 or not info2:
+            failures.append(f"stream resume ({faults!r}) failed "
+                            f"rc={p2.returncode}: "
+                            f"{(p2.stdout + p2.stderr)[-2000:]}")
+        elif info2.get("sha") != base["sha"]:
+            failures.append(
+                f"stream resume ({faults!r}) diverged: {info2}")
+        elif not info2.get("ffwd"):
+            failures.append(
+                f"stream resume ({faults!r}) recomputed committed "
+                f"window state: {info2}")
+        else:
+            print(f"# stream {faults!r} + resume -> ok "
+                  f"(ffwd={info2['ffwd']})", flush=True)
+
+    # injection sanity: a predicted fault at the append site surfaces
+    # TYPED — stream.append has no retry rung (an append is not a
+    # guarded operator with a fallback), so the contract is a loud
+    # typed abort, never a silent wrong answer
+    p, info = _spawn(args, os.path.join(args.workdir, "pred"),
+                     "stream.append::2=predicted", resume=False,
+                     stream=True)
+    if p.returncode == 0:
+        failures.append(
+            f"stream predicted fault was swallowed (rc=0): {info}")
+    elif "PredictedResourceExhausted" not in (p.stdout + p.stderr):
+        failures.append(
+            f"stream predicted fault did not surface typed "
+            f"(rc={p.returncode})")
+    else:
+        print("# stream predicted-fault schedule -> ok (typed abort)",
+              flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"stream": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
 
 
 def _worker_concurrent(args, env, make_workload) -> int:
@@ -269,7 +389,7 @@ def _pinned_schedules() -> list[dict]:
 
 def _spawn(args, workdir: str, faults: str, resume: bool,
            extra_env: dict | None = None, concurrent: int = 1,
-           only: int | None = None) -> tuple:
+           only: int | None = None, stream: bool = False) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env["JAX_PLATFORMS"] = "cpu"
@@ -286,6 +406,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
            f"--concurrent={concurrent}"]
     if only is not None:
         cmd.append(f"--only={only}")
+    if stream:
+        cmd.append("--stream")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -417,11 +539,19 @@ def main() -> int:
     ap.add_argument("--only", type=int, default=None,
                     help="(worker) restrict the concurrent scheduler to "
                          "one tenant — the solo bit-equality leg")
+    ap.add_argument("--stream", action="store_true",
+                    help="run the streaming-ingest acceptance flow "
+                         "(SIGKILL mid-ingest with checkpointing armed; "
+                         "resume must fast-forward committed window "
+                         "state and stay bit-equal)")
     args = ap.parse_args()
 
     if args.worker:
         sys.path.insert(0, REPO)
         return worker(args)
+
+    if args.stream:
+        return run_stream(args)
 
     if args.concurrent > 1:
         return run_concurrent(args)

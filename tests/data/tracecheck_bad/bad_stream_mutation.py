@@ -1,0 +1,22 @@
+"""Known-bad fixture for TS110: GroupBySink partial state mutated, and
+window-lifetime ledger entry points called, outside cylon_tpu/stream/
+(this file stands in for an operator module — streaming state
+transitions must ride the sink absorb/snapshot API and the watermark
+close lifecycle)."""
+
+
+def poke_sink(sink, part, reg):
+    # direct write of the sink's partial list: a live IncrementalView's
+    # read() no longer matches the rows absorbed
+    sink._parts = [part]                      # TS110
+    sink._parts.append(part)                  # TS110
+    sink._adopted = 0                         # TS110
+    sink._regs.clear()                        # TS110
+
+
+def poke_window(memory, arrays, reg):
+    # window-lifetime residency managed outside the stream package:
+    # the close lifecycle's eviction accounting never sees these
+    r = memory.register_window("rogue", arrays)   # TS110
+    memory.evict_release(reg)                     # TS110
+    return r

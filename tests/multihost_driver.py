@@ -169,6 +169,47 @@ assert len({int(s) for s in sigs}) == 1, (seq, sigs)
 recovery.install_faults("")
 print(f"SPILL_OK pid={pid} evictions={seq}", flush=True)
 
+# Streaming window-close determinism (cylon_tpu/stream, docs/
+# streaming.md): both processes ingest the same seeded micro-batches
+# into a TumblingWindowJoin; the watermark min-vote
+# (recovery.watermark_consensus over the pmax wire) must make every
+# rank close the IDENTICAL windows at the same step, and the closed
+# windows' joined contents must hash identically across ranks
+# (allgathered crc over the sorted output bytes).
+import hashlib as _hashlib
+
+from cylon_tpu.stream import TumblingWindowJoin
+
+env.barrier()
+srng = np.random.default_rng(29)   # same seed per process: SPMD ingest
+dims = ct.Table.from_pydict(
+    {"k": np.arange(16, dtype=np.int64),
+     "dim": np.arange(16, dtype=np.int64) * 3}, env)
+wj = TumblingWindowJoin(env, key="k", time_col="t", window=100,
+                        build=dims, build_on="k", lateness=10)
+for i in range(3):
+    wj.append({"k": srng.integers(0, 16, 300).astype(np.int64),
+               "t": (i * 100 + srng.integers(0, 100, 300)).astype(np.int64),
+               "v": srng.integers(0, 50, 300).astype(np.int64)})
+agreed = wj.watermark()
+assert wj.windows_closed >= 1, wj.stats()
+closed_sig = []
+for wid, out in wj.closed:
+    h = _hashlib.sha256()
+    if out is not None:
+        cdf = (out.to_pandas().sort_values(["k", "t", "v"])
+               .reset_index(drop=True))
+        h.update(cdf.to_csv(index=False).encode())
+    closed_sig.append((wid, zlib.crc32(h.hexdigest().encode())))
+wire = np.asarray([agreed, len(closed_sig)]
+                  + [x for p_ in closed_sig for x in p_], np.int64)
+gathered = np.asarray(multihost_utils.process_allgather(wire))
+gathered = gathered.reshape(nproc, -1)
+for r in range(1, nproc):
+    assert np.array_equal(gathered[0], gathered[r]), gathered
+print(f"STREAM_OK pid={pid} agreed={agreed} closed={len(closed_sig)}",
+      flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

@@ -256,6 +256,34 @@ def test_ts109_sanctioned_modules_exempt():
         "cylon_tpu/tpch.py", src))
 
 
+def test_ts110_stream_state_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_stream_mutation.py")) if f.rule == "TS110"]
+    # _parts assign, _parts.append, _adopted assign, _regs.clear,
+    # register_window, evict_release
+    assert len(found) == 6, found
+    assert any("absorb/snapshot" in f.message for f in found)
+    assert any("window-lifetime" in f.message for f in found)
+
+
+def test_ts110_sanctioned_modules_exempt():
+    src = ("def poke(sink, memory, reg, part):\n"
+           "    sink._parts.append(part)\n"
+           "    memory.evict_release(reg)\n")
+    # the stream package and the defining modules are sanctioned;
+    # anywhere else in the package fires
+    assert not any(f.rule == "TS110" for f in ast_lint.lint_source(
+        "cylon_tpu/stream/view.py", src))
+    assert not any(f.rule == "TS110" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", src))
+    assert not any(f.rule == "TS110" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/memory.py", src))
+    assert any(f.rule == "TS110" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/groupby.py", src))
+    assert any(f.rule == "TS110" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/scheduler.py", src))
+
+
 def test_package_lints_clean():
     found = ast_lint.lint_paths([PKG])
     assert found == [], "\n".join(map(str, found))
@@ -265,7 +293,7 @@ def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
-                                       "TS109"}
+                                       "TS109", "TS110"}
 
 
 # ---------------------------------------------------------------------------
