@@ -212,10 +212,14 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # durable-checkpoint traffic (exec/checkpoint): a number with
             # checkpoint_events > 0 paid page writes in-loop; one with
             # resume_fast_forwarded_pieces > 0 restored committed pieces
-            # instead of recomputing them (CYLON_TPU_RESUME=1)
+            # instead of recomputing them (CYLON_TPU_RESUME=1).
+            # resume_world_mismatch vs resume_resharded_pieces tells
+            # "resharded and fast-forwarded" apart from "threw the
+            # checkpoint away" after a topology change (elastic resume)
             **{k: v for k, v in checkpoint.stats().items() if k in
                ("checkpoint_events", "bytes_checkpointed",
-                "resume_fast_forwarded_pieces")},
+                "resume_fast_forwarded_pieces", "resume_resharded_pieces",
+                "resume_world_mismatch")},
         },
     }
 

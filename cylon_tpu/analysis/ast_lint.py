@@ -58,6 +58,15 @@ function within the same module) — and flags:
   statically visible (a variable ``donate=donate``) are not tracked —
   the rule under-approximates, like the rest of this pass.
 
+* **TS111** reads of a *foreign* rank's checkpoint directory — a
+  ``rank<r>`` path constructed off the checkpoint dir (``rank0``,
+  ``f"rank{r}"``, …) in any module except ``exec/checkpoint.py``: the
+  elastic re-shard path (``Stage.load_foreign_pieces``) is the one
+  sanctioned cross-rank reader, because it sha-verifies every page,
+  resolves the manifest GENERATION (a rewrite supersedes stale old-world
+  dirs) and min-votes the adoption over the live mesh — an ad-hoc read
+  can splice a stale generation's or a torn write's state in;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -79,6 +88,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 
 from .rules import Finding, file_suppressed, is_suppressed, suppressions
 
@@ -100,6 +110,16 @@ _RECOVERY_MODULE = "exec/recovery.py"
 #: exec/memory HBM ledger
 _RESIDENCY_DIRS = ("relational", "parallel")
 _RESIDENCY_FUNCS = {"device_put", "device_get"}
+
+#: the one module that may read ANOTHER rank's checkpoint directory
+#: (TS111): the elastic re-shard path sha-verifies pages, resolves the
+#: manifest generation and consensus-votes the adoption — everything an
+#: ad-hoc cross-rank read would skip
+_CKPT_SANCTIONED_FILE = "exec/checkpoint.py"
+#: a string literal (incl. an f-string's literal part) naming a rank
+#: directory: "rank0", "rank%d", the f"rank{r}" prefix, a joined
+#: ".../rank3/..." segment
+_RANK_DIR_LITERAL = re.compile(r"(^|/)rank(\d|\{|%|$)")
 
 #: modules that may not write checkpoint artifacts directly (TS107):
 #: relational/ operators and the pipelined range loop — all durable
@@ -399,6 +419,7 @@ class _ModuleLint:
         self._check_ckpt_artifacts()
         self._check_use_after_donate()
         self._check_direct_admission()
+        self._check_foreign_rank_read()
         self._check_stream_state()
         return self.findings
 
@@ -561,6 +582,32 @@ class _ModuleLint:
                     "two-phase rank-coherent manifest commit); a direct "
                     "artifact has no hash and no commit epoch, so resume "
                     "could restore torn or rank-divergent state")
+
+    def _check_foreign_rank_read(self) -> None:
+        """TS111: a ``rank<r>`` checkpoint path constructed off the ckpt
+        dir anywhere outside ``exec/checkpoint.py``.  Rank directories
+        are that module's private on-disk layout: the re-shard path
+        reads foreign dirs under per-page sha verification, a manifest
+        GENERATION scan (a post-reshard rewrite supersedes stale
+        old-world dirs) and the min-consensus resume vote.  A direct
+        cross-rank read — `os.path.join(ckpt_dir, f"rank{r}", ...)` and
+        friends — sees none of that and can splice a stale generation's
+        or torn write's state into a resume."""
+        norm = self.path.replace(os.sep, "/")
+        if norm.endswith(_CKPT_SANCTIONED_FILE):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _mentions_ckpt_path(node) and _mentions_rank_dir(node):
+                self._emit(
+                    "TS111", node,
+                    f"`{_func_name(node.func)}` constructs a rank<r> "
+                    "checkpoint path outside exec/checkpoint.py — "
+                    "foreign rank directories may only be read by the "
+                    "elastic re-shard path (Stage.load_foreign_pieces), "
+                    "which sha-verifies pages, resolves the manifest "
+                    "generation and consensus-votes the adoption")
 
     def _check_direct_admission(self) -> None:
         """TS109: a direct call of a ledger admission/eviction entry
@@ -801,6 +848,20 @@ def _linear_stmts(body: list):
     read can never be flagged against a donation that runs after it or
     against a binding that shadows the donated buffer."""
     return list(body)
+
+
+def _mentions_rank_dir(node: ast.Call) -> bool:
+    """Does the call's argument subtree contain a string literal naming
+    a ``rank<r>`` directory segment?  f-strings contribute their literal
+    parts (``f"rank{r}"`` → Constant ``"rank"``), so the common
+    construction shapes are all covered; plain identifiers like
+    ``rank`` variables are NOT flagged (the rule keys on the on-disk
+    layout's literal, like TS107 keys on the ckpt-path mention)."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and _RANK_DIR_LITERAL.search(sub.value)):
+            return True
+    return False
 
 
 def _mentions_ckpt_path(node: ast.Call) -> bool:

@@ -44,6 +44,10 @@ RULES = {
              "exec/scheduler.py and exec/memory.py (admission must be "
              "scheduler-mediated so multi-tenant footprints and "
              "cross-tenant evictions stay attributed)",
+    "TS111": "foreign-rank checkpoint directory read outside "
+             "exec/checkpoint.py (a rank<r> path constructed off the "
+             "ckpt dir skips the re-shard path's sha verification, "
+             "generation scan and resume consensus)",
     "TS110": "GroupBySink partials mutated or window-lifetime state "
              "registered/evicted outside cylon_tpu/stream/ (and the "
              "defining modules) — streaming state transitions must ride "

@@ -143,6 +143,11 @@ class CylonEnv:
         # the operator compile ladders dispatch on it (exec/recovery)
         from ..exec.recovery import prime_compiler_probe
         prime_compiler_probe()
+        # spot/preemptible semantics: arm the SIGTERM grace drain when
+        # CYLON_TPU_PREEMPT_GRACE_S declares a budget (exec/preempt —
+        # one env read and no handler otherwise)
+        from ..exec.preempt import install as _install_preempt
+        _install_preempt()
         self._conf: dict[str, str] = {}
         self._finalized = False
         self.serial = CylonEnv._next_serial

@@ -7,6 +7,7 @@ from .pipeline import (GroupBySink, chunk_table,  # noqa: F401
                        pipelined_join, pipelined_set_op)
 from . import checkpoint  # noqa: F401  — durable checkpoint/resume rung
 from . import memory  # noqa: F401  — HBM budget ledger + host spill tier
+from . import preempt  # noqa: F401  — SIGTERM preemption-grace drain
 from . import recovery  # noqa: F401  — rank-coherent failure recovery
 from . import scheduler  # noqa: F401  — multi-tenant serving tier
 from .scheduler import QueryScheduler  # noqa: F401

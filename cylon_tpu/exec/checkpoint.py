@@ -51,6 +51,22 @@ MapReduce/Spark line (PAPERS.md), this module adds the missing
    :class:`~cylon_tpu.status.ResumableAbort` carrying the resume token
    instead of a bare abort.
 
+5. **Elastic resume** (docs/robustness.md "Elastic resume & preemption
+   grace"): stages carry a world-invariant BASE token next to the full
+   layout token; a resume whose checkpoint was committed at a DIFFERENT
+   topology (world size or process layout) re-shards complete stages —
+   foreign rank dirs' pages sha-verified, shard prefixes stitched into
+   global row order, re-blocked through ``relational/repart``'s
+   order-preserving split, re-voted and re-committed over the NEW mesh
+   (:meth:`Stage.load_foreign_pieces` / :meth:`Stage.begin_rewrite`) —
+   and counts what it could not adopt (``resume_world_mismatch``)
+   instead of silently recomputing.  **Preemption grace**
+   (:mod:`cylon_tpu.exec.preempt`): SIGTERM with
+   ``CYLON_TPU_PREEMPT_GRACE_S`` armed drains at the next checkpoint
+   boundary (:func:`drain_requested` → :func:`drain_abort`, the drain
+   vote rank-coherent) so a spot scale-down is a planned
+   ``ResumableAbort``, not a mid-piece crash.
+
 Happy path contract: with ``CYLON_TPU_CKPT_DIR`` unset this module's
 entry points are a couple of env reads — ZERO filesystem writes, zero
 extra collectives, no measurable cost on the pipelined hot path.  In a
@@ -76,10 +92,11 @@ import io
 import json
 import os
 import pickle
+import re
 
 import numpy as np
 
-from ..status import CheckpointCorruptError
+from ..status import CheckpointCorruptError, InvalidError, ResumableAbort
 from ..utils import timing
 
 
@@ -107,7 +124,8 @@ def resume_requested() -> bool:
 # ---------------------------------------------------------------------------
 
 _STATS = {"checkpoint_events": 0, "bytes_checkpointed": 0,
-          "resume_fast_forwarded_pieces": 0, "corrupt_pages": 0}
+          "resume_fast_forwarded_pieces": 0, "corrupt_pages": 0,
+          "resume_resharded_pieces": 0, "resume_world_mismatch": 0}
 
 
 def stats() -> dict:
@@ -115,7 +133,13 @@ def stats() -> dict:
     ``checkpoint_events`` (committed piece checkpoints),
     ``bytes_checkpointed`` (page bytes written),
     ``resume_fast_forwarded_pieces`` (pieces restored instead of
-    recomputed) and ``corrupt_pages`` (hash-mismatch fallbacks)."""
+    recomputed), ``corrupt_pages`` (hash-mismatch fallbacks),
+    ``resume_resharded_pieces`` (pieces adopted across a topology
+    change — always also counted as fast-forwarded) and
+    ``resume_world_mismatch`` (stages whose checkpoint came from a
+    DIFFERENT topology: together with ``resume_resharded_pieces`` an
+    operator can tell "resharded and fast-forwarded" apart from "threw
+    the checkpoint away and recomputed")."""
     return dict(_STATS)
 
 
@@ -129,8 +153,35 @@ def unrestore(k: int) -> None:
     a multiprocess resume adopts the MINIMUM restorable prefix across
     ranks (:func:`cylon_tpu.exec.recovery.ckpt_resume_consensus`), so
     pieces a rank restored beyond the agreed prefix are recomputed and
-    must not count as fast-forwarded."""
-    _STATS["resume_fast_forwarded_pieces"] -= int(k)
+    must not count as fast-forwarded.  Backing out more than was ever
+    counted is a consensus bug, not a bookkeeping nuance: the counter
+    clamps at zero (a later bench read can never report a negative
+    fast-forward) and a typed :class:`InvalidError` surfaces the
+    over-unrestore loudly."""
+    k = int(k)
+    if k < 0:
+        raise InvalidError(f"unrestore({k}): negative back-out")
+    have = _STATS["resume_fast_forwarded_pieces"]
+    if k > have:
+        _STATS["resume_fast_forwarded_pieces"] = 0
+        raise InvalidError(
+            f"unrestore({k}) exceeds the {have} restores counted — the "
+            "resume consensus agreed on more discards than this rank "
+            "ever restored (counter clamped at zero)")
+    _STATS["resume_fast_forwarded_pieces"] = have - k
+
+
+def note_reshard(k: int) -> None:
+    """Count ``k`` pieces adopted across a topology change: they fast-
+    forwarded (the resumed loop skips their work) AND they resharded
+    (their host pages were stitched and re-blocked onto the new mesh) —
+    both counters move so the bench detail distinguishes an elastic
+    adoption from a plain same-world fast-forward."""
+    k = int(k)
+    _STATS["resume_fast_forwarded_pieces"] += k
+    _STATS["resume_resharded_pieces"] += k
+    for _ in range(k):
+        timing.bump("ckpt.piece_resharded")
 
 
 # ---------------------------------------------------------------------------
@@ -160,16 +211,48 @@ def reset_stages() -> None:
 
 def plan_token(*parts) -> str:
     """Deterministic token over a stage's static plan (pass plain python
-    ints/strs/tuples): resume restores a committed piece only when the
-    fresh process derived the IDENTICAL plan — a changed workload, chunk
-    count or world size silently starts the stage over instead of
-    splicing foreign state in."""
+    ints/strs/tuples).  Stages carry TWO tokens (docs/robustness.md
+    "Elastic resume & preemption grace"): a world-invariant BASE token
+    over the workload identity (operator, keys, chunk count, consumption
+    mode — nothing layout-derived), and the full LAYOUT token folding
+    the base together with world size, piece capacities and per-range
+    row counts.  A full-token match fast-forwards bit-identically; a
+    base-only match with a different recorded topology takes the
+    re-shard path (committed host pages stitched into global row order
+    and re-blocked onto the live mesh); no match at all starts the
+    stage over — foreign state is never spliced in."""
     return hashlib.sha1(repr(parts).encode()).hexdigest()[:16]
 
 
 def _rank() -> int:
     import jax
     return jax.process_index()
+
+
+def _procs() -> int:
+    import jax
+    return jax.process_count()
+
+
+_RANK_DIR_RE = re.compile(r"rank(\d+)$")
+
+
+def _rank_dirs() -> list[str]:
+    """``rank<r>`` directory names under the checkpoint root, sorted by
+    rank.  The elastic re-shard scan reads ALL of them (this module is
+    the one sanctioned reader of foreign rank directories — lint rule
+    TS111): with a shared checkpoint root (the GKE PVC drill,
+    deploy/gke/README.md) every live rank sees every old rank's pages;
+    with rank-local disks a world change degrades to recompute because
+    the foreign shards simply are not visible."""
+    root = ckpt_dir()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    ranked = [(int(m.group(1)), n) for n in names
+              if (m := _RANK_DIR_RE.fullmatch(n))]
+    return [n for _, n in sorted(ranked)]
 
 
 # ---------------------------------------------------------------------------
@@ -204,52 +287,189 @@ class Stage:
     hashed meta sidecars under the per-rank stage directory, committed
     under the two-phase manifest.  Obtain via :func:`open_stage`."""
 
-    def __init__(self, env, label: str, token: str, seq: int):
+    def __init__(self, env, label: str, token: str, seq: int,
+                 base_token: str | None = None):
         self.env = env
         self.label = label
         self.token = token
-        self.dir = os.path.join(ckpt_dir(), f"rank{_rank()}",
-                                f"stage{seq:03d}-{label}")
+        self.base = base_token
+        self._dirname = f"stage{seq:03d}-{label}"
+        self.dir = os.path.join(ckpt_dir(), f"rank{_rank()}", self._dirname)
         os.makedirs(self.dir, exist_ok=True)
         self.epoch = 0
+        #: manifest generation — monotonic across sessions sharing this
+        #: checkpoint root: seeded above anything already on disk, and
+        #: bumped again by a re-shard rewrite (scan keeps the max)
+        self.gen = 0
+        self.complete_flag = False
         self.committed: dict[int, dict] = {}
         self.resuming = False
+        #: world-mismatch resume state: {"world", "procs", "gen",
+        #: "complete", "pieces", "manifests": {rank_dirname: manifest}} —
+        #: set when the current manifest generation for this stage was
+        #: written by a DIFFERENT topology (see _resolve_resume)
+        self.foreign: dict | None = None
         if resume_requested():
-            man = self._read_manifest()
-            if man is not None and man.get("plan") == token:
-                self.committed = {int(k): v
-                                  for k, v in man.get("pieces", {}).items()}
-                self.epoch = int(man.get("epoch", 0))
-                self.resuming = bool(self.committed)
-            elif man is not None:
-                from ..utils.logging import log
-                log.warning(
-                    "checkpoint stage %s: plan token mismatch (manifest %s, "
-                    "workload %s) — stale checkpoint ignored, stage starts "
-                    "over", self.dir, man.get("plan"), token)
+            self._resolve_resume()
+        else:
+            # FRESH run over a non-empty stage dir landscape: supersede
+            # whatever previous sessions parked here.  Generations must
+            # be monotonic ACROSS sessions — a fresh run re-starting at
+            # gen 0 would leave an earlier reshard rewrite's gen-1
+            # manifests outranking ITS commits at the next resume,
+            # silently fast-forwarding a previous run's data
+            mans = self._scan_manifests()
+            if mans:
+                self.gen = max(int(m.get("gen", 0))
+                               for m in mans.values()) + 1
 
     # -- manifest ----------------------------------------------------------
     @property
     def _manifest_path(self) -> str:
         return os.path.join(self.dir, "MANIFEST.json")
 
-    def _read_manifest(self) -> dict | None:
+    def _read_manifest(self, rank_dirname: str | None = None) -> dict | None:
+        path = self._manifest_path if rank_dirname is None else os.path.join(
+            ckpt_dir(), rank_dirname, self._dirname, "MANIFEST.json")
         try:
-            with open(self._manifest_path, encoding="utf-8") as f:
+            with open(path, encoding="utf-8") as f:
                 return json.load(f)
         except (OSError, ValueError):
             return None
 
+    def _scan_manifests(self) -> dict:
+        """Every rank dir's manifest for THIS stage (rank dirname →
+        manifest).  One small JSON read per rank dir, once per stage
+        handle — negligible next to the page traffic it arbitrates,
+        and the price of generation monotonicity: an own-manifest-only
+        shortcut would let a rank resume from a manifest a later
+        reshard rewrite (possibly covering fewer ranks) already
+        superseded."""
+        mans: dict = {}
+        for rd in _rank_dirs():
+            man = self._read_manifest(rd)
+            if man is not None:
+                mans[rd] = man
+        return mans
+
+    def _resolve_resume(self) -> None:
+        """Decide what this stage can restore.  The scan reads every
+        ``rank<r>`` dir's manifest for this stage and keeps the highest
+        GENERATION whose manifests agree (same plan, world, gen) — a
+        re-shard rewrite bumps ``gen``, so rank dirs the rewrite did not
+        cover (the old world had more ranks) are recognized as stale
+        instead of masquerading as restorable state.  Three outcomes:
+
+        * current generation matches this stage's full layout token AND
+          live topology → plain fast-forward (``resuming``);
+        * current generation matches only the BASE token, from a
+          different world/process layout → the re-shard path
+          (``foreign``; counted in ``resume_world_mismatch`` with a
+          structured recovery event, so "resharded" vs "thrown away" is
+          auditable — before this rung the mismatch was a SILENT
+          recompute);
+        * anything else → stale, stage starts over (logged)."""
+        from ..utils.logging import log
+        mans = self._scan_manifests()
+        if not mans:
+            return
+        top_gen = max(int(m.get("gen", 0)) for m in mans.values())
+        cur = {rd: m for rd, m in mans.items()
+               if int(m.get("gen", 0)) == top_gen}
+        plans = {m.get("plan") for m in cur.values()}
+        worlds = {int(m.get("world", 0)) for m in cur.values()}
+        procs = {int(m.get("procs", 1)) for m in cur.values()}
+        if len(plans) != 1 or len(worlds) != 1 or len(procs) != 1:
+            log.warning("checkpoint stage %s: rank manifests disagree at "
+                        "generation %d (plans %s, worlds %s, procs %s) — "
+                        "torn checkpoint ignored, stage starts over",
+                        self._dirname, top_gen, plans, worlds, procs)
+            self.gen = top_gen + 1   # the recompute supersedes the mess
+            return
+        plan, world = plans.pop(), worlds.pop()
+        same_topo = (world == int(self.env.world_size)
+                     and procs == {_procs()})
+        if plan == self.token and same_topo:
+            # adopt the current generation even when THIS rank's own
+            # manifest is missing/unreadable (it recomputes, voted down
+            # to 0 by the resume consensus): committing below the
+            # on-disk generation would hand the NEXT resume's max-gen
+            # scan stale data over this run's fresh commits
+            self.gen = top_gen
+            own = cur.get(f"rank{_rank()}")
+            if own is None:
+                return
+            self.committed = {int(k): v
+                              for k, v in own.get("pieces", {}).items()}
+            self.epoch = int(own.get("epoch", 0))
+            self.gen = int(own.get("gen", 0))
+            self.complete_flag = bool(own.get("complete", False))
+            self.resuming = bool(self.committed)
+            return
+        base = {m.get("base") for m in cur.values()}
+        if (self.base is not None and base == {self.base}
+                and not same_topo):
+            # every rank dir must hold a piece for it to be adoptable
+            # (each dir contributes that rank's shard blocks); the
+            # contiguous common prefix is the restorable unit
+            common = set.intersection(*[
+                {int(k) for k in m.get("pieces", {})} for m in cur.values()])
+            n = 0
+            while n in common:
+                n += 1
+            # "complete" for WHOLE-stage adoption means the contiguous
+            # common prefix covers the piece count recorded at
+            # completion time on every rank — the complete flag alone
+            # would let a truncated (torn/tampered) piece table adopt a
+            # prefix as if it were the whole stage, a wrong answer
+            want = {int(m.get("n_pieces", -1)) for m in cur.values()}
+            complete = (all(bool(m.get("complete", False))
+                            for m in cur.values())
+                        and len(want) == 1 and n == want.pop())
+            info = {"world": world, "procs": procs.pop(), "gen": top_gen,
+                    "complete": complete, "pieces": n, "manifests": cur}
+            self.foreign = info
+            # whatever this run commits — a re-shard rewrite OR a fresh
+            # recompute of an unadoptable stage — supersedes the foreign
+            # generation, so old-world rank dirs the new (possibly
+            # smaller) process set never rewrites read as stale forever
+            self.gen = top_gen + 1
+            _STATS["resume_world_mismatch"] += 1
+            from . import recovery
+            recovery._record("ckpt.reshard", "world_mismatch", "detected")
+            log.warning(
+                "checkpoint stage %s: committed at world=%d (%d rank "
+                "dirs), resuming at world=%d — %s", self._dirname,
+                world, len(cur), int(self.env.world_size),
+                "re-shard path engaged (complete stage, %d pieces)" % n
+                if info["complete"] else
+                "stage incomplete at the old topology: whole-stage "
+                "consumers (pipelined joins) recompute — old-layout "
+                "pieces cannot splice into a new-layout loop — while "
+                "mergeable consumers (stream views) adopt the %d-piece "
+                "committed prefix (counted as resume_world_mismatch "
+                "either way)" % n)
+            return
+        log.warning(
+            "checkpoint stage %s: plan token mismatch (manifest %s, "
+            "workload %s) — stale checkpoint ignored, stage starts "
+            "over", self.dir, plan, self.token)
+        self.gen = top_gen + 1       # the fresh commits supersede it
+
     def _commit(self) -> None:
         """Two-phase manifest commit: stage (atomic rank-local write +
-        fsync), consensus (every rank votes Code.CkptCommit with its
-        staged epoch over the pmax wire), then rename staged →
-        MANIFEST.json.  Single-controller sessions skip the collective
-        entirely."""
+        fsync), consensus (every rank of the LIVE mesh votes
+        Code.CkptCommit with its staged epoch over the pmax wire — after
+        an elastic re-shard that is the NEW mesh; stale old-world rank
+        dirs are not voters), then rename staged → MANIFEST.json.
+        Single-controller sessions skip the collective entirely."""
         from . import recovery
         self.epoch += 1
-        man = {"plan": self.token, "label": self.label, "epoch": self.epoch,
-               "world": int(self.env.world_size),
+        man = {"plan": self.token, "base": self.base, "label": self.label,
+               "epoch": self.epoch, "gen": self.gen,
+               "complete": self.complete_flag,
+               "n_pieces": len(self.committed),
+               "world": int(self.env.world_size), "procs": _procs(),
                "pieces": {str(k): v for k, v in self.committed.items()}}
         staged = self._manifest_path + ".staged"
         with open(staged, "w", encoding="utf-8") as f:
@@ -262,6 +482,40 @@ class Stage:
 
     def has_piece(self, i: int) -> bool:
         return int(i) in self.committed
+
+    @property
+    def foreign_complete(self) -> bool:
+        """True when the world-mismatched checkpoint covers the WHOLE
+        stage — the precondition for adopting a non-mergeable (sinkless
+        piece-output) stage across a topology change: a partial prefix
+        of old-layout pieces has no expressible complement in the new
+        layout, so only a complete stage re-shards; anything less
+        recomputes (never a wrong answer)."""
+        return (self.foreign is not None and self.foreign["complete"]
+                and self.foreign["pieces"] > 0)
+
+    def mark_complete(self) -> None:
+        """Record that the stage finished all its pieces — the flag a
+        LATER world-mismatched resume needs to know the committed set is
+        the whole stage (adoptable) rather than a crash prefix
+        (recompute).  One extra manifest commit per stage on the armed
+        happy path; no-op when already marked."""
+        if self.complete_flag:
+            return
+        self.complete_flag = True
+        self._commit()
+
+    def begin_rewrite(self) -> None:
+        """Start the post-reshard rewrite: the adopted (re-blocked)
+        state re-commits under THIS topology's layout token at the next
+        manifest generation, so a second resume at this world is a plain
+        fast-forward and the old world's surviving rank dirs — which the
+        rewrite may not cover — read as stale (lower gen) forever."""
+        self.gen = int(self.foreign["gen"]) + 1
+        self.committed = {}
+        self.epoch = 0
+        self.resuming = False
+        self.complete_flag = False
 
     # -- save --------------------------------------------------------------
     def save_piece(self, i: int, table) -> None:
@@ -364,8 +618,140 @@ class Stage:
         timing.bump("ckpt.piece_restored")
         return out
 
-    def _read_verified(self, fname: str, want_sha: str) -> bytes:
-        path = os.path.join(self.dir, fname)
+    # -- elastic re-shard (world-mismatch resume) --------------------------
+    def load_foreign_pieces(self, limit: int | None = None,
+                            prefix_ok: bool = False) -> list:
+        """Adopt a world-mismatched checkpoint's committed pieces onto
+        the LIVE mesh — the elastic resume path (docs/robustness.md
+        "Elastic resume & preemption grace").  For each piece, every old
+        ``rank<r>`` directory's pages are read and sha-verified (this is
+        the one sanctioned foreign-rank read, lint rule TS111), the
+        per-shard blocks merged across directories (each old rank held
+        only its addressable shards), the shards' live prefixes stitched
+        into GLOBAL row order, and the rows re-blocked onto the live
+        mesh through :func:`cylon_tpu.relational.repart.
+        even_partition_counts` — the same order-preserving split a
+        fresh ``repartition`` would produce — before re-entering the
+        device through the sanctioned upload boundary
+        (:func:`cylon_tpu.exec.memory.put_blocks`).
+
+        Any missing block, unreadable file or hash mismatch (or an
+        injected ``corrupt`` at site ``ckpt.reshard``) raises a typed
+        :class:`CheckpointCorruptError`: the caller degrades the stage
+        to recompute — corruption never produces a wrong answer.
+
+        Returns the adopted Tables in piece order, re-distributed but
+        NOT yet counted (the caller counts via :func:`note_reshard`
+        after the all-or-nothing resume vote) and NOT yet re-committed
+        (the caller rewrites via :meth:`begin_rewrite` + save_piece so
+        a second resume at this topology is a plain fast-forward).
+        ``limit`` caps the adopted prefix.  ``prefix_ok`` is the
+        mergeable-consumer mode (stream views — piece identity is the
+        world-invariant batch ordinal): a corruption at piece k > 0
+        returns the VERIFIED prefix ``0..k-1`` instead of raising, so
+        one flipped byte in batch 199 of 200 costs one batch, not the
+        stream's whole committed history; join stages keep the raising
+        all-or-nothing contract (:attr:`foreign_complete`)."""
+        from . import recovery
+        if recovery.maybe_inject("ckpt.reshard",
+                                 intercept=("corrupt",)) == "corrupt":
+            _STATS["corrupt_pages"] += 1
+            raise CheckpointCorruptError(
+                "injected checkpoint corruption during re-shard",
+                site="ckpt.reshard")
+        n = self.foreign["pieces"] if limit is None \
+            else min(int(limit), self.foreign["pieces"])
+        out: list = []
+        with timing.region("ckpt.reshard"):
+            for i in range(n):
+                try:
+                    out.append(self._load_one_foreign(i))
+                except CheckpointCorruptError as e:
+                    if not (prefix_ok and out):
+                        raise
+                    recovery._record("ckpt.reshard", "corrupt",
+                                     "prefix_trim")
+                    from ..utils.logging import log
+                    log.warning(
+                        "re-shard of stage %s: piece %d failed "
+                        "verification (%s); adopting the verified "
+                        "%d-piece prefix (mergeable consumer)",
+                        self._dirname, i, e, len(out))
+                    break
+        return out
+
+    def _load_one_foreign(self, i: int):
+        from ..core.column import Column
+        from ..core.table import Table
+        from . import memory
+        meta = None
+        merged: list[list] = []
+        for rd, man in self.foreign["manifests"].items():
+            entry = man["pieces"][str(i)]
+            stage_dir = os.path.join(ckpt_dir(), rd, self._dirname)
+            meta_d = pickle.loads(
+                self._read_verified(entry["meta"], entry["sha"],
+                                    dir=stage_dir))
+            if meta is None:
+                meta = meta_d
+                merged = [[] for _ in meta["pages"]]
+            for j, page in enumerate(meta_d["pages"]):
+                raw = self._read_verified(page["file"], page["sha"],
+                                          dir=stage_dir)
+                blocks = _page_blocks(raw)
+                if len(merged[j]) < len(blocks):
+                    merged[j].extend([None] * (len(blocks) - len(merged[j])))
+                for b, blk in enumerate(blocks):
+                    if blk is not None:
+                        merged[j][b] = blk
+        vc_old = np.asarray(meta["valid_counts"], np.int64)
+        for j, blocks in enumerate(merged):
+            if any(b is None for b in blocks):
+                _STATS["corrupt_pages"] += 1
+                raise CheckpointCorruptError(
+                    f"re-shard of stage {self._dirname} piece {i}: page "
+                    f"{j} is missing shard blocks — an old rank "
+                    "directory is absent or unreadable (is the "
+                    "checkpoint root shared storage?)",
+                    site="ckpt.reshard")
+        from .. import config
+        from ..relational.repart import even_partition_counts
+        total = int(vc_old.sum())
+        w_new = int(self.env.world_size)
+        dest = even_partition_counts(total, w_new)
+        new_cap = config.pow2ceil(max(int(dest.max(initial=0)), 1))
+        dof = np.concatenate([[0], np.cumsum(dest)[:-1]]).astype(np.int64)
+        sharding = self.env.sharding()
+        flats = []
+        for blocks in merged:
+            rows = np.concatenate(
+                [blocks[s][:int(vc_old[s])] for s in range(len(blocks))]) \
+                if blocks else np.zeros(0)
+            new_blocks = []
+            for s in range(w_new):
+                part = rows[int(dof[s]):int(dof[s]) + int(dest[s])]
+                pad = np.zeros((new_cap - part.shape[0],) + part.shape[1:],
+                               part.dtype)
+                new_blocks.append(np.concatenate([part, pad]))
+            flats.append(memory.put_blocks(new_blocks, sharding))
+        flats = iter(flats)
+        cols = {}
+        for cm in meta["cols"]:
+            data = next(flats)
+            validity = next(flats) if cm["has_validity"] else None
+            # the re-block pads with zeros (the old padding is dropped
+            # with the old layout), so bounds must admit 0
+            b = cm["bounds"]
+            nb = (min(b[0], 0), max(b[1], 0)) if b is not None else None
+            cols[cm["name"]] = Column(data, cm["type"], validity,
+                                      cm["dictionary"], bounds=nb)
+        # per-shard key contiguity does not survive re-blocking: the
+        # grouped contract is deliberately dropped, consumers re-derive
+        return Table(cols, self.env, dest)
+
+    def _read_verified(self, fname: str, want_sha: str,
+                       dir: str | None = None) -> bytes:
+        path = os.path.join(self.dir if dir is None else dir, fname)
         try:
             with open(path, "rb") as f:
                 raw = f.read()
@@ -382,20 +768,25 @@ class Stage:
         return raw
 
 
-def open_stage(env, label: str, token: str) -> Stage:
+def open_stage(env, label: str, token: str,
+               base_token: str | None = None) -> Stage:
     """The next pipelined stage's checkpoint handle (advances the
     deterministic PER-SESSION stage sequence; under the serving
     scheduler the stage directory is additionally namespaced by the
     session name, so concurrent tenants' checkpoints never collide and a
     resumed process matches each tenant's stages regardless of how the
-    original interleave ordered them).  Call only when :func:`enabled`."""
+    original interleave ordered them).  ``base_token`` is the
+    world-invariant workload identity (:func:`plan_token`) — passing it
+    makes the stage eligible for the elastic re-shard path when a
+    resume finds its checkpoint committed at a different topology.
+    Call only when :func:`enabled`."""
     from . import recovery
     sid = recovery.current_session()
     seq = _STAGE_SEQ.get(sid, 0)
     _STAGE_SEQ[sid] = seq + 1
     if sid is not None:
         label = f"{sid}.{label}"
-    stage = Stage(env, label, token, seq)
+    stage = Stage(env, label, token, seq, base_token=base_token)
     _OPEN_DIRS.append(stage.dir)
     return stage
 
@@ -409,6 +800,47 @@ def corrupt_fallback(stage: Stage, piece: int, err: Exception) -> None:
     log.warning("checkpoint stage %s piece %d failed verification (%s); "
                 "recomputing this stage's remaining pieces instead of "
                 "restoring", stage.label, piece, err)
+
+
+def drain_requested(env) -> bool:
+    """Preemption-grace drain poll — called by the pipelined range loop
+    and the streaming absorb path at their checkpoint boundaries (the
+    points where completed-piece state is already durably committed).
+    True only when ALL of: a grace budget is declared
+    (``CYLON_TPU_PREEMPT_GRACE_S``), durable checkpointing is armed,
+    and the rank-coherent drain vote
+    (:func:`cylon_tpu.exec.recovery.drain_consensus`) agrees a
+    preemption notice arrived somewhere.  With checkpointing unarmed
+    the SIGTERM flag changes nothing — no drain, no writes, no
+    collectives (the happy-path contract, asserted in
+    tests/test_checkpoint.py)."""
+    from . import preempt
+    if not (preempt.armed() and enabled()):
+        return False
+    from . import recovery
+    return recovery.drain_consensus(getattr(env, "mesh", None),
+                                    preempt.requested())
+
+
+def drain_abort(label: str) -> None:
+    """Raise the preemption-grace drain: committed state is already
+    durable (the caller sits at a checkpoint boundary and has flushed
+    any pending sink state), so this records the resume token and exits
+    via typed :class:`ResumableAbort` — a planned scale-down, not a
+    fault.  The supervisor's relaunch (same or DIFFERENT topology)
+    fast-forwards past everything committed inside the grace window."""
+    from . import preempt, recovery
+    token = flush_for_abort(label)
+    recovery._record(label, "preempt", "drain")
+    timing.bump("ckpt.preempt_drain")
+    left = preempt.remaining_s()
+    raise ResumableAbort(
+        f"{label}: preemption notice received (grace "
+        f"{preempt.grace_seconds():g}s{'' if left is None else f', {left:.1f}s left'}) "
+        "— current stage flushed and committed; rerun with "
+        f"CYLON_TPU_RESUME=1 to fast-forward (resume token: {token}); a "
+        "different world size re-shards committed state automatically",
+        token=token)
 
 
 def flush_for_abort(label: str) -> str:

@@ -292,8 +292,7 @@ class GroupBySink:
             while len(self._pending) > 1:
                 self._settle(self._pending.pop(0))
         else:
-            while self._pending:
-                self._settle(self._pending.pop(0))
+            self.flush_pending()
         if h is None:
             # a crash-exhausted begin must not let groupby_aggregate
             # re-run the identical (uncached) compile ladder — force the
@@ -325,6 +324,15 @@ class GroupBySink:
     def absorb(self, chunk: Table) -> None:
         self(chunk)
 
+    def flush_pending(self) -> None:
+        """Settle every in-flight deferred chunk NOW — the partials
+        commit at their stage boundaries as a side effect.  Called
+        before a stage is marked complete and before a preemption-grace
+        drain raises: both need the durable state to cover every chunk
+        the sink has consumed, not just the settled ones."""
+        while self._pending:
+            self._settle(self._pending.pop(0))
+
     def compact(self) -> None:
         """Fold the adopted partials into ONE combined partial — bounded
         sink state for unbounded streams.  The combine groupby's summed
@@ -338,8 +346,7 @@ class GroupBySink:
         over a stream's lifetime.  No-op for 0/1 partials and for
         key-disjoint sinks (their partials are already final groups)."""
         from ..relational.groupby import groupby_aggregate
-        while self._pending:
-            self._settle(self._pending.pop(0))
+        self.flush_pending()
         if len(self._parts) <= 1 or self._disjoint:
             return
         partial = concat_tables(self._parts)
@@ -376,8 +383,7 @@ class GroupBySink:
 
     def _combine(self, drain: bool) -> Table:
         from ..relational.groupby import combine_sink_partials
-        while self._pending:
-            self._settle(self._pending.pop(0))
+        self.flush_pending()
         if not self._parts:
             raise InvalidError("GroupBySink saw no chunks")
         partial = concat_tables(self._parts) if len(self._parts) > 1 \
@@ -786,24 +792,40 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             and (sink is None or isinstance(sink, GroupBySink))):
         # the consumption MODE is part of the plan: a sink stage
         # checkpoints partial aggregates, a sinkless one piece outputs —
-        # restoring one as the other would splice wrong-shaped state in
+        # restoring one as the other would splice wrong-shaped state in.
+        # The token is SPLIT (docs/robustness.md "Elastic resume"): the
+        # base names the workload (world-invariant — nothing derived
+        # from the shard layout), the full token folds in world size,
+        # piece capacities and per-range counts.  A resume matching only
+        # the base at a different topology takes the re-shard path.
         mode = ("nosink", tuple(suffixes)) if sink is None else \
             ("sink", tuple(sink.by), tuple(sink._chunk_aggs), sink.ddof)
+        # the base carries a world-INVARIANT data fingerprint too — the
+        # global live row totals of both sides (per-range counts are
+        # layout-derived, their sums are not): without it an elastic
+        # resume over CHANGED inputs would base-match a stale
+        # checkpoint and adopt another dataset's answers, the guard the
+        # same-world full token already provides
+        base = ckpt.plan_token("pipelined_join", how, tuple(left_on),
+                               tuple(right_on), n_ranges, mode,
+                               int(pcounts.sum()), int(r_lens.sum()))
         token = ckpt.plan_token(
-            "pipelined_join", how, tuple(left_on), tuple(right_on),
-            n_ranges, w, tuple(caps_l), tuple(caps_r),
+            base, w, tuple(caps_l), tuple(caps_r),
             tuple(int(x) for x in pcounts.sum(axis=0)),
-            tuple(int(x) for x in r_lens.sum(axis=0)), mode)
-        stage = ckpt.open_stage(env, "pipelined_join", token)
+            tuple(int(x) for x in r_lens.sum(axis=0)))
+        stage = ckpt.open_stage(env, "pipelined_join", token,
+                                base_token=base)
         if isinstance(sink, GroupBySink):
             sink.attach_checkpoint(stage)
 
     start = 0
     outs = []
+    adopted_whole = False
     if stage is not None and ckpt.resume_requested():
         from ..status import CheckpointCorruptError
         from . import recovery
         restored: list = []
+        foreign = stage.foreign is not None
         if stage.resuming:
             while (len(restored) < len(live_ranges)
                    and stage.has_piece(len(restored))):
@@ -812,17 +834,48 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                 except CheckpointCorruptError as e:
                     ckpt.corrupt_fallback(stage, len(restored), e)
                     break
+        elif foreign and stage.foreign_complete:
+            # world-mismatch re-shard: the WHOLE stage (and only a whole
+            # stage — old-layout pieces have no expressible complement
+            # in the new layout) is adopted, stitched and re-blocked
+            # onto this mesh; any corruption degrades to recompute
+            try:
+                restored = stage.load_foreign_pieces()
+            except CheckpointCorruptError as e:
+                ckpt.corrupt_fallback(stage, len(restored), e)
+                restored = []
         # rank-coherent fast-forward: every rank adopts the MINIMUM
         # restorable prefix across ranks (one vote per stage; entered by
         # every rank whenever resume is requested, even with nothing
-        # restorable locally) — a rank-local fallback would leave the
-        # recomputing rank alone in the per-piece commit collectives
+        # restorable locally — including ranks that have no own rank dir
+        # because the world GREW) — a rank-local fallback would leave
+        # the recomputing rank alone in the per-piece commit collectives
         # below
         start = recovery.ckpt_resume_consensus(getattr(env, "mesh", None),
                                                len(restored))
-        if len(restored) > start:
+        if foreign:
+            # all-or-nothing: a rank that verified fewer foreign pieces
+            # degrades EVERY rank's adoption to recompute (foreign
+            # restores were not yet counted, so nothing to unrestore)
+            if start != len(restored) or not restored:
+                start = 0
+                restored = []
+            else:
+                ckpt.note_reshard(start)
+                adopted_whole = True
+                # first post-reshard commit: rewrite the adopted state
+                # under THIS topology's layout token at the next
+                # manifest generation — the second resume at this world
+                # is then a plain fast-forward, and the old world's
+                # leftover rank dirs read as stale forever
+                stage.begin_rewrite()
+                for i, tbl in enumerate(restored):
+                    stage.save_piece(i, tbl)
+                stage.mark_complete()
+                start = len(live_ranges)   # the whole piece loop is done
+        elif len(restored) > start:
             ckpt.unrestore(len(restored) - start)
-        for tbl in restored[:start]:
+        for tbl in restored[:(len(restored) if adopted_whole else start)]:
             if sink is not None:
                 sink.restore_partial(tbl)
                 outs.append(None)   # a GroupBySink call returns None too
@@ -897,6 +950,16 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             # partials at adoption instead)
             stage.save_piece(i, res_r)
         outs.append(out_r)
+        if stage is not None and ckpt.drain_requested(env):
+            # preemption grace (exec/preempt): a SIGTERM arrived and the
+            # drain vote agreed — this piece boundary is the planned
+            # exit.  Pending sink chunks settle first (their partials
+            # commit), then the typed ResumableAbort carries the resume
+            # token out; the relaunch fast-forwards everything committed
+            # inside the grace window, re-sharding if the world changed.
+            if isinstance(sink, GroupBySink):
+                sink.flush_pending()
+            ckpt.drain_abort("pipelined_join")
         if nxt is None and i + 1 < len(live_ranges):
             # piece r+1's phase dispatch overlaps piece r's in-flight
             # consumption (the sink's pending pull / deferred counts)
@@ -919,12 +982,24 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                             suffixes=suffixes, assume_colocated=True,
                             allow_defer=False)
         outs.append(sink(res_r) if sink is not None else res_r)
+    if stage is not None:
+        # mark the stage COMPLETE (one manifest commit): a later resume
+        # at a DIFFERENT topology may only adopt whole stages, and this
+        # flag is how it tells a finished stage from a crash prefix.
+        # Pending sink chunks settle first so the durable set covers
+        # every consumed chunk.
+        if isinstance(sink, GroupBySink):
+            sink.flush_pending()
+        stage.mark_complete()
     if sink is not None:
         return outs
     out = concat_tables(outs) if len(outs) > 1 else outs[0]
-    if left_on == right_on:
+    if left_on == right_on and not adopted_whole:
         # pieces are key-grouped (sorted merge order) in key-range order and
-        # hash-colocated: the concatenation keeps the grouped contract
+        # hash-colocated: the concatenation keeps the grouped contract —
+        # EXCEPT for state adopted across a topology change, whose rows
+        # were re-blocked in global order (per-shard key contiguity and
+        # hash colocation are both gone; consumers re-derive)
         out.grouped_by = tuple(left_on)
     return out
 

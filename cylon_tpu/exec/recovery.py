@@ -110,20 +110,30 @@ shard_map = jax.shard_map
 #: (cylon_tpu/stream): ``stream.append`` wraps one micro-batch's ingest
 #: (shuffle + ledger admission + sink absorb) — ``kill`` there is the
 #: chaos harness's mid-ingest crash — and ``stream.watermark`` wraps the
-#: watermark min-vote that closes event-time windows.
+#: watermark min-vote that closes event-time windows.  ``ckpt.reshard``
+#: wraps the elastic resume's foreign-rank page read + re-shard
+#: (exec/checkpoint.load_foreign_pieces): ``corrupt`` there simulates a
+#: failed foreign-page hash check (the stage degrades to recompute,
+#: never a wrong answer) and ``kill`` crashes mid-reshard — the resumed
+#: rerun must converge anyway.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
-         "ckpt.write", "ckpt.load", "pipe.phase_sync",
+         "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
          "stream.append", "stream.watermark")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
 #: analog of ``stall``); ``corrupt`` flips checkpoint page bytes (write)
-#: or simulates a failed hash check (load); ``kill`` SIGKILLs the
-#: PROCESS at the site — the chaos-soak harness's hard-crash primitive
-#: (the parent reruns the workload with ``CYLON_TPU_RESUME=1``)
+#: or simulates a failed hash check (load/reshard); ``kill`` SIGKILLs
+#: the PROCESS at the site — the chaos-soak harness's hard-crash
+#: primitive (the parent reruns the workload with ``CYLON_TPU_RESUME=1``)
+#: — and ``term`` delivers SIGTERM to the process at the site: the
+#: spot-VM preemption notice (exec/preempt) — with the grace handler
+#: armed the process keeps running and DRAINS at its next checkpoint
+#: boundary; unarmed, default disposition applies, exactly like a real
+#: preemption
 KINDS = ("predicted", "device_oom", "capacity", "desync", "stall",
-         "spill_stall", "corrupt", "kill")
+         "spill_stall", "corrupt", "kill", "term")
 
 
 # ---------------------------------------------------------------------------
@@ -445,18 +455,39 @@ def hard_kill(site: str) -> None:
     os.kill(os.getpid(), signal.SIGKILL)
 
 
+def soft_term(site: str) -> None:
+    """The ``term`` fault kind: deliver SIGTERM to THIS process at
+    ``site`` — the spot-VM preemption notice (exec/preempt,
+    docs/robustness.md "Elastic resume & preemption grace").  With the
+    grace handler armed (``CYLON_TPU_PREEMPT_GRACE_S``) the handler
+    only sets a flag and the process drains at its next checkpoint
+    boundary; unarmed, the default disposition terminates the process —
+    both are exactly what a real preemption does."""
+    import signal
+    from ..utils.logging import log
+    log.warning("recovery: injected preemption notice at %s — SIGTERM self",
+                site)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
 def maybe_inject(site: str, intercept: tuple = ()) -> str | None:
     """Raise the armed fault for ``site`` (no-op when nothing is armed).
     Call at each named injection point.  The ``kill`` kind never raises:
-    it SIGKILLs the process.  Kinds named in ``intercept`` are RETURNED
-    for site-specific handling instead of recorded-and-raised (the
-    checkpoint sites intercept ``corrupt``: on write it flips page bytes
-    after hashing rather than raising)."""
+    it SIGKILLs the process.  The ``term`` kind never raises either: it
+    delivers SIGTERM (the preemption notice) and execution continues to
+    the next checkpoint boundary's drain poll.  Kinds named in
+    ``intercept`` are RETURNED for site-specific handling instead of
+    recorded-and-raised (the checkpoint sites intercept ``corrupt``: on
+    write it flips page bytes after hashing rather than raising)."""
     kind = injected(site)
     if kind is None:
         return None
     if kind == "kill":
         hard_kill(site)
+    if kind == "term":
+        _record(site, kind, "sigterm")
+        soft_term(site)
+        return None
     if kind in intercept:
         return kind
     _record(site, kind, "injected")
@@ -636,6 +667,23 @@ def spill_consensus(mesh: Mesh | None, local_need: bool) -> bool:
     return consensus_code(mesh, local) == Code.SpillRequired
 
 
+def drain_consensus(mesh: Mesh | None, local_flag: bool) -> bool:
+    """Preemption-grace drain agreement (exec/preempt → exec/checkpoint
+    ``drain_requested``): True when ANY rank has received a SIGTERM
+    preemption notice — then every rank flushes, commits and raises the
+    identical typed ``ResumableAbort`` at the SAME checkpoint boundary.
+    A rank draining alone would leave its peers hanging in the next
+    piece's commit collective, which is the desync this module exists
+    to prevent.  Rides the same one-int32 pmax wire as the fault codes
+    with the dedicated :class:`Code.PreemptDrain` vote,
+    session-namespaced like every other wire.  Polled ONLY at the
+    checkpoint boundaries of sessions with BOTH the grace budget and
+    durable checkpointing armed — unarmed sessions stay collective-free
+    (one env read per boundary)."""
+    local = Code.PreemptDrain if local_flag else Code.OK
+    return consensus_code(mesh, local) == Code.PreemptDrain
+
+
 def count_consensus(mesh: Mesh | None, n: int) -> int:
     """Max-agree a small non-negative count across ranks — the spill
     tier's eviction-COUNT wire (exec/memory.ensure_headroom) and the
@@ -673,7 +721,13 @@ def ckpt_commit_consensus(mesh: Mesh | None, epoch: int) -> int:
     at :data:`_CKPT_NS_BASE`): two serving tenants' stages commonly sit
     at EQUAL epoch numbers, so without the namespace a rank-schedule
     divergence could durably commit one tenant's manifest against
-    another tenant's vote."""
+    another tenant's vote.
+
+    Like the resume vote, this runs over the LIVE mesh only.  After an
+    elastic re-shard the first post-reshard commit re-votes the epoch
+    over the NEW mesh — stale rank dirs from the old world never
+    participate (they are directories, not voters) and are superseded
+    by the rewrite's higher manifest generation (exec/checkpoint)."""
     epoch = int(epoch)
     if not 0 <= epoch < _CKPT_EPOCH_BASE:
         raise ValueError(f"checkpoint epoch {epoch} out of wire range")
@@ -736,7 +790,19 @@ def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:
     check (divergence IS the input here, and min is the agreement) —
     but the wire IS session-namespaced, so a vote arriving from another
     serving tenant's resume surfaces typed instead of silently clamping
-    this tenant's fast-forward."""
+    this tenant's fast-forward.
+
+    The vote is over the LIVE mesh, never over checkpoint rank
+    directories: an elastic resume (docs/robustness.md "Elastic resume
+    & preemption grace") commonly has rank dirs OUTNUMBERING live ranks
+    (world shrank — every live rank reads all N foreign dirs and votes
+    the count it could verify) or UNDERNUMBERING them (world grew — a
+    live rank with no own-rank dir simply votes what the foreign scan
+    yielded, 0 if the checkpoint root is not shared).  Either way the
+    min over live ranks is well-defined, and for an all-or-nothing
+    re-shard adoption the caller compares the agreed min against its
+    own count and discards EVERYTHING on any shortfall (old-layout
+    pieces cannot partially splice into a new-layout loop)."""
     n = int(n)
     if not 0 <= n < _CKPT_EPOCH_BASE:
         raise ValueError(f"resume fast-forward count {n} out of wire range")

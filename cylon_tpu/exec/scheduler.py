@@ -82,7 +82,7 @@ import threading
 import time
 
 from .. import config
-from ..status import InvalidError
+from ..status import InvalidError, ResumableAbort
 from .session import DONE, FAILED, PENDING, RUNNING, QuerySession
 
 #: the active scheduler — at most one per process; read by maybe_yield
@@ -237,6 +237,7 @@ class QueryScheduler:
         self._abort = False
         self._forced_admissions = 0
         self._scheduler_evictions = 0
+        self._preempt_drained = 0
 
     # -- submission --------------------------------------------------------
     def submit(self, name: str, fn, *, footprint_bytes: int = 0,
@@ -299,7 +300,19 @@ class QueryScheduler:
 
     def _loop(self) -> None:
         while True:
-            self._admit_pending()
+            if self._draining():
+                # preemption grace (exec/preempt): a SIGTERM arrived
+                # with checkpointing armed — drain the whole box.  No
+                # new admissions; PENDING sessions fail typed with the
+                # resume token (they never started, so a resume simply
+                # recomputes them); RUNNING sessions keep getting slices
+                # and exit via their own checkpoint-boundary drains —
+                # each tenant commits its current stage and raises
+                # ResumableAbort, so a multi-tenant box preempts as
+                # cleanly as a single query (docs/serving.md).
+                self._drain_pending()
+            else:
+                self._admit_pending()
             running = [s for s in self.sessions if s.state == RUNNING]
             if not running:
                 if any(s.state == PENDING for s in self.sessions):
@@ -310,6 +323,43 @@ class QueryScheduler:
                     continue
                 return
             self._grant_slice(self._pick(running))
+
+    # -- preemption-grace drain --------------------------------------------
+    def _draining(self) -> bool:
+        """Preemption check gating NEW admissions.  In a multiprocess
+        session the decision rides the same rank-coherent vote as the
+        sessions' own boundary drains (``recovery.drain_consensus``) —
+        a rank-local read would let the SIGTERM'd rank fail a pending
+        session while its peers admit and start it, leaving them alone
+        in that session's first collective.  Every rank's scheduler
+        loop reaches this poll at the same iteration (the pick
+        consensus already requires lockstep loops), and the vote is
+        armed-only: grace budget + checkpointing, same as the piece
+        boundaries."""
+        from . import checkpoint, preempt
+        if not (preempt.armed() and checkpoint.enabled()):
+            return False
+        if self._multi():
+            from . import recovery
+            return recovery.drain_consensus(self.env.mesh,
+                                            preempt.requested())
+        return preempt.requested()
+
+    def _drain_pending(self) -> None:
+        from . import checkpoint, recovery
+        for s in self.sessions:
+            if s.state != PENDING:
+                continue
+            token = checkpoint.flush_for_abort(f"sched.{s.name}")
+            recovery._record(f"sched.{s.name}", "preempt", "drain_pending")
+            s.state = FAILED
+            s.error = ResumableAbort(
+                f"preemption grace drain: session {s.name} was queued but "
+                "never admitted — nothing committed, a rerun with "
+                f"CYLON_TPU_RESUME=1 recomputes it (resume token: {token})",
+                token=token)
+            s.finished_s = time.perf_counter()
+            self._preempt_drained += 1
 
     # -- admission ---------------------------------------------------------
     def _budget(self) -> int:
@@ -536,6 +586,9 @@ class QueryScheduler:
                                           for s in self.sessions), 4),
             "forced_admissions": self._forced_admissions,
             "scheduler_evictions": self._scheduler_evictions,
+            "preempt_drained": self._preempt_drained,
+            "resumable_aborts": sum(1 for s in self.sessions
+                                    if isinstance(s.error, ResumableAbort)),
             "cross_session_evictions": mem["cross_session_evictions"],
             "spill_events": mem["spill_events"],
             "slices": sum(s.slices for s in self.sessions),

@@ -196,16 +196,31 @@ def _order_preserving_targets(table: Table, dest_counts: np.ndarray):
     return fn(np.asarray(vc, np.int32), offs, bounds, probe)
 
 
+def even_partition_counts(total: int, w: int) -> np.ndarray:
+    """The default order-preserving split: ``total`` global rows divided
+    as evenly as possible over ``w`` partitions, earlier partitions
+    taking the remainder — the host side of the
+    :func:`_order_preserving_targets` index math (reference
+    ``DivideRowsEvenly``, repartition.hpp:32).  Shared by
+    :func:`repartition` and the elastic checkpoint re-shard path
+    (``exec/checkpoint.py``), which re-blocks committed host pages onto
+    a different-world mesh through the SAME split so a resharded resume
+    lands on the exact distribution a fresh :func:`repartition` would
+    produce."""
+    total, w = int(total), int(w)
+    base = total // w
+    extra = total - base * w
+    return np.asarray([base + (1 if i < extra else 0) for i in range(w)],
+                      np.int64)
+
+
 def repartition(table: Table, rows_per_partition=None) -> Table:
     """Redistribute preserving global row order; default = even split."""
     env = table.env
     w = env.world_size
     total = table.row_count
     if rows_per_partition is None:
-        base = total // w
-        extra = total - base * w
-        dest = np.asarray([base + (1 if i < extra else 0) for i in range(w)],
-                          np.int64)
+        dest = even_partition_counts(total, w)
     else:
         dest = np.asarray(rows_per_partition, np.int64)
         if dest.shape != (w,) or dest.sum() != total:

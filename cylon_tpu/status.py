@@ -38,6 +38,13 @@ class Code(enum.IntEnum):
     #: every rank at the identical epoch or on none.  Not an error class
     #: — never raised.
     CkptCommit = 47
+    #: preemption-grace drain vote (exec/preempt + exec/checkpoint): a
+    #: rank that received SIGTERM with the grace budget armed requests a
+    #: COLLECTIVE drain at the next checkpoint boundary, so every rank
+    #: commits the same prefix and raises the same typed ResumableAbort
+    #: instead of one rank draining while its peers enter the next
+    #: collective alone.  Not an error class — never raised.
+    PreemptDrain = 48
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42

@@ -75,24 +75,38 @@ class IncrementalView:
     def _attach_checkpoint(self) -> None:
         """Arm durable checkpointing when ``CYLON_TPU_CKPT_DIR`` is set:
         the view is ONE long-lived stage (plan token over the view's
-        static plan — name, keys, agg specs, ddof, world), each absorbed
-        partial a committed piece.  On resume the committed prefix is
-        restored bit-identically, the fast-forward count min-agreed
-        across ranks (a rank whose page failed verification degrades the
-        whole session coherently), and that many future appends are
-        fast-forwarded instead of re-absorbed."""
+        static plan — name, keys, agg specs, ddof; the world rides the
+        LAYOUT half of the split token), each absorbed partial a
+        committed piece.  On resume the committed prefix is restored
+        bit-identically, the fast-forward count min-agreed across ranks
+        (a rank whose page failed verification degrades the whole
+        session coherently), and that many future appends are
+        fast-forwarded instead of re-absorbed.
+
+        Unlike a pipelined join's pieces, a view's piece identity (the
+        batch ordinal in the stream) is WORLD-INVARIANT and its content
+        is mergeable, so a resume at a different topology adopts the
+        committed PREFIX: each foreign partial's pages are stitched and
+        re-blocked onto the live mesh (`Stage.load_foreign_pieces`) and
+        adopted via ``restore_partial`` — the sink's
+        ``combine_sink_partials`` read path merges re-distributed
+        partials exactly like same-world ones, which is why no row-order
+        preservation is needed here.  The adopted prefix re-commits in
+        the new layout so the next resume is plain."""
         from ..exec import checkpoint as ckpt
         from ..exec import recovery
         from ..status import CheckpointCorruptError
         if not ckpt.enabled():
             return
-        token = ckpt.plan_token(
+        base = ckpt.plan_token(
             "stream_view", self.name, tuple(self.by),
-            tuple((c, op) for c, op, *_ in self.aggs), self.ddof,
-            int(self.env.world_size))
-        stage = ckpt.open_stage(self.env, f"stream_view.{self.name}", token)
+            tuple((c, op) for c, op, *_ in self.aggs), self.ddof)
+        token = ckpt.plan_token(base, int(self.env.world_size))
+        stage = ckpt.open_stage(self.env, f"stream_view.{self.name}", token,
+                                base_token=base)
         if ckpt.resume_requested():
             restored: list = []
+            foreign = stage.foreign is not None
             if stage.resuming:
                 while stage.has_piece(len(restored)):
                     try:
@@ -100,13 +114,29 @@ class IncrementalView:
                     except CheckpointCorruptError as e:
                         ckpt.corrupt_fallback(stage, len(restored), e)
                         break
+            elif foreign:
+                try:
+                    # prefix_ok: a corrupt batch k trims the adoption to
+                    # the verified 0..k-1 prefix instead of discarding
+                    # the stream's whole committed history
+                    restored = stage.load_foreign_pieces(prefix_ok=True)
+                except CheckpointCorruptError as e:
+                    ckpt.corrupt_fallback(stage, len(restored), e)
+                    restored = []
             n = recovery.ckpt_resume_consensus(
                 getattr(self.env, "mesh", None), len(restored))
-            if len(restored) > n:
+            if foreign:
+                restored = restored[:n]
+                if restored:
+                    ckpt.note_reshard(n)
+                    stage.begin_rewrite()
+                    for i, part in enumerate(restored):
+                        stage.save_piece(i, part)
+            elif len(restored) > n:
                 ckpt.unrestore(len(restored) - n)
             for part in restored[:n]:
                 self.sink.restore_partial(part)
-            self._skip = self._ffwd = n
+            self._skip = self._ffwd = len(restored[:n])
         self.sink.attach_checkpoint(stage)
 
     @property
@@ -129,6 +159,14 @@ class IncrementalView:
         if (self.compact_every
                 and len(self.sink._parts) >= self.compact_every):
             self.sink.compact()
+        from ..exec import checkpoint as ckpt
+        if self.sink._ckpt is not None and ckpt.drain_requested(self.env):
+            # preemption grace: the batch just absorbed is committed —
+            # this append boundary is the planned exit (exec/preempt);
+            # the resumed ingest fast-forwards the committed batches,
+            # re-sharding them if the world changed
+            self.sink.flush_pending()
+            ckpt.drain_abort(f"stream_view.{self.name}")
 
     def read(self) -> Table:
         """A consistent finalized snapshot over every batch absorbed so

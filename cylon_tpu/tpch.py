@@ -1164,9 +1164,13 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                    # durable checkpoint traffic (exec/checkpoint): did
                    # this number include checkpoint writes, and did a
                    # resumed run fast-forward instead of recomputing?
+                   # resume_world_mismatch alongside
+                   # resume_resharded_pieces says whether a topology
+                   # change resharded or threw the checkpoint away
                    **{k: v for k, v in _ckpt_stats().items() if k in
                       ("checkpoint_events", "bytes_checkpointed",
-                       "resume_fast_forwarded_pieces")},
+                       "resume_fast_forwarded_pieces",
+                       "resume_resharded_pieces", "resume_world_mismatch")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 

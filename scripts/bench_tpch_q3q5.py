@@ -82,10 +82,13 @@ def main():
                    **{k: v for k, v in memory.stats().items() if k in
                       ("spill_events", "bytes_spilled",
                        "peak_ledger_bytes")},
-                   # durable-checkpoint traffic (exec/checkpoint)
+                   # durable-checkpoint traffic (exec/checkpoint);
+                   # mismatch vs resharded distinguishes an elastic
+                   # re-shard from a thrown-away checkpoint
                    **{k: v for k, v in checkpoint.stats().items() if k in
                       ("checkpoint_events", "bytes_checkpointed",
-                       "resume_fast_forwarded_pieces")},
+                       "resume_fast_forwarded_pieces",
+                       "resume_resharded_pieces", "resume_world_mismatch")},
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

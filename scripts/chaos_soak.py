@@ -43,11 +43,28 @@ counting), and the resumed rerun must fast-forward t0's committed
 pieces while EVERY tenant's answer stays bit-equal to its solo
 (single-session) run — crash isolation under multi-tenancy.
 
+``--elastic`` switches to the ELASTIC-RESUME acceptance flow
+(docs/robustness.md "Elastic resume & preemption grace"): a TWO-stage
+workload (sinkless pipelined join feeding a join+sink) checkpoints at
+world=2 in a subprocess; pinned schedules SIGKILL it mid-stage-2 and
+resume at world=1 (the completed stage 1 must RE-SHARD and
+fast-forward — ``resume_resharded_pieces > 0`` — while the interrupted
+stage 2 recomputes, counted in ``resume_world_mismatch``), resume at
+world=2 plain (no reshard, ordinary fast-forward), kill the world=1
+resume AGAIN and resume at world=2-after-reshard (the rewritten
+world=1 manifests re-shard back up), inject ``ckpt.reshard`` corruption
+during a reshard (degrades to recompute, never a wrong answer), and
+deliver SIGTERM with the preemption grace armed (the child must exit
+via typed ResumableAbort — exit 17, not a signal death — within the
+grace budget).  Every schedule must end bit-equal to the uninterrupted
+world=2 baseline.
+
 Usage::
 
     python scripts/chaos_soak.py --seed 7                 # 20 schedules
     python scripts/chaos_soak.py --seed 7 --schedules 4 --rows 1500
     python scripts/chaos_soak.py --concurrent 3 --rows 2000
+    python scripts/chaos_soak.py --elastic --rows 1500 --chunks 3
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
 runs in CI as a slow-marked test (tests/test_checkpoint.py); the
@@ -111,7 +128,7 @@ def worker(args) -> int:
     from cylon_tpu.status import ResumableAbort
 
     recovery.install_faults(None)   # validate the env grammar up front
-    env = ct.CylonEnv(config=CPUMeshConfig(world_size=4))
+    env = ct.CylonEnv(config=CPUMeshConfig(world_size=args.world))
 
     # TPC-H-shaped: orders ⋈ lineitem on the order key, aggregated per
     # order — integer "money" so every retry/restore path is exactly
@@ -143,6 +160,9 @@ def worker(args) -> int:
 
     if args.stream:
         return _worker_stream(args, env)
+
+    if args.elastic:
+        return _worker_elastic(args, env)
 
     if args.concurrent > 1:
         return _worker_concurrent(args, env, make_workload)
@@ -275,6 +295,216 @@ def run_stream(args) -> int:
     return 1 if failures else 0
 
 
+def _worker_elastic(args, env) -> int:
+    """The elastic-resume acceptance workload: TWO chained pipelined
+    stages — a sinkless join (stage 1) feeding a join+GroupBySink
+    (stage 2) — so a kill landing mid-stage-2 leaves a COMPLETE stage 1
+    behind, which a resume at a different world must re-shard and
+    fast-forward while stage 2 recomputes.  Integer "money" columns and
+    a unique-key final groupby keep the sorted result sha world-
+    invariant, which is what makes one uninterrupted world=2 baseline
+    the oracle for every resume world.  A preemption-grace drain
+    (SIGTERM via the ``term`` injector kind, grace budget in the env)
+    exits via typed ResumableAbort → RESUMABLE_EXIT instead of a signal
+    death."""
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, \
+        recovery
+    from cylon_tpu.status import ResumableAbort
+
+    rng = np.random.default_rng(20260804)
+    rows = args.rows
+    n_ord = max(rows // 4, 64)
+    n_cust = 16
+    orders = ct.Table.from_pydict(
+        {"o_orderkey": np.arange(n_ord, dtype=np.int64),
+         "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64)},
+        env)
+    lineitem = ct.Table.from_pydict(
+        {"l_orderkey": rng.integers(0, n_ord, rows).astype(np.int64),
+         "l_quantity": rng.integers(1, 51, rows).astype(np.int64),
+         "l_extendedprice": rng.integers(900_00, 10_500_00,
+                                         rows).astype(np.int64)},
+        env)
+    customers = ct.Table.from_pydict(
+        {"c_custkey": np.arange(n_cust, dtype=np.int64),
+         "c_nationkey": rng.integers(0, 5, n_cust).astype(np.int64)},
+        env)
+    try:
+        # stage 1 (sinkless): its piece outputs are the checkpointed
+        # state a world change must re-shard in global row order
+        jt = pipelined_join(lineitem, orders, "l_orderkey", "o_orderkey",
+                            how="inner", n_chunks=args.chunks)
+        # stage 2 (sink): mergeable partial aggregates
+        sink = GroupBySink("o_custkey", [("l_quantity", "sum"),
+                                         ("l_extendedprice", "sum")])
+        pipelined_join(jt, customers, "o_custkey", "c_custkey",
+                       how="inner", n_chunks=args.chunks, sink=sink)
+        out = sink.finalize()
+    except ResumableAbort as e:
+        print(json.dumps({"resumable": True, "token": e.token,
+                          "events": len(recovery.recovery_events()),
+                          **checkpoint.stats()}), flush=True)
+        return RESUMABLE_EXIT
+    df = out.to_pandas().sort_values("o_custkey").reset_index(drop=True)
+    print(json.dumps({
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
+        "world": int(env.world_size),
+        "events": len(recovery.recovery_events()),
+        **checkpoint.stats(),
+    }), flush=True)
+    return 0
+
+
+def run_elastic(args) -> int:
+    """The ``--elastic`` acceptance flow (pinned, not drawn) — see the
+    module docstring.  ``k1`` is stage 2's first checkpoint write (the
+    stage-1 pieces occupy writes 1..chunks), so a fault there leaves
+    stage 1 complete and stage 2 untouched or partial."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_elastic_")
+    failures: list = []
+    k1 = args.chunks + 1
+
+    p, base = _spawn(args, os.path.join(args.workdir, "base"), "",
+                     resume=False, elastic=True, world=2)
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: elastic baseline failed", file=sys.stderr)
+        return 1
+    print(f"# elastic baseline sha={base['sha'][:16]} world=2", flush=True)
+
+    def resume_leg(tag, workdir, world, resume_faults="", extra=None,
+                   want_reshard=True):
+        p2, info = _spawn(args, workdir, resume_faults, resume=True,
+                          elastic=True, world=world, extra_env=extra)
+        if p2.returncode != 0 or not info:
+            failures.append(f"{tag}: resume at world={world} failed "
+                            f"rc={p2.returncode}: "
+                            f"{(p2.stdout + p2.stderr)[-2000:]}")
+            return None
+        if info.get("sha") != base["sha"]:
+            failures.append(f"{tag}: resume at world={world} diverged "
+                            f"from the world=2 baseline: {info}")
+        elif want_reshard and not info.get("resume_resharded_pieces"):
+            failures.append(f"{tag}: world change did not re-shard "
+                            f"(recomputed everything): {info}")
+        elif want_reshard and not info.get("resume_world_mismatch"):
+            failures.append(f"{tag}: world mismatch not counted: {info}")
+        elif not want_reshard and info.get("resume_resharded_pieces"):
+            failures.append(f"{tag}: same-world resume resharded: {info}")
+        elif not info.get("resume_fast_forwarded_pieces"):
+            failures.append(f"{tag}: resume recomputed every committed "
+                            f"piece: {info}")
+        else:
+            print(f"# elastic {tag} -> ok (world={world} "
+                  f"ffwd={info['resume_fast_forwarded_pieces']} "
+                  f"resharded={info['resume_resharded_pieces']} "
+                  f"mismatch={info['resume_world_mismatch']})", flush=True)
+        return info
+
+    def kill_leg(tag, workdir, faults, extra=None):
+        p1, _ = _spawn(args, workdir, faults, resume=False, elastic=True,
+                       world=2, extra_env=extra)
+        if p1.returncode != -9:
+            failures.append(f"{tag}: kill schedule did not crash "
+                            f"(rc={p1.returncode})")
+            return False
+        return True
+
+    # A: ckpt at world=2, SIGKILL mid-stage-2 → resume at world=1:
+    # stage 1 re-shards 2→1 and fast-forwards, stage 2 recomputes
+    dA = os.path.join(args.workdir, "killA")
+    if kill_leg("A", dA, f"ckpt.write::{k1}=kill"):
+        resume_leg("A (2→1 reshard)", dA, 1)
+
+    # B: same kill → plain resume at world=2 (fast-forward, no reshard)
+    dB = os.path.join(args.workdir, "killB")
+    if kill_leg("B", dB, f"ckpt.write::{k1}=kill"):
+        resume_leg("B (2→2 plain)", dB, 2, want_reshard=False)
+
+    # C: kill at world=2, resume at world=1 and kill THAT mid-stage-2
+    # (stage 1 is now rewritten in the world=1 layout), then resume at
+    # world=2-after-reshard: the gen-bumped world=1 manifests must
+    # re-shard back up while the stale world=2 rank dirs read as stale
+    dC = os.path.join(args.workdir, "killC")
+    if kill_leg("C", dC, f"ckpt.write::{k1}=kill"):
+        p2, _ = _spawn(args, dC, f"ckpt.write::{args.chunks + 2}=kill",
+                       resume=True, elastic=True, world=1)
+        if p2.returncode != -9:
+            failures.append(f"C: second kill (world=1 resume) did not "
+                            f"crash (rc={p2.returncode})")
+        else:
+            resume_leg("C (1→2 after-reshard)", dC, 2)
+
+    # D: corruption injected DURING the re-shard read: the stage must
+    # degrade to recompute — bit-equal, nothing resharded
+    dD = os.path.join(args.workdir, "killD")
+    if kill_leg("D", dD, f"ckpt.write::{k1}=kill"):
+        p2, info = _spawn(args, dD, "ckpt.reshard::1=corrupt",
+                          resume=True, elastic=True, world=1)
+        if p2.returncode != 0 or not info:
+            failures.append(f"D: corrupt-reshard resume failed "
+                            f"rc={p2.returncode}")
+        elif info.get("sha") != base["sha"]:
+            failures.append(f"D: corrupt reshard produced a WRONG "
+                            f"answer: {info}")
+        elif info.get("resume_resharded_pieces"):
+            failures.append(f"D: corrupt reshard still adopted pieces: "
+                            f"{info}")
+        else:
+            print("# elastic D (corrupt reshard → recompute) -> ok",
+                  flush=True)
+
+    # F: SIGKILL DURING the re-shard itself (mid-adoption crash): the
+    # checkpoint state is untouched (adoption commits nothing until the
+    # rewrite), so resuming AGAIN must re-shard cleanly
+    dF = os.path.join(args.workdir, "killF")
+    if kill_leg("F", dF, f"ckpt.write::{k1}=kill"):
+        p2, _ = _spawn(args, dF, "ckpt.reshard::1=kill", resume=True,
+                       elastic=True, world=1)
+        if p2.returncode != -9:
+            failures.append(f"F: kill mid-reshard did not crash "
+                            f"(rc={p2.returncode})")
+        else:
+            resume_leg("F (reshard after mid-reshard kill)", dF, 1)
+
+    # E: preemption grace — SIGTERM (term kind) with the grace budget
+    # armed must exit via typed ResumableAbort (RESUMABLE_EXIT), not a
+    # signal death, with the current stage committed; the world=1
+    # resume then rides the committed prefix
+    dE = os.path.join(args.workdir, "termE")
+    grace = {"CYLON_TPU_PREEMPT_GRACE_S": "30"}
+    p1, info1 = _spawn(args, dE, f"ckpt.write::{k1}=term", resume=False,
+                       elastic=True, world=2, extra_env=grace)
+    if p1.returncode != RESUMABLE_EXIT:
+        failures.append(f"E: SIGTERM with grace armed did not drain via "
+                        f"ResumableAbort (rc={p1.returncode}): "
+                        f"{(p1.stdout + p1.stderr)[-1500:]}")
+    elif not info1 or not info1.get("checkpoint_events"):
+        failures.append(f"E: grace drain committed nothing: {info1}")
+    else:
+        print(f"# elastic E drain -> ok (committed="
+              f"{info1['checkpoint_events']})", flush=True)
+        p2, info2 = _spawn(args, dE, "", resume=True, elastic=True,
+                           world=1, extra_env=grace)
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"E: resume after grace drain diverged "
+                            f"(rc={p2.returncode}): {info2}")
+        else:
+            print(f"# elastic E resume -> ok (ffwd="
+                  f"{info2['resume_fast_forwarded_pieces']})", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"elastic": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
 def _worker_concurrent(args, env, make_workload) -> int:
     """K concurrent serving sessions over one mesh (exec/scheduler), each
     a differently-seeded pipelined join+sink tenant.  ``--only i``
@@ -389,9 +619,11 @@ def _pinned_schedules() -> list[dict]:
 
 def _spawn(args, workdir: str, faults: str, resume: bool,
            extra_env: dict | None = None, concurrent: int = 1,
-           only: int | None = None, stream: bool = False) -> tuple:
+           only: int | None = None, stream: bool = False,
+           elastic: bool = False, world: int | None = None) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
+    env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["CYLON_TPU_FAULTS"] = faults
@@ -403,11 +635,13 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
         env.pop("CYLON_TPU_RESUME", None)
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            f"--rows={args.rows}", f"--chunks={args.chunks}",
-           f"--concurrent={concurrent}"]
+           f"--concurrent={concurrent}", f"--world={world or 4}"]
     if only is not None:
         cmd.append(f"--only={only}")
     if stream:
         cmd.append("--stream")
+    if elastic:
+        cmd.append("--elastic")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -544,6 +778,14 @@ def main() -> int:
                          "(SIGKILL mid-ingest with checkpointing armed; "
                          "resume must fast-forward committed window "
                          "state and stay bit-equal)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-resume acceptance flow "
+                         "(checkpoint at world=2, SIGKILL/SIGTERM "
+                         "mid-run, resume at world=1 and at world=2-"
+                         "after-reshard; every schedule must end "
+                         "bit-equal to the uninterrupted baseline)")
+    ap.add_argument("--world", type=int, default=4,
+                    help="(worker) mesh world size for this process")
     args = ap.parse_args()
 
     if args.worker:
@@ -552,6 +794,9 @@ def main() -> int:
 
     if args.stream:
         return run_stream(args)
+
+    if args.elastic:
+        return run_elastic(args)
 
     if args.concurrent > 1:
         return run_concurrent(args)

@@ -1,0 +1,27 @@
+"""TS111 fixture: reads of a FOREIGN rank's checkpoint directory —
+``rank<r>`` paths constructed off the checkpoint dir — outside
+``cylon_tpu/exec/checkpoint.py``.  The elastic re-shard path
+(``Stage.load_foreign_pieces``) is the one sanctioned cross-rank
+reader: it sha-verifies every page, resolves the manifest GENERATION
+(a post-reshard rewrite supersedes stale old-world rank dirs) and
+min-votes the adoption over the live mesh.  An ad-hoc read sees none
+of that and can splice a stale generation's or a torn write's state
+into a resume."""
+
+import json
+import os
+
+
+def peek_peer_manifest(ckpt_dir, r):
+    # TS111: foreign rank dir constructed by hand off the ckpt root
+    man = os.path.join(ckpt_dir, f"rank{r}", "stage000-pipelined_join",
+                       "MANIFEST.json")
+    with open(man, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def steal_rank0_page(ckpt_dir):
+    # TS111: literal rank segment, same hazard
+    path = os.path.join(ckpt_dir, "rank0/stage000-x/piece_0.p0")
+    with open(path, "rb") as f:
+        return f.read()

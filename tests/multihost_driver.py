@@ -59,6 +59,73 @@ env.barrier()
 # two-phase CkptCommit vote completed, recompute the rest, and must end
 # bit-equal with the IDENTICAL manifest epoch on every rank.
 # ---------------------------------------------------------------------------
+# ---------------------------------------------------------------------------
+# Scenario mode: elastic_resume (docs/robustness.md "Elastic resume &
+# preemption grace").  First launch (2 processes, world=8): a TWO-stage
+# pipelined workload — sinkless join feeding a join+sink — checkpoints
+# per piece; the `kill` fault SIGKILLs rank 0 at stage 2's first
+# checkpoint write, leaving stage 1 COMPLETE on disk across both rank
+# dirs.  Second launch (1 process, world=4 — a topology change): the
+# resume must detect the world mismatch, merge both old rank dirs'
+# shard blocks, re-shard stage 1 onto the 4-device mesh
+# (resume_resharded_pieces > 0, ffwd > 0), recompute stage 2, and end
+# equal to the pandas oracle.
+# ---------------------------------------------------------------------------
+if os.environ.get("CYLON_TPU_MH_SCENARIO") == "elastic_resume":
+    import hashlib
+
+    from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, \
+        recovery
+
+    resuming = os.environ.get("CYLON_TPU_RESUME") == "1"
+    if not resuming:
+        # stage 1 owns writes 1..3 (n_chunks=3); write 4 is stage 2's
+        # first piece — killing there leaves stage 1 complete
+        recovery.install_faults("ckpt.write:0:4=kill")
+    erng = np.random.default_rng(17)   # same seed per process: SPMD ingest
+    n_ord, n_li, n_cust = 600, 2400, 16
+    orders = ct.Table.from_pydict(
+        {"o_orderkey": np.arange(n_ord, dtype=np.int64),
+         "o_custkey": erng.integers(0, n_cust, n_ord).astype(np.int64)}, env)
+    lineitem = ct.Table.from_pydict(
+        {"l_orderkey": erng.integers(0, n_ord, n_li).astype(np.int64),
+         "l_quantity": erng.integers(1, 51, n_li).astype(np.int64)}, env)
+    customers = ct.Table.from_pydict(
+        {"c_custkey": np.arange(n_cust, dtype=np.int64),
+         "c_nationkey": erng.integers(0, 5, n_cust).astype(np.int64)}, env)
+    jt = pipelined_join(lineitem, orders, "l_orderkey", "o_orderkey",
+                        how="inner", n_chunks=3)
+    esink = GroupBySink("o_custkey", [("l_quantity", "sum")])
+    pipelined_join(jt, customers, "o_custkey", "c_custkey", how="inner",
+                   n_chunks=3, sink=esink)
+    got = (esink.finalize().to_pandas().sort_values("o_custkey")
+           .reset_index(drop=True))
+    # pandas oracle (world-invariant: integer sums, unique group keys)
+    odf = pd.DataFrame({"o_orderkey": np.arange(n_ord, dtype=np.int64)})
+    erng2 = np.random.default_rng(17)
+    odf["o_custkey"] = erng2.integers(0, n_cust, n_ord).astype(np.int64)
+    ldf2 = pd.DataFrame(
+        {"l_orderkey": erng2.integers(0, n_ord, n_li).astype(np.int64),
+         "l_quantity": erng2.integers(1, 51, n_li).astype(np.int64)})
+    exp = (ldf2.merge(odf, left_on="l_orderkey", right_on="o_orderkey")
+           .groupby("o_custkey", as_index=False)
+           .agg(l_quantity_sum=("l_quantity", "sum"))
+           .sort_values("o_custkey").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got[["o_custkey", "l_quantity_sum"]], exp,
+                                  check_dtype=False)
+    st = checkpoint.stats()
+    if resuming:
+        assert st["resume_fast_forwarded_pieces"] > 0, st
+        assert st["resume_resharded_pieces"] > 0, st
+        assert st["resume_world_mismatch"] > 0, st
+    sha = hashlib.sha256(got.to_csv(index=False).encode()).hexdigest()
+    print(f"ELASTIC_OK pid={pid} world={env.world_size} "
+          f"ffwd={st['resume_fast_forwarded_pieces']} "
+          f"resharded={st['resume_resharded_pieces']} "
+          f"mismatch={st['resume_world_mismatch']} sha={sha[:16]}",
+          flush=True)
+    sys.exit(0)
+
 if os.environ.get("CYLON_TPU_MH_SCENARIO") == "kill_resume":
     import glob
     import hashlib

@@ -15,9 +15,10 @@ import pandas as pd
 import pytest
 
 import cylon_tpu as ct
-from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, recovery
+from cylon_tpu.exec import GroupBySink, checkpoint, pipelined_join, preempt, \
+    recovery
 from cylon_tpu.status import (CheckpointCorruptError, DeviceOOMError,
-                              ResumableAbort)
+                              InvalidError, ResumableAbort)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -25,16 +26,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.fixture(autouse=True)
 def _clean(tmp_path, monkeypatch):
     """Every test runs with its own checkpoint root, a fresh stage
-    sequence, zeroed counters and a disarmed injector."""
+    sequence, zeroed counters, a disarmed injector and no pending
+    preemption notice."""
     monkeypatch.setenv("CYLON_TPU_CKPT_DIR", str(tmp_path / "ckpt"))
     monkeypatch.delenv("CYLON_TPU_RESUME", raising=False)
+    monkeypatch.delenv("CYLON_TPU_PREEMPT_GRACE_S", raising=False)
     checkpoint.reset_stages()
     checkpoint.reset_stats()
     recovery.install_faults("")
+    preempt.reset()
     yield
     checkpoint.reset_stages()
     checkpoint.reset_stats()
     recovery.install_faults("")
+    preempt.reset(uninstall=True)
+
+
+@pytest.fixture(scope="module")
+def env2():
+    """2-device env for the elastic (world-change) resume tests — the
+    same virtual-device rig env4 uses, half the mesh."""
+    from cylon_tpu.ctx.context import CPUMeshConfig
+    return ct.CylonEnv(config=CPUMeshConfig(world_size=2))
+
+
+@pytest.fixture()
+def grace(monkeypatch):
+    """Arm the preemption grace budget and install the SIGTERM
+    handler (uninstalled by _clean's teardown)."""
+    monkeypatch.setenv("CYLON_TPU_PREEMPT_GRACE_S", "30")
+    assert preempt.install()
+    return preempt
 
 
 def _tables(env, rng, n=2500, card=250):
@@ -323,6 +345,443 @@ class TestResumeFastForward:
 
 
 # ---------------------------------------------------------------------------
+# elastic resume: checkpoints survive topology changes (re-shard path)
+# ---------------------------------------------------------------------------
+
+def _join_on(env, ldf, rdf, n_chunks=3):
+    """The sinkless workload rebuilt on ``env`` from the same frames —
+    what a resumed process on a different topology actually does."""
+    lt = ct.Table.from_pandas(ldf, env)
+    rt = ct.Table.from_pandas(rdf, env)
+    return _run_join(lt, rt, n_chunks=n_chunks)
+
+
+def _sink_on(env, ldf, rdf, n_chunks=3):
+    lt = ct.Table.from_pandas(ldf, env)
+    rt = ct.Table.from_pandas(rdf, env)
+    return _run_sink(lt, rt, n_chunks=n_chunks)
+
+
+def _resume_mode(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+    checkpoint.reset_stages()
+    checkpoint.reset_stats()
+
+
+class TestElasticReshard:
+    def _frames(self, rng, n=1800, card=200):
+        ldf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                            "a": rng.integers(0, 50, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                            "b": rng.integers(0, 50, n).astype(np.int64)})
+        return ldf, rdf
+
+    def test_shrink_world_reshards_then_plain_fast_forward(
+            self, env4, env2, rng, monkeypatch):
+        """world=4 → world=2: the complete stage re-shards (every piece
+        fast-forwarded AND counted as resharded, the mismatch counted
+        once), and — because the first post-reshard commit rewrote the
+        manifests in the new layout — a SECOND resume at world=2 is a
+        plain fast-forward with zero reshard work."""
+        ldf, rdf = self._frames(rng)
+        base = _join_on(env4, ldf, rdf)
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        assert n_pieces >= 2
+        _resume_mode(monkeypatch)
+        resharded = _join_on(env2, ldf, rdf)
+        _frames_bitequal(resharded, base)
+        s = checkpoint.stats()
+        assert s["resume_world_mismatch"] == 1
+        assert s["resume_resharded_pieces"] == n_pieces
+        assert s["resume_fast_forwarded_pieces"] == n_pieces
+        # second resume at the new world: rewritten manifests match the
+        # full layout token — ordinary fast-forward, nothing resharded
+        _resume_mode(monkeypatch)
+        again = _join_on(env2, ldf, rdf)
+        _frames_bitequal(again, base)
+        s2 = checkpoint.stats()
+        assert s2["resume_world_mismatch"] == 0
+        assert s2["resume_resharded_pieces"] == 0
+        assert s2["resume_fast_forwarded_pieces"] == n_pieces
+        assert s2["checkpoint_events"] == 0
+
+    def test_grow_world_reshards(self, env4, env2, rng, monkeypatch):
+        """world=2 → world=4 (M > N): ranks that never existed at
+        checkpoint time adopt the stitched state too."""
+        ldf, rdf = self._frames(rng)
+        base = _join_on(env2, ldf, rdf)
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        _resume_mode(monkeypatch)
+        out = _join_on(env4, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == n_pieces > 0
+        assert s["resume_world_mismatch"] == 1
+
+    def test_reshard_to_single_device(self, env4, env1, rng, monkeypatch):
+        """world=4 → world=1: the degenerate mesh still adopts."""
+        ldf, rdf = self._frames(rng, n=1200)
+        base = _join_on(env4, ldf, rdf)
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        _resume_mode(monkeypatch)
+        out = _join_on(env1, ldf, rdf)
+        _frames_bitequal(out, base)
+        assert checkpoint.stats()["resume_resharded_pieces"] == n_pieces > 0
+
+    def test_lane_classes_round_trip_bit_exact(self, env4, env2, rng,
+                                               monkeypatch):
+        """Strings (dictionary codes), nullable ints and NaN-carrying
+        f64 side arrays survive the stitch + re-block bit-exactly —
+        the reshard reuses the page transport, so every lane class the
+        spill tier round-trips must round-trip here too."""
+        n = 1600
+        ldf = pd.DataFrame({
+            "k": rng.integers(0, 120, n).astype(np.int64),
+            "s": np.asarray([f"v{i % 11}" for i in range(n)], dtype=object),
+            "f": np.where(rng.random(n) < 0.15, np.nan, rng.random(n)),
+            "ni": pd.array(rng.integers(0, 9, n), dtype="Int64"),
+        })
+        ldf.loc[rng.integers(0, n, 40), "ni"] = pd.NA
+        rdf = pd.DataFrame({"k": rng.integers(0, 120, n).astype(np.int64),
+                            "b": rng.integers(0, 50, n).astype(np.int64)})
+
+        def run(env):
+            lt = ct.Table.from_pandas(ldf, env)
+            rt = ct.Table.from_pandas(rdf, env)
+            out = pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=3)
+            df = out.to_pandas()
+            return df.sort_values(["k", "b", "s", "f"],
+                                  na_position="last").reset_index(drop=True)
+
+        base = run(env4)
+        assert checkpoint.stats()["checkpoint_events"] >= 2
+        _resume_mode(monkeypatch)
+        resharded = run(env2)
+        assert checkpoint.stats()["resume_resharded_pieces"] > 0
+        assert list(resharded.columns) == list(base.columns)
+        for c in base.columns:
+            a = base[c].to_numpy()
+            b = resharded[c].to_numpy()
+            if a.dtype.kind == "f":
+                np.testing.assert_array_equal(a, b, c)   # NaN == NaN here
+            else:
+                np.testing.assert_array_equal(a, b, c)
+
+    def test_corrupt_foreign_page_degrades_to_recompute(
+            self, env4, env2, rng, monkeypatch):
+        """A flipped byte in a foreign rank's committed page: the
+        reshard detects the hash mismatch and the stage recomputes —
+        bit-equal, never a wrong answer."""
+        ldf, rdf = self._frames(rng, n=1200)
+        base = _join_on(env4, ldf, rdf)
+        rank_dir = os.path.join(checkpoint.ckpt_dir(), "rank0")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        page = next(p for p in sorted(os.listdir(stage_dir))
+                    if p.startswith("piece_0.p"))
+        path = os.path.join(stage_dir, page)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(raw))
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        assert s["corrupt_pages"] >= 1
+        assert s["resume_resharded_pieces"] == 0
+        assert s["resume_world_mismatch"] == 1   # detected, then degraded
+
+    def test_injected_reshard_corruption(self, env4, env2, rng,
+                                         monkeypatch):
+        ldf, rdf = self._frames(rng, n=1200)
+        base = _join_on(env4, ldf, rdf)
+        _resume_mode(monkeypatch)
+        recovery.install_faults("ckpt.reshard::1=corrupt")
+        out = _join_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        assert checkpoint.stats()["resume_resharded_pieces"] == 0
+        assert any(e["site"] == "ckpt.reshard"
+                   for e in recovery.recovery_events())
+
+    def test_sink_partial_reshard_equals_batch_recompute(
+            self, env4, env2, rng, monkeypatch):
+        """GroupBySink partials re-shard as MERGEABLE state: the adopted
+        (re-blocked) partials combine through combine_sink_partials to
+        the exact batch answer."""
+        ldf, rdf = self._frames(rng)
+        base = _sink_on(env4, ldf, rdf)
+        exp = (ldf.merge(rdf, on="k").groupby("k", as_index=False)
+               .agg(a_sum=("a", "sum"), b_sum=("b", "sum"))
+               .sort_values("k").reset_index(drop=True))
+        pd.testing.assert_frame_equal(base, exp, check_dtype=False)
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        _resume_mode(monkeypatch)
+        out = _sink_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == n_pieces > 0
+
+    def test_incomplete_stage_recomputes_and_counts(self, env4, env2, rng,
+                                                    monkeypatch):
+        """A stage that never completed at the old topology (a crash
+        prefix) is NOT adoptable across a world change: old-layout
+        pieces have no complement in the new layout.  The mismatch is
+        counted and the stage recomputes — the satellite contract that
+        kills the silent-recompute behavior."""
+        import json
+        ldf, rdf = self._frames(rng, n=1200)
+        base = _join_on(env4, ldf, rdf)
+        rank_dir = os.path.join(checkpoint.ckpt_dir(), "rank0")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        mpath = os.path.join(stage_dir, "MANIFEST.json")
+        man = json.load(open(mpath, encoding="utf-8"))
+        man["complete"] = False   # as if the process died mid-stage
+        json.dump(man, open(mpath, "w", encoding="utf-8"))
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        assert s["resume_world_mismatch"] == 1
+        assert s["resume_resharded_pieces"] == 0
+        assert s["resume_fast_forwarded_pieces"] == 0
+        assert any(e["site"] == "ckpt.reshard"
+                   and e["kind"] == "world_mismatch"
+                   for e in recovery.recovery_events())
+
+    def test_truncated_complete_manifest_recomputes(self, env4, env2, rng,
+                                                    monkeypatch):
+        """A manifest still flagged complete but with a truncated piece
+        table (torn edit, tampering) must NOT adopt the surviving
+        prefix as the whole stage — the recorded completion count gates
+        the adoption, and the stage recomputes bit-equal."""
+        import json
+        ldf, rdf = self._frames(rng, n=1200)
+        base = _join_on(env4, ldf, rdf)
+        rank_dir = os.path.join(checkpoint.ckpt_dir(), "rank0")
+        stage_dir = os.path.join(rank_dir, sorted(os.listdir(rank_dir))[0])
+        mpath = os.path.join(stage_dir, "MANIFEST.json")
+        man = json.load(open(mpath, encoding="utf-8"))
+        assert man["complete"] and man["n_pieces"] >= 2
+        dropped = str(max(int(k) for k in man["pieces"]))
+        del man["pieces"][dropped]          # n_pieces left claiming more
+        json.dump(man, open(mpath, "w", encoding="utf-8"))
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == 0
+        assert s["resume_world_mismatch"] == 1
+
+    def test_changed_data_never_adopts_across_worlds(self, env4, env2, rng,
+                                                     monkeypatch):
+        """Review regression: the world-invariant BASE token carries a
+        data fingerprint (global live row totals), so an elastic resume
+        over DIFFERENT inputs must not adopt the stale checkpoint — it
+        recomputes the new data's answer, exactly like the same-world
+        full-token guard."""
+        ldf, rdf = self._frames(rng, n=1500)
+        _join_on(env4, ldf, rdf)                      # checkpoint D1 @ 4
+        ldf2, rdf2 = self._frames(rng, n=1100)        # a DIFFERENT dataset
+        exp = (ldf2.merge(rdf2, on="k").sort_values(["k", "a", "b"])
+               .reset_index(drop=True))
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf2, rdf2)              # resume D2 @ 2
+        pd.testing.assert_frame_equal(out[exp.columns], exp,
+                                      check_dtype=False)
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == 0      # D1 never spliced in
+        assert s["resume_fast_forwarded_pieces"] == 0
+
+    def test_fresh_run_supersedes_older_generations(self, env4, env2, rng,
+                                                    monkeypatch):
+        """Review regression: generations must stay monotonic ACROSS
+        sessions.  After a reshard rewrite (gen 1), a FRESH run of the
+        same shape with DIFFERENT payload values must not be outranked
+        by the stale rewrite at the next resume — same keys means the
+        layout token matches, so only the generation can disambiguate,
+        and losing would silently fast-forward the old run's data."""
+        ldf, rdf = self._frames(rng, n=1200)
+        _join_on(env4, ldf, rdf)                      # gen 0 @ world 4
+        _resume_mode(monkeypatch)
+        _join_on(env2, ldf, rdf)                      # reshard → gen 1 @ 2
+        # fresh session, same keys (identical layout token), new values
+        monkeypatch.delenv("CYLON_TPU_RESUME", raising=False)
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        ldf2 = ldf.copy()
+        ldf2["a"] = ldf2["a"] + 1000
+        base2 = _join_on(env2, ldf2, rdf)             # must write gen 2
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf2, rdf)
+        _frames_bitequal(out, base2)                  # the NEW data
+        s = checkpoint.stats()
+        assert s["resume_fast_forwarded_pieces"] == n_pieces > 0
+        assert s["resume_world_mismatch"] == 0
+
+    def test_orphan_rank_dirs_do_not_block_resume(self, env4, env2, rng,
+                                                  monkeypatch):
+        """Review regression: leftover rank dirs from an older topology
+        (a shared PVC reused across launches) must not read as a 'torn
+        checkpoint' against a newer run's manifests — the fresh run's
+        generation bump outranks them."""
+        import shutil
+        ldf, rdf = self._frames(rng, n=1200)
+        _join_on(env4, ldf, rdf)                      # gen 0 @ world 4
+        root = checkpoint.ckpt_dir()
+        # simulate a second old process's dir surviving on shared storage
+        shutil.copytree(os.path.join(root, "rank0"),
+                        os.path.join(root, "rank1"))
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        base = _join_on(env2, ldf, rdf)               # fresh → gen 1 @ 2
+        n_pieces = checkpoint.stats()["checkpoint_events"]
+        assert n_pieces > 0                           # it did NOT resume
+        _resume_mode(monkeypatch)
+        out = _join_on(env2, ldf, rdf)
+        _frames_bitequal(out, base)
+        s = checkpoint.stats()
+        # plain fast-forward of the fresh run, orphans ignored
+        assert s["resume_fast_forwarded_pieces"] == n_pieces
+        assert s["resume_world_mismatch"] == 0
+
+    def test_unrestore_clamps_and_raises(self):
+        """Satellite regression: over-unrestoring (a consensus bug)
+        clamps the counter at zero and raises typed — a bench read can
+        never report a negative fast-forward count."""
+        checkpoint._STATS["resume_fast_forwarded_pieces"] = 2
+        checkpoint.unrestore(1)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 1
+        with pytest.raises(InvalidError):
+            checkpoint.unrestore(5)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 0
+        with pytest.raises(InvalidError):
+            checkpoint.unrestore(-1)
+        checkpoint.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# preemption grace: SIGTERM drains at checkpoint boundaries
+# ---------------------------------------------------------------------------
+
+class TestPreemptGrace:
+    def test_sigterm_drains_committed_then_resumes(self, env4, rng, grace,
+                                                   monkeypatch):
+        """The ``term`` injector delivers a REAL SIGTERM mid-run (through
+        the installed handler); the piece loop drains at the next
+        checkpoint boundary: pending sink chunks settle, the manifest
+        commits, and a typed ResumableAbort carries the resume token.
+        The resumed run fast-forwards the grace window's commits and is
+        bit-equal to the pandas oracle."""
+        ldf, rdf, lt, rt = _tables(env4, rng, n=1800)
+        recovery.install_faults("ckpt.write::2=term")
+        with pytest.raises(ResumableAbort) as ei:
+            _run_sink(lt, rt, n_chunks=3)
+        assert ei.value.token == os.path.abspath(checkpoint.ckpt_dir())
+        assert grace.requested()
+        committed = checkpoint.stats()["checkpoint_events"]
+        assert committed >= 2
+        assert any(e["kind"] == "preempt" and e["action"] == "drain"
+                   for e in recovery.recovery_events())
+        # the drain left a committed, resumable prefix
+        recovery.install_faults("")
+        grace.reset()
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        out = _run_sink(lt, rt, n_chunks=3)
+        exp = (ldf.merge(rdf, on="k").groupby("k", as_index=False)
+               .agg(a_sum=("a", "sum"), b_sum=("b", "sum"))
+               .sort_values("k").reset_index(drop=True))
+        pd.testing.assert_frame_equal(out, exp, check_dtype=False)
+        assert checkpoint.stats()["resume_fast_forwarded_pieces"] \
+            == committed
+
+    def test_unarmed_checkpoint_means_zero_writes(self, env4, rng, grace,
+                                                  monkeypatch, tmp_path):
+        """The acceptance contract: with CYLON_TPU_CKPT_DIR unset the
+        handler changes NOTHING — the run completes, no file is written,
+        no drain fires (SIGTERM flag notwithstanding)."""
+        monkeypatch.delenv("CYLON_TPU_CKPT_DIR", raising=False)
+        _, _, lt, rt = _tables(env4, rng, n=800)
+        import signal
+        os.kill(os.getpid(), signal.SIGTERM)   # the real notice
+        out = _run_join(lt, rt, n_chunks=3)    # must complete normally
+        assert len(out) > 0
+        assert grace.requested()
+        assert checkpoint.stats() == {
+            "checkpoint_events": 0, "bytes_checkpointed": 0,
+            "resume_fast_forwarded_pieces": 0, "corrupt_pages": 0,
+            "resume_resharded_pieces": 0, "resume_world_mismatch": 0}
+        assert not (tmp_path / "ckpt").exists()
+
+    def test_grace_unset_means_no_drain(self, env4, rng):
+        """Checkpointing armed but no grace budget declared: the flag
+        (set programmatically — without a handler a real SIGTERM would
+        just kill the process, which is the point) triggers nothing."""
+        preempt.request()
+        _, _, lt, rt = _tables(env4, rng, n=800)
+        out = _run_join(lt, rt, n_chunks=3)
+        assert len(out) > 0
+        assert checkpoint.stats()["checkpoint_events"] >= 2
+
+    def test_scheduler_drains_running_tenant(self, env4, rng, grace):
+        """Multi-tenant preemption, notice mid-run: the targeted tenant
+        drains via typed ResumableAbort at its own checkpoint boundary
+        with durable state committed; every other tenant either finished
+        BEFORE the notice (a clean preemption leaves them done) or
+        drained typed too — no tenant dies mid-piece, none is left
+        running or pending."""
+        from cylon_tpu.exec.scheduler import QueryScheduler
+        ldf, rdf, _, _ = _tables(env4, rng, n=1500)
+
+        def make_fn():
+            def fn():
+                lt = ct.Table.from_pandas(ldf, env4)
+                rt = ct.Table.from_pandas(rdf, env4)
+                sink = GroupBySink("k", [("a", "sum")])
+                pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=3,
+                               sink=sink)
+                return sink.finalize()
+            return fn
+
+        recovery.install_faults("ckpt.write::2=term@t0")
+        sched = QueryScheduler(env4, policy="fair", max_concurrency=2)
+        sessions = [sched.submit(f"t{i}", make_fn()) for i in range(3)]
+        sched.run()
+        assert isinstance(sessions[0].error, ResumableAbort), \
+            sessions[0].error
+        for s in sessions[1:]:
+            assert (s.error is None and s.result is not None) \
+                or isinstance(s.error, ResumableAbort), (s.name, s.error)
+        assert all(s.state in ("done", "failed") for s in sessions)
+        assert sched.stats()["resumable_aborts"] >= 1
+        # t0 committed durable state before draining
+        assert checkpoint.stats()["checkpoint_events"] >= 1
+
+    def test_scheduler_preempt_before_admission(self, env4, rng, grace):
+        """Multi-tenant preemption, notice BEFORE anything ran: no
+        session is admitted; every pending tenant fails typed with the
+        resume token (nothing committed — a resume recomputes them) and
+        the drain is counted."""
+        from cylon_tpu.exec.scheduler import QueryScheduler
+        ldf, rdf, _, _ = _tables(env4, rng, n=800)
+
+        def fn():
+            raise AssertionError("a drained-pending session must not run")
+
+        preempt.request()
+        sched = QueryScheduler(env4, policy="fifo")
+        sessions = [sched.submit(f"t{i}", fn) for i in range(3)]
+        sched.run()
+        assert all(isinstance(s.error, ResumableAbort) for s in sessions)
+        st = sched.stats()
+        assert st["preempt_drained"] == 3
+        assert st["resumable_aborts"] == 3
+        assert checkpoint.stats()["checkpoint_events"] == 0
+
+
+# ---------------------------------------------------------------------------
 # happy path + FINAL ladder rung
 # ---------------------------------------------------------------------------
 
@@ -338,7 +797,9 @@ class TestHappyPathAndFinalRung:
         assert checkpoint.stats() == {"checkpoint_events": 0,
                                       "bytes_checkpointed": 0,
                                       "resume_fast_forwarded_pieces": 0,
-                                      "corrupt_pages": 0}
+                                      "corrupt_pages": 0,
+                                      "resume_resharded_pieces": 0,
+                                      "resume_world_mismatch": 0}
         assert not (tmp_path / "ckpt").exists()
 
     def test_device_oom_abort_becomes_resumable(self, env4, rng):
@@ -401,3 +862,21 @@ def test_chaos_soak_trimmed():
         capture_output=True, text=True, timeout=570, cwd=REPO)
     assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
     assert "killed+resumed(ffwd=1)" in p.stdout, p.stdout[-2000:]
+
+
+@pytest.mark.slow
+def test_chaos_elastic_pinned():
+    """scripts/chaos_soak.py --elastic: the pinned elastic-resume
+    schedules — checkpoint at world=2, SIGKILL mid-stage-2, resume at
+    world=1 (2→1 re-shard, ffwd>0), plain world=2 resume, the 1→2
+    after-reshard double hop, corrupt-reshard degradation, and the
+    SIGTERM grace drain (typed ResumableAbort exit) — every schedule
+    bit-equal to the uninterrupted world=2 baseline."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_soak.py"),
+         "--elastic", "--rows", "1000", "--chunks", "3"],
+        capture_output=True, text=True, timeout=570, cwd=REPO)
+    assert p.returncode == 0, p.stdout[-4000:] + p.stderr[-2000:]
+    assert "A (2→1 reshard) -> ok" in p.stdout, p.stdout[-3000:]
+    assert "C (1→2 after-reshard) -> ok" in p.stdout, p.stdout[-3000:]
+    assert "E drain -> ok" in p.stdout, p.stdout[-3000:]

@@ -95,6 +95,38 @@ def test_kill_rank0_and_resume(tmp_path):
     assert all(int(m.group(2)) > 0 for m in stats), outs2
 
 
+def test_elastic_resume_world_change(tmp_path):
+    """Elastic resume acceptance, cross-process edition (docs/
+    robustness.md "Elastic resume & preemption grace"): a 2-process
+    world=8 session checkpoints a two-stage workload and is SIGKILLed at
+    stage 2's first write (stage 1 complete across BOTH rank dirs); a
+    SINGLE-process world=4 relaunch must detect the topology change,
+    merge the two rank dirs' shard blocks, re-shard stage 1 onto the
+    4-device mesh (ffwd > 0, resharded > 0), recompute stage 2 and match
+    the pandas oracle."""
+    global _CPU_MULTIPROCESS_UNSUPPORTED
+    if _CPU_MULTIPROCESS_UNSUPPORTED:
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+    base_env = {"CYLON_TPU_MH_SCENARIO": "elastic_resume",
+                "CYLON_TPU_CKPT_DIR": str(tmp_path),
+                "CYLON_TPU_WATCHDOG_S": "30"}
+    procs, outs = _spawn_drivers(2, base_env)
+    if _cpu_backend_unsupported(outs):
+        _CPU_MULTIPROCESS_UNSUPPORTED = True
+        pytest.skip("jaxlib CPU backend lacks multiprocess collectives")
+    # rank 0 died by SIGKILL mid-stage-2; rank 1 must not have completed
+    assert procs[0].returncode == -9, (procs[0].returncode, outs[0][-2000:])
+    assert "ELASTIC_OK pid=1" not in outs[1], outs[1][-2000:]
+    # the relaunch is ONE process (4 local devices): world 8 -> 4
+    procs2, outs2 = _spawn_drivers(1, {**base_env, "CYLON_TPU_RESUME": "1"})
+    assert procs2[0].returncode == 0, outs2[0][-4000:]
+    import re
+    m = re.search(r"ELASTIC_OK pid=0 world=4 ffwd=(\d+) resharded=(\d+) "
+                  r"mismatch=(\d+)", outs2[0])
+    assert m, outs2[0][-2000:]
+    assert int(m.group(1)) > 0 and int(m.group(2)) > 0, outs2[0][-1000:]
+
+
 @pytest.mark.parametrize("nproc", [2, 4])
 def test_multi_process_join_groupby_sort(nproc):
     """2- and 4-process worlds (reference test_all.py runs mpirun -n {2,4});

@@ -160,6 +160,25 @@ class TestInjector:
         assert (kind, armed) == (None, True)   # occurrence 1: armed only
         assert recovery.probe("ckpt.write")[0] == "kill"
 
+    def test_elastic_sites_and_kinds_parse(self):
+        """The elastic-resume grammar extensions: the ckpt.reshard site
+        (corrupt parses as interceptable — exec/checkpoint converts it
+        to a typed CheckpointCorruptError — and kill parses; firing it
+        would SIGKILL this process, exercised by chaos_soak --elastic)
+        and the `term` kind (delivers SIGTERM — the preemption notice;
+        tests/test_checkpoint.py fires it under an installed grace
+        handler)."""
+        recovery.install_faults("ckpt.reshard=corrupt")
+        assert recovery.maybe_inject(
+            "ckpt.reshard", intercept=("corrupt",)) == "corrupt"
+        recovery.install_faults("ckpt.reshard::2=kill")
+        kind, armed = recovery.probe("ckpt.reshard")
+        assert (kind, armed) == (None, True)
+        assert recovery.probe("ckpt.reshard")[0] == "kill"
+        recovery.install_faults("ckpt.write::3=term")
+        assert recovery.probe("ckpt.write") == (None, True)
+        recovery.install_faults("")
+
     def test_install_faults_fully_resets_state(self):
         """Regression (chaos-soak hygiene): re-installing a schedule
         must clear the per-site occurrence counters AND the recorded

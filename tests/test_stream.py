@@ -368,6 +368,98 @@ class TestViewCheckpointResume:
         assert len(view2.sink._parts) == 3   # restored, not recomputed
         pd.testing.assert_frame_equal(again, base, check_exact=True)
 
+    def test_world_change_reshards_committed_prefix(self, env4, tmp_path,
+                                                    monkeypatch):
+        """Elastic resume for streams (docs/robustness.md): a view's
+        piece identity (the batch ordinal) is world-invariant and its
+        partials are MERGEABLE, so a resume on a DIFFERENT mesh adopts
+        the committed prefix — each partial's foreign pages stitched
+        and re-blocked onto the live mesh, the replayed appends counted
+        not re-absorbed — and the final read is bit-equal."""
+        import cylon_tpu as ct
+        from cylon_tpu.ctx.context import CPUMeshConfig
+        monkeypatch.setenv("CYLON_TPU_CKPT_DIR", str(tmp_path))
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        env2 = ct.CylonEnv(config=CPUMeshConfig(world_size=2))
+
+        def run_stream(env):
+            rng = np.random.default_rng(9)
+            st = StreamTable(env, key="k", name="el")
+            view = IncrementalView(st, "k", [("v", "sum"), ("q", "mean")],
+                                   name="el_view", env=env)
+            for _ in range(4):
+                st.append(_batch(rng))
+            return view, view.read().to_pandas().sort_values("k") \
+                .reset_index(drop=True)
+
+        _, base = run_stream(env4)
+        assert checkpoint.stats()["checkpoint_events"] == 4
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        view2, again = run_stream(env2)
+        assert view2.fast_forwarded == 4
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == 4
+        assert s["resume_world_mismatch"] == 1
+        pd.testing.assert_frame_equal(again, base, check_exact=True)
+        # the rewrite re-committed the adopted prefix in the new
+        # layout: a THIRD run at world=2 is a plain fast-forward
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        view3, third = run_stream(env2)
+        assert view3.fast_forwarded == 4
+        assert checkpoint.stats()["resume_resharded_pieces"] == 0
+        pd.testing.assert_frame_equal(third, base, check_exact=True)
+
+    def test_world_change_corrupt_tail_trims_prefix(self, env4, tmp_path,
+                                                    monkeypatch):
+        """Review regression: one corrupt byte in the LAST committed
+        batch's page must cost one batch, not the stream's whole
+        history — the view's mergeable adoption trims to the verified
+        prefix (load_foreign_pieces(prefix_ok=True))."""
+        import cylon_tpu as ct
+        from cylon_tpu.ctx.context import CPUMeshConfig
+        monkeypatch.setenv("CYLON_TPU_CKPT_DIR", str(tmp_path))
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        env2 = ct.CylonEnv(config=CPUMeshConfig(world_size=2))
+
+        def run_stream(env):
+            rng = np.random.default_rng(13)
+            st = StreamTable(env, key="k", name="trim")
+            view = IncrementalView(st, "k", [("v", "sum")],
+                                   name="trim_view", env=env)
+            for _ in range(4):
+                st.append(_batch(rng))
+            return view, view.read().to_pandas().sort_values("k") \
+                .reset_index(drop=True)
+
+        _, base = run_stream(env4)
+        # flip a byte in the LAST batch's committed page
+        import os
+        stage_dir = os.path.join(str(tmp_path), "rank0",
+                                 next(d for d in os.listdir(
+                                     os.path.join(str(tmp_path), "rank0"))
+                                     if "trim_view" in d))
+        page = os.path.join(stage_dir, "piece_3.p0")
+        raw = bytearray(open(page, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(page, "wb") as f:
+            f.write(bytes(raw))
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        view2, again = run_stream(env2)
+        assert view2.fast_forwarded == 3        # trimmed, not discarded
+        s = checkpoint.stats()
+        assert s["resume_resharded_pieces"] == 3
+        assert s["corrupt_pages"] >= 1
+        pd.testing.assert_frame_equal(again, base, check_exact=True)
+        assert any(e["action"] == "prefix_trim"
+                   for e in recovery.recovery_events())
+
     def test_no_ckpt_no_writes(self, env4, tmp_path, monkeypatch):
         monkeypatch.delenv("CYLON_TPU_CKPT_DIR", raising=False)
         rng = np.random.default_rng(10)

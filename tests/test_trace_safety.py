@@ -284,6 +284,46 @@ def test_ts110_sanctioned_modules_exempt():
         "cylon_tpu/exec/scheduler.py", src))
 
 
+def test_ts111_foreign_rank_read_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_foreign_rank_read.py")) if f.rule == "TS111"]
+    # the f-string rank{r} join and the literal rank0/ segment
+    assert len(found) == 2, found
+    assert all("load_foreign_pieces" in f.message for f in found)
+
+
+def test_ts111_scoping_and_negatives():
+    src = ("import os\n"
+           "def peek(ckpt_dir, r):\n"
+           "    return os.path.join(ckpt_dir, f'rank{r}', 'MANIFEST.json')\n")
+    # the checkpoint module is the one sanctioned cross-rank reader
+    assert not any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/checkpoint.py", src))
+    assert any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", src))
+    assert any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/stream/view.py", src))
+    # rank literals with no checkpoint-path mention stay clean (an
+    # exchange peer table is not a checkpoint read) …
+    clean = ("import os\n"
+             "def peer(base, r):\n"
+             "    return os.path.join(base, f'rank{r}')\n")
+    assert not any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", clean))
+    # … and ckpt paths without a rank<r> segment are TS107's business
+    no_rank = ("import os\n"
+               "def tokenfile(ckpt_dir):\n"
+               "    return os.path.join(ckpt_dir, 'RESUME_TOKEN.json')\n")
+    assert not any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", no_rank))
+    # prefix words containing 'rank' are not rank dirs
+    ranked = ("import os\n"
+              "def f(ckpt_dir):\n"
+              "    return os.path.join(ckpt_dir, 'ranked_results')\n")
+    assert not any(f.rule == "TS111" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", ranked))
+
+
 def test_package_lints_clean():
     found = ast_lint.lint_paths([PKG])
     assert found == [], "\n".join(map(str, found))
@@ -293,7 +333,7 @@ def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
-                                       "TS109", "TS110"}
+                                       "TS109", "TS110", "TS111"}
 
 
 # ---------------------------------------------------------------------------
