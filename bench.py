@@ -64,7 +64,7 @@ def _sync(arr):
 def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
         skew: float = 0.0) -> dict:
     import cylon_tpu as ct
-    from cylon_tpu import config
+    from cylon_tpu import config, obs
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
     from cylon_tpu.exec import checkpoint, memory, recovery
     from cylon_tpu.relational import groupby_aggregate, join_tables
@@ -200,26 +200,23 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             "phases_s": {k: v["s"] for k, v in snap.items()},
             "phases_dispatch_s": dispatch_s,
             "phases_block_s": block_s,
-            # (site, kind, action) per recovery: was the number achieved
-            # on the happy path or after degradation? (docs/robustness.md)
-            "recovery_events": recovery.drain_events(),
-            # spill-tier traffic (exec/memory): resident vs host-spilled
-            # state — a throughput number with spill_events > 0 was
-            # PCIe-assisted, not HBM-resident
-            **{k: v for k, v in memory.stats().items() if k in
-               ("spill_events", "bytes_spilled", "peak_ledger_bytes",
-                "donated_bytes_reused")},
-            # durable-checkpoint traffic (exec/checkpoint): a number with
-            # checkpoint_events > 0 paid page writes in-loop; one with
-            # resume_fast_forwarded_pieces > 0 restored committed pieces
-            # instead of recomputing them (CYLON_TPU_RESUME=1).
+            # per-rank min/median/max phase skew (obs/rank_report,
+            # CYLON_TPU_RANK_REPORT=1): the measurement rung the
+            # heavy-hitter work stands on — one hot rank's piece_join
+            # seconds towering over the median IS the skew signal.
+            # Unarmed: not called, zero extra collectives.
+            **({"rank_phase_skew": obs.rank_report.report()}
+               if obs.rank_report.armed() else {}),
+            # recovery events + spill-tier + durable-checkpoint counters
+            # (cylon_tpu.obs.bench_detail — the collector every bench
+            # script shares): recovery_events says whether the number
+            # was achieved on the happy path or after degradation;
+            # spill_events > 0 means PCIe-assisted, not HBM-resident;
+            # checkpoint_events > 0 paid page writes in-loop, and
             # resume_world_mismatch vs resume_resharded_pieces tells
             # "resharded and fast-forwarded" apart from "threw the
             # checkpoint away" after a topology change (elastic resume)
-            **{k: v for k, v in checkpoint.stats().items() if k in
-               ("checkpoint_events", "bytes_checkpointed",
-                "resume_fast_forwarded_pieces", "resume_resharded_pieces",
-                "resume_world_mismatch")},
+            **obs.bench_detail(),
         },
     }
 

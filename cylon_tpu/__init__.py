@@ -16,6 +16,9 @@ User contract preserved from the reference (frame.py:2063 dispatch rule):
 """
 
 from . import config  # noqa: F401  (applies x64 policy at import)
+from . import obs  # noqa: F401  (observability: metrics/trace/rank report)
+obs.trace.autoarm()     # CYLON_TPU_TRACE=path arms the flight recorder
+obs.metrics.autoarm()   # CYLON_TPU_METRICS_JSON=path: end-of-run snapshot
 from .ctx.context import (CPUMeshConfig, CylonEnv, LocalConfig,  # noqa: F401
                           TPUConfig)
 from .core.column import Column  # noqa: F401

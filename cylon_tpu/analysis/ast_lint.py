@@ -67,6 +67,13 @@ function within the same module) — and flags:
   dirs) and min-votes the adoption over the live mesh — an ad-hoc read
   can splice a stale generation's or a torn write's state in;
 
+* **TS112** module-level mutable counter tables (``_STATS``-style dict
+  literals) outside ``cylon_tpu/obs/`` — ad-hoc counter dicts fragment
+  the telemetry the observability subsystem unified; counters route
+  through the metrics registry facade (``cylon_tpu.obs.metrics``
+  ``counter``/``group``/``namespace``), whose dict-like views are the
+  sanctioned migration shim;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -157,6 +164,16 @@ _SINK_STATE_ATTRS = {"_parts", "_regs", "_adopted", "_pending"}
 _SINK_MUTATORS = {"append", "extend", "insert", "clear", "pop", "remove"}
 _WINDOW_LIFETIME_FUNCS = {"register_window", "evict_release"}
 _STREAM_OK_FILES = ("exec/pipeline.py", "exec/memory.py")
+
+#: module-level mutable counter-table names (TS112): ad-hoc ``_STATS``
+#: dicts and friends must route through the metrics registry facade
+#: (cylon_tpu/obs/metrics — counter/group/namespace); the obs package
+#: itself is the defining module and exempt by construction
+_STATS_NAME_RE = re.compile(r"^_?[A-Z0-9_]*(STATS|COUNTERS|METRICS)$")
+#: the defining package, matched as a QUALIFIED path pair (a workspace
+#: directory that merely happens to be called "obs" must not disable
+#: the rule for everything under it)
+_OBS_PKG_PAIR = "/cylon_tpu/obs/"
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -421,6 +438,7 @@ class _ModuleLint:
         self._check_direct_admission()
         self._check_foreign_rank_read()
         self._check_stream_state()
+        self._check_stats_dicts()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -683,6 +701,47 @@ class _ModuleLint:
                     f"`.{node.func.value.attr}.{node.func.attr}()` "
                     "outside cylon_tpu/stream/ — route through the "
                     "GroupBySink absorb/snapshot API")
+
+    def _check_stats_dicts(self) -> None:
+        """TS112: a module-level mutable counter table — a dict literal
+        (or bare ``dict()`` call) bound to a ``_STATS``-style name
+        (``*STATS`` / ``*COUNTERS`` / ``*METRICS``) — anywhere outside
+        ``cylon_tpu/obs/``.  Before the observability subsystem, four
+        such dicts plus hand-rolled bench collection blocks each carried
+        a private slice of the telemetry; the registry facade
+        (cylon_tpu.obs.metrics ``counter``/``group``/``namespace``) is
+        now the one place counters live, so Prometheus exposition, JSON
+        snapshots and the bench detail see every counter.  Registry-
+        backed views (``metrics.group(...)``) bound to the same names
+        are the sanctioned migration shim and are not flagged (the
+        rule keys on the mutable LITERAL, not the name alone)."""
+        if _OBS_PKG_PAIR in "/" + self.path.replace(os.sep, "/"):
+            return
+        for node in self.tree.body:
+            if isinstance(node, ast.AnnAssign):
+                targets = [node.target] if node.value is not None else []
+                value = node.value
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            else:
+                continue
+            is_mutable_dict = isinstance(value, (ast.Dict, ast.DictComp)) \
+                or (isinstance(value, ast.Call)
+                    and _func_name(value.func) == "dict")
+            if not is_mutable_dict:
+                continue
+            for tgt in targets:
+                if (isinstance(tgt, ast.Name)
+                        and _STATS_NAME_RE.match(tgt.id)):
+                    self._emit(
+                        "TS112", node,
+                        f"module-level mutable counter table `{tgt.id}` "
+                        "outside cylon_tpu/obs/ — route counters through "
+                        "the metrics registry facade (cylon_tpu.obs."
+                        "metrics counter/group/namespace) so Prometheus "
+                        "exposition, JSON snapshots and bench_detail see "
+                        "every counter (docs/observability.md)")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

@@ -54,6 +54,10 @@ RULES = {
              "the sink absorb/snapshot API and the window close "
              "lifecycle so snapshots stay consistent and the ledger "
              "drains at close",
+    "TS112": "module-level mutable counter dict (_STATS-style table) "
+             "outside cylon_tpu/obs/ — counters must route through the "
+             "metrics registry facade (cylon_tpu.obs.metrics) so "
+             "exposition, snapshots and bench detail see every counter",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -123,9 +123,15 @@ def resume_requested() -> bool:
 # stats (bench JSON detail, alongside recovery_events / spill counters)
 # ---------------------------------------------------------------------------
 
-_STATS = {"checkpoint_events": 0, "bytes_checkpointed": 0,
-          "resume_fast_forwarded_pieces": 0, "corrupt_pages": 0,
-          "resume_resharded_pieces": 0, "resume_world_mismatch": 0}
+# counters live in the metrics registry (cylon_tpu.obs.metrics — the
+# TS112 facade); this dict-like view keeps every `_STATS[k] += 1` call
+# site (and tests poking the table directly) working verbatim
+from ..obs import metrics as _metrics  # noqa: E402
+
+_STATS = _metrics.group("ckpt", (
+    "checkpoint_events", "bytes_checkpointed",
+    "resume_fast_forwarded_pieces", "corrupt_pages",
+    "resume_resharded_pieces", "resume_world_mismatch"))
 
 
 def stats() -> dict:
@@ -861,4 +867,9 @@ def flush_for_abort(label: str) -> str:
     except OSError:
         pass  # the committed manifests are the durable state; the
         # breadcrumb is best-effort
+    # flight-recorder postmortem (obs/trace, armed runs only): the
+    # last-N timeline events land alongside the manifests — the
+    # multi-event successor of the single last_region() breadcrumb
+    from ..obs import trace
+    trace.postmortem(f"abort flush: {label}", dir_path=root)
     return token

@@ -695,10 +695,23 @@ def upload_window(reg: Registration, starts, window: int):
 # stats + eviction log (bench detail; cross-rank coherence assertions)
 # ---------------------------------------------------------------------------
 
-_STATS = {"spill_events": 0, "bytes_spilled": 0,
-          "readmit_events": 0, "bytes_readmitted": 0,
-          "donated_bytes_reused": 0, "cross_session_evictions": 0,
-          "window_evictions": 0}
+# counters live in the metrics registry (cylon_tpu.obs.metrics — the
+# TS112 facade); this dict-like view keeps every `_STATS[k] += 1` call
+# site and the public stats() shim working verbatim
+from ..obs import metrics as _metrics  # noqa: E402
+
+_STATS = _metrics.group("memory", (
+    "spill_events", "bytes_spilled",
+    "readmit_events", "bytes_readmitted",
+    "donated_bytes_reused", "cross_session_evictions",
+    "window_evictions"))
+
+_metrics.gauge("memory_ledger_bytes",
+               help="current resident-ledger balance (bytes)",
+               fn=lambda: _LEDGER.balance())
+_metrics.gauge("memory_peak_ledger_bytes",
+               help="resident-ledger high-water mark (bytes)",
+               fn=lambda: _LEDGER.peak)
 
 #: owners in eviction order since the last reset — the multihost driver
 #: asserts this sequence is IDENTICAL across ranks

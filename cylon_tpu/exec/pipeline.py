@@ -26,6 +26,7 @@ Degenerate case C=1 equals the monolithic operator exactly.
 
 from __future__ import annotations
 
+import time as _time
 from collections.abc import Sequence
 
 import jax
@@ -34,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..obs import trace as _trace
 from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
@@ -54,7 +56,12 @@ def _interleave() -> None:
     hands the baton to the next tenant — its already-dispatched async
     device work keeps executing underneath, so the PR 6 overlap
     scheduler keeps the device busy ACROSS tenants.  A no-op (one
-    module-global load) outside a scheduler."""
+    module-global load) outside a scheduler.  Piece boundaries are
+    also the periodic metrics-snapshot poll for entrypoints that never
+    run the scheduler loop (bench.py; CYLON_TPU_METRICS_JSON) — one
+    list load when unarmed."""
+    from ..obs import metrics
+    metrics.maybe_write_snapshot()
     from . import scheduler
     scheduler.maybe_yield()
 
@@ -263,6 +270,7 @@ class GroupBySink:
         self._regs.append(memory.register_table("sink_part", part))
         if self._ckpt is not None:
             self._ckpt.save_piece(self._adopted, part)
+        _trace.async_end("sink.chunk_inflight", self._adopted)
         self._adopted += 1
 
     def mark_key_disjoint(self) -> None:
@@ -281,6 +289,12 @@ class GroupBySink:
         execution.hpp:43)."""
         from ..relational.fused import try_begin_join_groupby
         from ..relational.groupby import _normalize_aggs, groupby_aggregate
+        # async trace span per chunk (obs/trace, armed runs only):
+        # begins at absorb, ends when the chunk's partial is ADOPTED —
+        # for deferred chunks that is one piece later, which is exactly
+        # the dispatch/consume overlap the timeline exists to show
+        _trace.async_begin("sink.chunk_inflight",
+                           self._adopted + len(self._pending))
         specs = _normalize_aggs(list(self._chunk_aggs))
         h = try_begin_join_groupby(chunk, self.by, specs, 1)
         if h is not None:
@@ -927,6 +941,11 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
 
     nxt = piece_future(live_ranges[start]) if live_ranges[start:] else None
     for i in range(start, len(live_ranges)):
+        # flight-recorder lifecycle (obs/trace, armed runs only): a
+        # dispatch span per piece — paired with the sink's async
+        # in-flight span, the Perfetto timeline shows piece r+1's
+        # dispatch overlapping piece r's consume
+        t_disp = _time.perf_counter() if _trace.armed() else None
         piece_l, piece_r = nxt.get()
         nxt = None
         if i + 1 < len(live_ranges) and _prefetch_ok(live_ranges[i + 1]):
@@ -942,6 +961,9 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                                 how=how, suffixes=suffixes,
                                 assume_colocated=True,
                                 allow_defer=(sink is not None))
+        if t_disp is not None:
+            _trace.complete("pipe.piece_dispatch", t_disp,
+                            piece=int(live_ranges[i]))
         with timing.region("pipe.consume"):
             out_r = sink(res_r) if sink is not None else res_r
         if stage is not None and sink is None:

@@ -86,6 +86,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import config
 from ..ctx.context import ROW_AXIS
+from ..obs import trace as _trace
 from ..status import (CapacityOverflowError, CheckpointCorruptError, Code,
                       CylonError, DeviceOOMError, FAULT_TYPES,
                       PredictedResourceExhausted, RankDesyncError,
@@ -116,10 +117,14 @@ shard_map = jax.shard_map
 #: failed foreign-page hash check (the stage degrades to recompute,
 #: never a wrong answer) and ``kill`` crashes mid-reshard — the resumed
 #: rerun must converge anyway.
+#: ``obs.export`` wraps the flight recorder's Chrome-trace write
+#: (cylon_tpu/obs/trace.export): injecting there proves a hung or
+#: corrupt trace write surfaces TYPED instead of silently losing the
+#: timeline the operator armed.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
          "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
-         "stream.append", "stream.watermark")
+         "stream.append", "stream.watermark", "obs.export")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
@@ -452,6 +457,15 @@ def hard_kill(site: str) -> None:
     import signal
     from ..utils.logging import log
     log.warning("recovery: injected kill at %s — SIGKILL self", site)
+    try:
+        # flight-recorder breadcrumb: SIGKILL allows no Python unwind,
+        # so the postmortem dump (obs/trace, armed runs only) is written
+        # HERE — the one place the process still runs — landing next to
+        # the checkpoint manifests like the drain-path dump does
+        from ..obs import trace
+        trace.postmortem(f"injected kill at {site}")
+    except Exception:  # noqa: BLE001 — the kill must proceed regardless
+        pass
     os.kill(os.getpid(), signal.SIGKILL)
 
 
@@ -596,6 +610,7 @@ def _ns_consensus(mesh: Mesh | None, payload: int, base: int,
     upstream; this layer is defense-in-depth, not the primary fence."""
     ns = _session_ns()
     agreed = _consensus_wire(mesh, ns * base + int(payload))
+    _trace.instant("consensus." + what, wire=int(agreed))
     if agreed // base != ns:
         raise RankDesyncError(
             f"cross-session consensus collision at {what}: this rank "

@@ -82,6 +82,8 @@ import threading
 import time
 
 from .. import config
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..status import InvalidError, ResumableAbort
 from .session import DONE, FAILED, PENDING, RUNNING, QuerySession
 
@@ -300,6 +302,9 @@ class QueryScheduler:
 
     def _loop(self) -> None:
         while True:
+            # periodic metrics snapshot for the GKE deploy
+            # (CYLON_TPU_METRICS_JSON) — one list load when unarmed
+            _metrics.maybe_write_snapshot()
             if self._draining():
                 # preemption grace (exec/preempt): a SIGTERM arrived
                 # with checkpointing armed — drain the whole box.  No
@@ -360,6 +365,7 @@ class QueryScheduler:
                 token=token)
             s.finished_s = time.perf_counter()
             self._preempt_drained += 1
+            _metrics.counter("sched_preempt_drained").inc()
 
     # -- admission ---------------------------------------------------------
     def _budget(self) -> int:
@@ -411,6 +417,7 @@ class QueryScheduler:
         evicted = memory.ledger().evict_n(want)
         if evicted:
             self._scheduler_evictions += len(evicted)
+            _metrics.counter("sched_evictions").inc(len(evicted))
             from ..utils.logging import log
             log.info("scheduler: evicted %s to admit session %s "
                      "(footprint %d B)", evicted, sess.name,
@@ -451,6 +458,7 @@ class QueryScheduler:
         pend = [s for s in self.sessions if s.state == PENDING]
         cand = min(pend, key=self._key)
         self._forced_admissions += 1
+        _metrics.counter("sched_forced_admissions").inc()
         from ..utils.logging import log
         log.warning("scheduler: nothing running and session %s "
                     "(footprint %d B) cannot fit the budget — force-"
@@ -526,6 +534,10 @@ class QueryScheduler:
         # (utils/timing scope exclusion — the no-bleed invariant)
         from ..utils import timing
         timing.exclude_from_scope(sess._slice_t0 - t_park)
+        # baton handoff on the trace timeline: the park span (session-
+        # tagged via the active attribution scope) shows exactly where a
+        # tenant waited while its async device work kept running
+        _trace.complete("sched.park", t_park, session=sess.name)
         if self._abort:
             raise ExecutionError(
                 f"serving scheduler aborted while session {sess.name} "
@@ -557,6 +569,8 @@ class QueryScheduler:
 
     def _grant_slice(self, sess: QuerySession) -> None:
         self._control.clear()
+        _trace.instant("sched.grant", session=sess.name,
+                       policy=self.policy)
         sess._grant.set()
         while not self._control.wait(timeout=60.0):
             t = sess._thread

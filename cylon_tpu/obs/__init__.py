@@ -1,0 +1,30 @@
+"""Unified observability subsystem: metrics registry, trace timeline /
+flight recorder, and per-rank skew reporting.
+
+Three cooperating pieces (docs/observability.md):
+
+* :mod:`cylon_tpu.obs.metrics` — typed counters/gauges/histograms
+  behind one facade; the exec modules' former ``_STATS`` dicts and
+  utils/timing's byte/event attribution live here now, with a
+  Prometheus text writer and periodic JSON snapshots for the GKE
+  deploy, plus the shared :func:`~cylon_tpu.obs.metrics.bench_detail`
+  collector the bench scripts report through.
+* :mod:`cylon_tpu.obs.trace` — a bounded ring of span/instant events
+  (``CYLON_TPU_TRACE=path``) exported as Chrome-trace/Perfetto JSON,
+  with a last-N postmortem dump on drains, final-rung aborts and
+  injected kills.
+* :mod:`cylon_tpu.obs.rank_report` — an explicitly-armed end-of-run
+  allgather of each rank's phase table, reduced to a min/median/max
+  skew report (``CYLON_TPU_RANK_REPORT=1``).
+
+Overhead contract: with nothing armed, the whole subsystem costs one
+extra list load per timed region and one per scheduler loop — zero
+collectives, zero host syncs, zero filesystem writes (asserted in
+tests/test_obs.py).  Module-level ad-hoc counter dicts outside this
+package are a lint finding (TS112, docs/trace_safety.md).
+"""
+
+from . import metrics, rank_report, trace  # noqa: F401
+from .metrics import (bench_detail, counter, gauge,  # noqa: F401
+                      histogram, maybe_write_snapshot, prometheus_text,
+                      snapshot, write_prometheus, write_snapshot)

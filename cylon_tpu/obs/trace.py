@@ -1,0 +1,335 @@
+"""Trace timeline / flight recorder — a bounded ring of span + instant
+events, exportable as Chrome-trace (Perfetto) JSON.
+
+What lands in the ring once armed (``CYLON_TPU_TRACE=path`` or
+:func:`arm`):
+
+* every ``utils/timing`` region as a complete span ("X") — the recorder
+  installs itself as timing's trace sink, so the pipelined join's phase
+  regions, the checkpoint/spill regions and the stream regions all
+  appear without their modules knowing about this file;
+* every ``timing.bump``/``add_bytes`` as an instant ("i") — recovery
+  events, consensus outcomes, window closes;
+* per-piece lifecycle from exec/pipeline: a dispatch span per piece and
+  an ASYNC span ("b"/"e", one per piece index) covering dispatch →
+  consume-settle, which is how piece r+1's dispatch visibly overlaps
+  piece r's consume on the Perfetto timeline;
+* serving baton handoffs from exec/scheduler (grant instants, park
+  spans), tagged with the session so per-tenant filtering works.
+
+Every event records the active :func:`~cylon_tpu.utils.timing.
+attribution_scope` tag, so a multi-tenant trace separates per session.
+
+**Postmortem breadcrumb.**  On a preemption-grace drain, a final-rung
+``ResumableAbort`` flush (exec/checkpoint.flush_for_abort) or an
+injected hard kill (exec/recovery.hard_kill), the last-N events dump to
+``TRACE_POSTMORTEM.json`` alongside the checkpoint manifests —
+superseding the single ``last_region()`` string as the crash
+breadcrumb.
+
+**Overhead contract.**  Unarmed: timing pays one extra list load per
+region; nothing else runs, nothing allocates, no file is touched
+(asserted in tests/test_obs.py).  Armed: events are tuples in a
+preallocated ring (capacity ``CYLON_TPU_TRACE_EVENTS``, default 65536);
+export happens once, at :func:`export`/process exit.
+
+A hung or failing trace write surfaces TYPED through the fault
+injector's ``obs.export`` site (exec/recovery) — never a silent loss.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+
+__all__ = ["arm", "disarm", "armed", "recorder", "instant", "complete",
+           "async_begin", "async_end", "export", "postmortem", "autoarm"]
+
+#: the active recorder — one module-global load on every armed() check
+_REC: list = [None]
+
+
+def armed() -> bool:
+    return _REC[0] is not None
+
+
+def recorder() -> "TraceRecorder | None":
+    return _REC[0]
+
+
+class TraceRecorder:
+    """Bounded ring buffer of trace events.
+
+    Events are tuples ``(ts_us, dur_us, ph, name, tid, session, args)``
+    — ``dur_us`` is None for instants, ``ph`` a Chrome-trace phase
+    ("X" complete, "i" instant, "b"/"e" async begin/end), ``args`` a
+    small dict or None.  Timestamps are microseconds relative to the
+    recorder's arming (perf_counter based — monotonic per process)."""
+
+    __slots__ = ("capacity", "path", "t0", "_buf", "_n", "_lock",
+                 "_tids", "_exported")
+
+    def __init__(self, capacity: int = 65536, path: str | None = None):
+        self.capacity = max(int(capacity), 8)
+        self.path = path
+        self.t0 = time.perf_counter()
+        self._buf: list = [None] * self.capacity
+        self._n = 0
+        self._lock = threading.Lock()
+        self._tids: dict[int, tuple[int, str]] = {}
+        self._exported = False
+
+    # -- recording ---------------------------------------------------------
+    def _now_us(self) -> int:
+        return int((time.perf_counter() - self.t0) * 1e6)
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        ent = self._tids.get(ident)
+        if ent is None:
+            with self._lock:
+                ent = self._tids.setdefault(
+                    ident, (len(self._tids),
+                            threading.current_thread().name))
+        return ent[0]
+
+    def _session(self):
+        from ..utils import timing
+        sc = timing._scope()
+        return sc.tag if sc is not None and sc.tag else None
+
+    def _push(self, ev: tuple) -> None:
+        with self._lock:
+            self._buf[self._n % self.capacity] = ev
+            self._n += 1
+
+    def span(self, name: str, t0_s: float, dur_s: float,
+             args: dict | None = None) -> None:
+        """One complete span — timing's region sink calls this with the
+        region's own perf_counter start/duration."""
+        ts = int((t0_s - self.t0) * 1e6)
+        self._push((ts, max(int(dur_s * 1e6), 1), "X", name, self._tid(),
+                    self._session(), args))
+
+    def instant(self, name: str, args: dict | None = None) -> None:
+        self._push((self._now_us(), None, "i", name, self._tid(),
+                    self._session(), args))
+
+    def async_begin(self, name: str, aid: int,
+                    args: dict | None = None) -> None:
+        self._push((self._now_us(), None, "b", name, self._tid(),
+                    self._session(), dict(args or (), id=int(aid))))
+
+    def async_end(self, name: str, aid: int) -> None:
+        self._push((self._now_us(), None, "e", name, self._tid(),
+                    self._session(), {"id": int(aid)}))
+
+    # -- reading -----------------------------------------------------------
+    def events(self, last: int | None = None) -> list[tuple]:
+        """Recorded events oldest-first (ring order preserved across
+        wrap); ``last`` trims to the newest N."""
+        with self._lock:
+            if self._n <= self.capacity:
+                out = [e for e in self._buf[:self._n]]
+            else:
+                cut = self._n % self.capacity
+                out = self._buf[cut:] + self._buf[:cut]
+        return out if last is None else out[-int(last):]
+
+    @property
+    def dropped(self) -> int:
+        """Events that fell off the ring (flight-recorder semantics)."""
+        return max(self._n - self.capacity, 0)
+
+    # -- export ------------------------------------------------------------
+    def _pid(self) -> int:
+        try:
+            import jax
+            return int(jax.process_index())
+        except Exception:  # noqa: BLE001 — no backend: single process
+            return 0
+
+    def chrome_trace(self) -> dict:
+        """The Chrome-trace/Perfetto JSON object for the current ring."""
+        pid = self._pid()
+        events = []
+        for ident, (tid, tname) in sorted(self._tids.items(),
+                                          key=lambda kv: kv[1][0]):
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        for ts, dur, ph, name, tid, sess, args in self.events():
+            ev: dict = {"name": name, "ph": ph, "ts": ts,
+                        "pid": pid, "tid": tid}
+            if ph == "X":
+                ev["dur"] = dur
+            elif ph == "i":
+                ev["s"] = "t"
+            elif ph in ("b", "e"):
+                ev["cat"] = "piece"
+                ev["id"] = (args or {}).get("id", 0)
+            a = dict(args) if args else {}
+            if sess is not None:
+                a["session"] = sess
+            if a:
+                ev["args"] = a
+            events.append(ev)
+        # stable, ts-sorted stream (metadata first at ts implicit 0)
+        events.sort(key=lambda e: e.get("ts", -1))
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped,
+                              "recorded_events": self._n}}
+
+
+def arm(path: str | None = None, capacity: int | None = None
+        ) -> TraceRecorder:
+    """Arm the flight recorder (idempotent — re-arming with the same
+    path returns the live recorder) and install it as utils/timing's
+    trace sink so regions/bumps start landing."""
+    rec = _REC[0]
+    if rec is not None:
+        if path is not None:
+            rec.path = path
+        return rec
+    if capacity is None:
+        capacity = int(os.environ.get("CYLON_TPU_TRACE_EVENTS", "65536"))
+    rec = TraceRecorder(capacity=capacity, path=path)
+    _REC[0] = rec
+    from ..utils import timing
+    timing._TRACE[0] = rec
+    return rec
+
+
+def disarm() -> None:
+    _REC[0] = None
+    from ..utils import timing
+    timing._TRACE[0] = None
+
+
+def autoarm() -> None:
+    """Arm from ``CYLON_TPU_TRACE=path`` (called at package import):
+    registers an atexit export so bench/CI subprocess runs emit their
+    timeline without any explicit call.  No env var: nothing happens."""
+    path = os.environ.get("CYLON_TPU_TRACE")
+    if not path or armed():
+        return
+    arm(path=path)
+    atexit.register(_atexit_export)
+
+
+def _atexit_export() -> None:
+    rec = _REC[0]
+    if rec is not None and rec.path and not rec._exported:
+        try:
+            export()
+        except Exception:  # noqa: BLE001 — exit path: never raise
+            pass
+
+
+# -- module-level conveniences (no-ops unarmed: one list load) -------------
+
+def instant(name: str, **args) -> None:
+    rec = _REC[0]
+    if rec is not None:
+        rec.instant(name, args or None)
+
+
+def complete(name: str, t0_s: float, **args) -> None:
+    """Record a span begun at perf_counter() time ``t0_s``, ending now."""
+    rec = _REC[0]
+    if rec is not None:
+        rec.span(name, t0_s, time.perf_counter() - t0_s, args or None)
+
+
+def async_begin(name: str, aid: int, **args) -> None:
+    rec = _REC[0]
+    if rec is not None:
+        rec.async_begin(name, aid, args or None)
+
+
+def async_end(name: str, aid: int) -> None:
+    rec = _REC[0]
+    if rec is not None:
+        rec.async_end(name, aid)
+
+
+# -- export + postmortem ----------------------------------------------------
+
+def export(path: str | None = None) -> str | None:
+    """Write the Chrome-trace JSON to ``path`` (default: the armed
+    path).  Returns the path written, or None when unarmed/pathless.
+    The write is an injection site (``obs.export``): a simulated hung
+    or corrupt write surfaces TYPED (exec/recovery), and a real OSError
+    is wrapped into :class:`~cylon_tpu.status.ExecutionError` — a trace
+    the operator asked for must never vanish silently."""
+    rec = _REC[0]
+    if rec is None:
+        return None
+    path = path or rec.path
+    if not path:
+        return None
+    from ..exec import recovery
+    recovery.maybe_inject("obs.export")
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rec.chrome_trace(), f)
+        os.replace(tmp, path)
+    except OSError as e:
+        from ..status import ExecutionError
+        raise ExecutionError(
+            f"trace export to {path!r} failed: {e}") from e
+    rec._exported = True
+    return path
+
+
+#: newest events carried in a postmortem dump
+POSTMORTEM_EVENTS = 256
+
+
+def postmortem(reason: str, dir_path: str | None = None,
+               n: int = POSTMORTEM_EVENTS) -> str | None:
+    """Dump the last-``n`` events (+ the last-region breadcrumb and the
+    serving session, when tagged) to ``TRACE_POSTMORTEM.json`` in
+    ``dir_path`` — default: the checkpoint root when armed, else the
+    trace path's directory.  Best-effort by design (it runs on abort
+    paths); returns the path written or None.  Unarmed: nothing."""
+    rec = _REC[0]
+    if rec is None:
+        return None
+    if dir_path is None:
+        from ..exec import checkpoint
+        dir_path = checkpoint.ckpt_dir()
+        if dir_path is None and rec.path:
+            dir_path = os.path.dirname(os.path.abspath(rec.path))
+    if not dir_path:
+        return None
+    from ..utils import timing
+    payload = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "last_region": timing.last_region(),
+        "session": rec._session(),
+        "dropped_events": rec.dropped,
+        "events": [
+            {"ts_us": ts, "dur_us": dur, "ph": ph, "name": name,
+             "tid": tid, "session": sess, "args": args}
+            for ts, dur, ph, name, tid, sess, args in rec.events(last=n)],
+    }
+    # the checkpoint root is SHARED storage in multihost deploys
+    # (deploy/gke): non-zero ranks suffix the filename so concurrent
+    # dumps never clobber rank 0's breadcrumb
+    r = rec._pid()
+    fname = ("TRACE_POSTMORTEM.json" if r == 0
+             else f"TRACE_POSTMORTEM.rank{r}.json")
+    try:
+        os.makedirs(dir_path, exist_ok=True)
+        path = os.path.join(dir_path, fname)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        return path
+    except OSError:
+        return None

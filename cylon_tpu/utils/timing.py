@@ -61,8 +61,20 @@ _ACCUM: dict[str, list] = {}
 _LAST_REGION = [""]
 
 #: per-thread stack of active AttributionScopes (serving sessions run on
-#: their own threads, so thread identity IS session identity here)
+#: their own threads, so thread identity IS session identity here).  The
+#: same TLS carries the thread's cumulative baton-park seconds
+#: (``.excluded``): the PROCESS-GLOBAL table nets a region's own
+#: thread's park time out exactly like the scope table does — a region
+#: spanning a serving yield must not charge co-tenants' slices to the
+#: global phase either (the fair-share no-bleed invariant, now applied
+#: to both tables).
 _SCOPE_TLS = threading.local()
+
+#: the observability trace sink (cylon_tpu.obs.trace installs the armed
+#: flight recorder here): every region exit becomes a timeline span,
+#: every bump/add_bytes an instant.  One list load per region when
+#: unarmed — the trace tier's whole happy-path cost in this module.
+_TRACE: list = [None]
 
 
 class AttributionScope:
@@ -114,14 +126,18 @@ def _scope() -> AttributionScope | None:
 
 def exclude_from_scope(seconds: float) -> None:
     """Mark ``seconds`` of the current thread's wall time as NOT this
-    scope's work — the serving scheduler calls this with the time a
+    thread's work — the serving scheduler calls this with the time a
     session spent parked at the baton, so regions spanning a yield point
     attribute only the tenant's own dispatch time (no co-tenant bleed
-    into phase tables or the fair-share clock).  No-op outside a
-    scope."""
+    into phase tables or the fair-share clock).  Nets out of BOTH the
+    active scope's table and the process-global ``_ACCUM`` table (the
+    global phase seconds previously absorbed co-tenants' slices inside
+    spanning regions)."""
+    s = float(seconds)
+    _SCOPE_TLS.excluded = getattr(_SCOPE_TLS, "excluded", 0.0) + s
     sc = _scope()
     if sc is not None:
-        sc._excluded += float(seconds)
+        sc._excluded += s
 
 
 @contextlib.contextmanager
@@ -152,11 +168,12 @@ def region(name: str, block=None):
         sc.last = name
     else:
         _LAST_REGION[0] = name
-    if not config.BENCH_TIMINGS and sc is None:
+    if not config.BENCH_TIMINGS and sc is None and _TRACE[0] is None:
         yield
         return
     t0 = time.perf_counter()
     ex0 = sc._excluded if sc is not None else 0.0
+    gex0 = getattr(_SCOPE_TLS, "excluded", 0.0)
     try:
         yield
     finally:
@@ -164,14 +181,19 @@ def region(name: str, block=None):
             import jax
             jax.block_until_ready(block)
         dt = time.perf_counter() - t0
+        tr = _TRACE[0]
+        if tr is not None:
+            tr.span(name, t0, dt)
         if config.BENCH_TIMINGS:
+            # baton-park time that fell inside this region's window is
+            # not this THREAD's work (exclude_from_scope); like the
+            # scope table below, the global table nets it out — the
+            # cumulative counters handle nesting correctly
+            gnet = getattr(_SCOPE_TLS, "excluded", 0.0) - gex0
             acc = _ACCUM.setdefault(name, [0.0, 0])
-            acc[0] += dt
+            acc[0] += max(dt - gnet, 0.0)
             acc[1] += 1
         if sc is not None:
-            # baton-park time that fell inside this region's window is
-            # not this tenant's work (exclude_from_scope); the cumulative
-            # counter nets out correctly under nesting
             sc._add(name, max(dt - (sc._excluded - ex0), 0.0))
 
 
@@ -241,36 +263,65 @@ def last_region() -> str:
 def bump(name: str) -> None:
     """Count an event in the phase table without timing it (recovery
     events, exec/recovery): shows up in :func:`snapshot` with s=0 and the
-    occurrence count.  Unconditional — recovery events are rare and must
-    be countable even without ``CYLON_TPU_BENCH``."""
+    occurrence count, mirrored into the metrics registry
+    (``timing_event_<name>``) and — when the flight recorder is armed —
+    the trace timeline.  Unconditional — recovery events are rare and
+    must be countable even without ``CYLON_TPU_BENCH``."""
     acc = _ACCUM.setdefault(name, [0.0, 0])
     acc[1] += 1
+    _EVENT_COUNTS[name] = _EVENT_COUNTS.get(name, 0) + 1
+    tr = _TRACE[0]
+    if tr is not None:
+        tr.instant(name)
     sc = _scope()
     if sc is not None:
         sc._add(name, 0.0)
 
 
+# Registry-backed attribution tables (cylon_tpu.obs.metrics — the typed
+# registry this module's counters migrated onto).  The dict-like views
+# keep every call site verbatim while the values live in (and export
+# from) the registry; the collector hands the phase table itself to
+# metrics.snapshot() / the periodic JSON snapshots.
+from ..obs import metrics as _metrics  # noqa: E402
+
 #: name -> bytes moved, the spill tier's phase attribution: seconds alone
 #: cannot say whether ``spill.upload`` is PCIe-bound or dispatch-bound —
 #: GB/phase does.  Unconditional like bump(): spill traffic must be
 #: attributable even without CYLON_TPU_BENCH.
-_BYTES: dict[str, int] = {}
+_BYTES = _metrics.namespace("timing_bytes")
+
+#: bump() occurrence counts, registry-visible for Prometheus exposition
+_EVENT_COUNTS = _metrics.namespace("timing_event")
+
+_metrics.register_collector(lambda: {"phases": snapshot()})
 
 
 def add_bytes(name: str, nbytes: int) -> None:
     """Attribute ``nbytes`` of host↔device traffic to a named phase
     (exec/memory spill/evict/upload); appears as ``b`` in
-    :func:`snapshot` entries."""
+    :func:`snapshot` entries and as ``timing_bytes_<name>`` in the
+    metrics registry."""
     _BYTES[name] = _BYTES.get(name, 0) + int(nbytes)
     _ACCUM.setdefault(name, [0.0, 0])
+    tr = _TRACE[0]
+    if tr is not None:
+        tr.instant(name, {"bytes": int(nbytes)})
     sc = _scope()
     if sc is not None:
         sc._add_bytes(name, nbytes)
 
 
 def reset() -> None:
+    """Zero the phase table, byte/event attribution AND the last-region
+    breadcrumb (a fresh profile must not inherit the previous
+    workload's final phase as its crash breadcrumb) plus the thread's
+    park-exclusion accumulator."""
     _ACCUM.clear()
     _BYTES.clear()
+    _EVENT_COUNTS.clear()
+    _LAST_REGION[0] = ""
+    _SCOPE_TLS.excluded = 0.0
 
 
 def snapshot() -> dict:

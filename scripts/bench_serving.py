@@ -114,9 +114,13 @@ def _make_qpipe(env, dfs):
     return qpipe
 
 
-def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record):
+def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record, hist=None):
     """Closed loop: cycle the mix for ``queries`` iterations, recording
-    (query, latency, sha) into ``record`` as each completes."""
+    (query, latency, sha) into ``record`` as each completes.  ``hist``
+    (concurrent pass only) is the tenant's streaming latency histogram
+    in the metrics registry (cylon_tpu.obs) — the SLO-attainment
+    source, bit-consistent with the sorted-list quantiles by the
+    histogram's exact-sample contract."""
     def fn():
         for k in range(queries):
             qname = mix[k % len(mix)]
@@ -125,8 +129,10 @@ def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record):
                 else qfuncs[qname](dfs, env=env)
             if hasattr(out, "to_pandas"):
                 out = out.to_pandas()
-            record.append({"q": qname,
-                           "latency_s": time.perf_counter() - t0,
+            lat = time.perf_counter() - t0
+            if hist is not None:
+                hist.observe(lat)
+            record.append({"q": qname, "latency_s": lat,
                            "sha": _result_sha(out)})
         return len(record)
     return fn
@@ -139,14 +145,16 @@ def _percentile(xs, p):
 
 def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
                 policy: str = "fair", budget_mb=None, world: int = 4,
-                seed: int = 0) -> dict:
+                seed: int = 0, slo_ms: float | None = None) -> dict:
     """Drive the bench in-process and return the report dict (the CLI
     wraps this; tests call it directly with trimmed parameters).
     ``budget_mb``: None = unlimited (no pressure), "auto" = ~2.2 tenant
-    footprints (the acceptance configuration), or explicit MiB."""
+    footprints (the acceptance configuration), or explicit MiB.
+    ``slo_ms``: per-query latency SLO target — each tenant's report
+    then carries its attainment fraction from the latency histogram."""
     import jax
     import cylon_tpu as ct
-    from cylon_tpu import config, tpch
+    from cylon_tpu import config, obs, tpch
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
     from cylon_tpu.exec import checkpoint, memory, recovery
     from cylon_tpu.exec.scheduler import (QueryScheduler,
@@ -209,11 +217,14 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
         # the ledger's own allocation-time admission (PieceSource pack)
         # gates on the config budget
         config.HBM_BUDGET_BYTES = ledger_budget
+    obs.metrics.reset("serving_latency")   # fresh histograms per round
     try:
         for p in plans:
             sched.submit(p["name"],
                          _tenant_fn(p["name"], p["mix"], queries, dfs,
-                                    env, qfuncs, records[p["name"]]),
+                                    env, qfuncs, records[p["name"]],
+                                    hist=obs.histogram(
+                                        f"serving_latency_{p['name']}")),
                          footprint_bytes=p["footprint"])
         t0 = time.perf_counter()
         sessions = sched.run()
@@ -246,17 +257,35 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
         rows = sum(sum(row_counts[t] for t in QUERY_TABLES[r["q"]])
                    for r in rec)
         total_rows += rows
+        # SLO quantiles come from the streaming histogram registry
+        # (obs.metrics) — the exact-sample contract makes them
+        # BIT-CONSISTENT with the sorted-list np.percentile this script
+        # used to compute, which the assert pins (acceptance criterion)
+        hist = obs.histogram(f"serving_latency_{s.name}")
+        p50, p99 = hist.percentile(50), hist.percentile(99)
+        assert p50 == _percentile(lats, 50) and \
+            p99 == _percentile(lats, 99), \
+            (s.name, p50, p99, _percentile(lats, 50), _percentile(lats, 99))
         per_tenant[s.name] = {
             "mix": list(next(p["mix"] for p in plans
                              if p["name"] == s.name)),
             "queries": len(rec),
-            "p50_latency_s": round(_percentile(lats, 50) or 0, 4),
-            "p99_latency_s": round(_percentile(lats, 99) or 0, 4),
+            "p50_latency_s": round(p50 or 0, 4),
+            "p99_latency_s": round(p99 or 0, 4),
+            **({"slo_target_s": slo_ms / 1e3,
+                "slo_attainment": round(
+                    hist.attainment(slo_ms / 1e3) or 0.0, 4)}
+               if slo_ms is not None else {}),
             **{k: v for k, v in s.summary().items()
                if k not in ("name", "tenant", "state")},
         }
 
-    mem = memory.stats()
+    # recovery events + spill counters through the shared collector
+    # (cylon_tpu.obs.bench_detail — same keys the report always carried)
+    bd = obs.bench_detail(
+        spill_keys=("spill_events", "bytes_spilled", "readmit_events",
+                    "cross_session_evictions", "peak_ledger_bytes"),
+        ckpt_keys=())
     report = {
         "metric": f"TPC-H SF{scale:g} serving mix, {tenants} tenants "
                   f"x {queries} queries ({policy})",
@@ -277,10 +306,9 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
             "bit_equal": bit_equal,
             "failures": failures,
             "scheduler": sched.stats(),
-            "spill": {k: mem[k] for k in
-                      ("spill_events", "bytes_spilled", "readmit_events",
-                       "cross_session_evictions", "peak_ledger_bytes")},
-            "recovery_events": recovery.drain_events(),
+            "spill": {k: v for k, v in bd.items()
+                      if k != "recovery_events"},
+            "recovery_events": bd["recovery_events"],
             "tenants": per_tenant,
         },
     }
@@ -299,6 +327,10 @@ def main() -> int:
                     help='"auto" (acceptance pressure), "none", or MiB')
     ap.add_argument("--world", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="per-query latency SLO target (ms): per-tenant "
+                         "attainment is reported from the latency "
+                         "histogram registry (docs/observability.md)")
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "SERVING_r01.json"))
     args = ap.parse_args()
@@ -307,7 +339,7 @@ def main() -> int:
     report = run_serving(tenants=args.tenants, queries=args.queries,
                          scale=args.scale, policy=args.policy,
                          budget_mb=budget, world=args.world,
-                         seed=args.seed)
+                         seed=args.seed, slo_ms=args.slo_ms)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     d = report["detail"]

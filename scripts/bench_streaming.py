@@ -104,7 +104,7 @@ def run(args) -> dict:
     import pandas as pd
 
     import cylon_tpu as ct
-    from cylon_tpu import tpch
+    from cylon_tpu import obs, tpch
     from cylon_tpu.ctx.context import CPUMeshConfig
     from cylon_tpu.exec import memory
     from cylon_tpu.exec.scheduler import QueryScheduler
@@ -214,7 +214,6 @@ def run(args) -> dict:
         if len(g) != len(e) or sha(g[e.columns].astype(e.dtypes)) != sha(e):
             windows_equal = False
 
-    mem = memory.stats()
     total_rows = sum(len(b["k"]) for b in batches)
     wall = metrics.get("ingest_wall_s", 1e-9)
     detail = {
@@ -231,8 +230,12 @@ def run(args) -> dict:
         "watermark_lag_max": max(wm_lag) if wm_lag else 0,
         "windows_closed": wj.windows_closed,
         "late_dropped": wj.late_dropped,
-        "window_evictions": mem["window_evictions"],
-        "bytes_spilled": mem["bytes_spilled"],
+        # spill-tier counters through the shared collector
+        # (cylon_tpu.obs.bench_detail — same keys as the hand-rolled
+        # block it replaces)
+        **obs.bench_detail(spill_keys=("window_evictions",
+                                       "bytes_spilled"),
+                           ckpt_keys=(), events=None),
         "ledger_delta_bytes": memory.balance() - ledger_before,
         "bit_equal": bool(bit_equal),
         "windows_bit_equal": bool(windows_equal),

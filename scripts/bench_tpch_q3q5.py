@@ -38,7 +38,7 @@ def main():
 
     import jax
     import cylon_tpu as ct
-    from cylon_tpu import tpch
+    from cylon_tpu import obs, tpch
     from cylon_tpu.ctx.context import CPUMeshConfig, TPUConfig
     from cylon_tpu.exec import checkpoint, memory, recovery
 
@@ -76,19 +76,13 @@ def main():
         "unit": "seconds",
         "detail": {"world": env.world_size, "platform": devs[0].platform,
                    "scale": scale,
-                   # happy path vs post-degradation (docs/robustness.md)
-                   "recovery_events": recovery.drain_events(),
-                   # resident vs host-spilled state (exec/memory)
-                   **{k: v for k, v in memory.stats().items() if k in
-                      ("spill_events", "bytes_spilled",
-                       "peak_ledger_bytes")},
-                   # durable-checkpoint traffic (exec/checkpoint);
-                   # mismatch vs resharded distinguishes an elastic
-                   # re-shard from a thrown-away checkpoint
-                   **{k: v for k, v in checkpoint.stats().items() if k in
-                      ("checkpoint_events", "bytes_checkpointed",
-                       "resume_fast_forwarded_pieces",
-                       "resume_resharded_pieces", "resume_world_mismatch")},
+                   # recovery + spill + checkpoint counters through the
+                   # shared collector (cylon_tpu.obs.bench_detail):
+                   # happy path vs post-degradation, resident vs
+                   # host-spilled, re-shard vs thrown-away checkpoint
+                   **obs.bench_detail(spill_keys=(
+                       "spill_events", "bytes_spilled",
+                       "peak_ledger_bytes")),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

@@ -254,6 +254,10 @@ def run_stream(args) -> int:
                 f"stream kill ({faults!r}) did not crash the process "
                 f"(rc={p.returncode})")
             continue
+        if not os.path.exists(os.path.join(killdir,
+                                           "TRACE_POSTMORTEM.json")):
+            failures.append(f"stream kill ({faults!r}) left no "
+                            "TRACE_POSTMORTEM.json breadcrumb")
         p2, info2 = _spawn(args, killdir, "", resume=True, stream=True)
         if p2.returncode != 0 or not info2:
             failures.append(f"stream resume ({faults!r}) failed "
@@ -412,6 +416,10 @@ def run_elastic(args) -> int:
             failures.append(f"{tag}: kill schedule did not crash "
                             f"(rc={p1.returncode})")
             return False
+        if not os.path.exists(os.path.join(workdir,
+                                           "TRACE_POSTMORTEM.json")):
+            failures.append(f"{tag}: killed child left no "
+                            "TRACE_POSTMORTEM.json breadcrumb")
         return True
 
     # A: ckpt at world=2, SIGKILL mid-stage-2 → resume at world=1:
@@ -485,6 +493,9 @@ def run_elastic(args) -> int:
                         f"{(p1.stdout + p1.stderr)[-1500:]}")
     elif not info1 or not info1.get("checkpoint_events"):
         failures.append(f"E: grace drain committed nothing: {info1}")
+    elif not os.path.exists(os.path.join(dE, "TRACE_POSTMORTEM.json")):
+        failures.append("E: grace drain left no TRACE_POSTMORTEM.json "
+                        "breadcrumb beside the manifests")
     else:
         print(f"# elastic E drain -> ok (committed="
               f"{info1['checkpoint_events']})", flush=True)
@@ -628,6 +639,10 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["CYLON_TPU_FAULTS"] = faults
     env["CYLON_TPU_CKPT_DIR"] = workdir
+    # arm the flight recorder (cylon_tpu/obs/trace): a killed or drained
+    # child leaves TRACE_POSTMORTEM.json next to its manifests — the
+    # crash breadcrumb the schedules assert below
+    env["CYLON_TPU_TRACE"] = os.path.join(workdir, "trace.json")
     env.update(extra_env or {})
     if resume:
         env["CYLON_TPU_RESUME"] = "1"
@@ -674,6 +689,12 @@ def _run_schedule(args, idx: int, sched: dict, baseline_sha: str,
             fail(f"unbounded retries: {info['events']} recovery events", p)
     elif p.returncode == -9 or p.returncode == RESUMABLE_EXIT:
         outcome = "killed" if p.returncode == -9 else "resumable"
+        if not os.path.exists(os.path.join(workdir,
+                                           "TRACE_POSTMORTEM.json")):
+            # the injected kill dumps the flight recorder BEFORE the
+            # SIGKILL; a ResumableAbort dumps at its flush — either way
+            # the breadcrumb must land next to the manifests
+            fail("no TRACE_POSTMORTEM.json breadcrumb after kill/abort", p)
         p2, info2 = _spawn(args, workdir, sched.get("resume_faults", ""),
                            resume=True, extra_env=sched.get("env"))
         if p2.returncode != 0:

@@ -1,0 +1,27 @@
+"""TS112 fixture: module-level mutable counter tables outside
+cylon_tpu/obs/ — each must route through the metrics registry facade
+(cylon_tpu.obs.metrics counter/group/namespace)."""
+
+# the classic ad-hoc stats table — flagged
+_STATS = {"spill_events": 0, "bytes_spilled": 0}
+
+# other counter-table spellings — flagged
+_EVICTION_COUNTERS = {"cold": 0, "hot": 0}
+QUERY_METRICS = dict(served=0, failed=0)
+
+# NOT flagged: name does not read as a counter table
+_CACHE = {"a": 1}
+
+# NOT flagged: registry-backed view (the sanctioned migration shim) —
+# the rule keys on the mutable literal, not the name alone
+import sys  # noqa: E402 — stand-in binding, fixtures never import cylon_tpu
+
+_RESUME_STATS = sys.intern("not-a-dict-literal")
+
+
+def bump():
+    # NOT flagged: function-local tables are transient working state,
+    # not module-lifetime telemetry
+    local_stats = {"n": 0}
+    local_stats["n"] += 1
+    return local_stats

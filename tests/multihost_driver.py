@@ -236,6 +236,35 @@ assert len({int(s) for s in sigs}) == 1, (seq, sigs)
 recovery.install_faults("")
 print(f"SPILL_OK pid={pid} evictions={seq}", flush=True)
 
+# Per-rank phase skew report (cylon_tpu/obs/rank_report, docs/
+# observability.md): each rank times the same pipelined join, then the
+# ARMED report allgathers every rank's phase table and reduces to
+# min/median/max per phase.  The cross-check: the gathered matrix is the
+# same on every rank, so the REPORT must be byte-identical across ranks
+# (crc allgather) — and a rank timing a structurally different program
+# would have surfaced as the report's typed name-set desync instead.
+import json as _json
+
+from cylon_tpu import config as _config, obs
+
+_prev_bench = _config.BENCH_TIMINGS
+_config.BENCH_TIMINGS = True
+from cylon_tpu.utils import timing as _timing
+_timing.reset()
+pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=4)
+_config.BENCH_TIMINGS = _prev_bench
+obs.rank_report.arm()
+rep = obs.rank_report.report()
+obs.rank_report.arm(False)
+assert rep["ranks"] == nproc, rep
+assert "pipe.piece_join" in rep["phases"], sorted(rep["phases"])
+for ent in rep["phases"].values():
+    assert ent["min_s"] <= ent["median_s"] <= ent["max_s"], ent
+rep_sig = np.int64(zlib.crc32(_json.dumps(rep, sort_keys=True).encode()))
+rep_sigs = np.atleast_1d(multihost_utils.process_allgather(rep_sig))
+assert len({int(s) for s in rep_sigs}) == 1, (rep, rep_sigs)
+print(f"RANKREPORT_OK pid={pid} phases={len(rep['phases'])}", flush=True)
+
 # Streaming window-close determinism (cylon_tpu/stream, docs/
 # streaming.md): both processes ingest the same seeded micro-batches
 # into a TumblingWindowJoin; the watermark min-vote

@@ -1,0 +1,573 @@
+"""Tests for the unified observability subsystem (cylon_tpu.obs).
+
+Fast tests (tier-1): metrics registry semantics (typed metrics, the
+group/namespace migration shims, Prometheus exposition, JSON
+snapshots), histogram quantiles bit-consistent with np.percentile (the
+serving SLO acceptance), the shared bench_detail collector's key-schema
+stability, flight-recorder ring wrap + postmortem content + session
+tagging, the obs.export injection site surfacing typed, the
+zero-overhead/zero-write unarmed contract, and the utils/timing edge
+cases (reset clears the last-region breadcrumb, baton-park netting in
+BOTH tables across nesting, sync_region/split_snapshot round-trip).
+
+Slow tests: scripts/bench_smoke.py driven in a subprocess with
+``CYLON_TPU_TRACE`` armed, validating the emitted Chrome-trace JSON
+schema (pid/tid presence, ts monotonicity, per-piece dispatch spans,
+balanced async in-flight pairs).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import config, obs
+from cylon_tpu.obs import metrics, rank_report, trace
+from cylon_tpu.status import (ExecutionError, InvalidError,
+                              PredictedResourceExhausted)
+from cylon_tpu.utils import timing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every test starts with the recorder disarmed, a fresh phase
+    table, bench-mode flags restored and no armed injector."""
+    from cylon_tpu.exec import recovery
+    monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+    monkeypatch.delenv("CYLON_TPU_METRICS_JSON", raising=False)
+    monkeypatch.delenv("CYLON_TPU_RANK_REPORT", raising=False)
+    prev_bench, prev_async = config.BENCH_TIMINGS, config.TIMING_ASYNC
+    trace.disarm()
+    timing.reset()
+    metrics._rearm_snapshots()
+    recovery.install_faults("")
+    yield
+    trace.disarm()
+    timing.reset()
+    metrics._rearm_snapshots()
+    recovery.install_faults("")
+    config.BENCH_TIMINGS, config.TIMING_ASYNC = prev_bench, prev_async
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_roundtrip(self):
+        c = metrics.counter("t_reg_c")
+        c.inc()
+        c.inc(4)
+        assert metrics.counter("t_reg_c").value == 5
+        g = metrics.gauge("t_reg_g")
+        g.set(17)
+        assert g.value == 17
+        live = metrics.gauge("t_reg_live", fn=lambda: 42)
+        assert live.value == 42
+
+    def test_type_conflict_is_typed(self):
+        metrics.counter("t_reg_conflict")
+        with pytest.raises(InvalidError):
+            metrics.gauge("t_reg_conflict")
+
+    def test_group_is_dict_like_and_registry_backed(self):
+        st = metrics.group("t_grp", ("a_events", "b_bytes"))
+        st["a_events"] += 3
+        st["b_bytes"] += 100
+        assert dict(st) == {"a_events": 3, "b_bytes": 100}
+        # the values live in the registry, not the view
+        assert metrics.counter("t_grp_a_events").value == 3
+        for k in st:
+            st[k] = 0
+        assert dict(st) == {"a_events": 0, "b_bytes": 0}
+
+    def test_namespace_dynamic_keys(self):
+        ns = metrics.namespace("t_ns")
+        ns["x"] = ns.get("x", 0) + 7
+        assert ns["x"] == 7 and ns.get("zzz") is None
+        assert metrics.counter("t_ns_x").value == 7
+        ns.clear()
+        assert "x" not in ns
+        assert metrics.counter("t_ns_x").value == 0
+
+    def test_reset_prefix(self):
+        metrics.counter("t_rst_one").inc(5)
+        metrics.counter("other_t_rst").inc(5)
+        metrics.reset("t_rst")
+        assert metrics.counter("t_rst_one").value == 0
+        assert metrics.counter("other_t_rst").value == 5
+
+    def test_exec_stats_shims_are_registry_backed(self):
+        from cylon_tpu.exec import checkpoint, memory
+        checkpoint.reset_stats()
+        memory.reset_stats()
+        checkpoint._STATS["checkpoint_events"] += 2
+        memory._STATS["spill_events"] += 1
+        assert checkpoint.stats()["checkpoint_events"] == 2
+        assert metrics.counter("ckpt_checkpoint_events").value == 2
+        assert metrics.counter("memory_spill_events").value == 1
+        checkpoint.reset_stats()
+        memory.reset_stats()
+        assert metrics.counter("ckpt_checkpoint_events").value == 0
+        assert metrics.counter("memory_spill_events").value == 0
+
+
+class TestHistogram:
+    def test_percentiles_bit_consistent_with_sorted_list(self):
+        """The serving-bench acceptance: histogram p50/p99 must equal
+        np.percentile over the same observations EXACTLY."""
+        h = metrics.histogram("t_hist_exact")
+        h.reset()
+        rng = np.random.default_rng(3)
+        xs = list(rng.gamma(2.0, 0.05, 499))
+        for x in xs:
+            h.observe(x)
+        arr = np.asarray(xs, float)
+        for p in (50, 90, 99, 99.9):
+            assert h.percentile(p) == float(np.percentile(arr, p)), p
+
+    def test_bucket_counts_and_attainment(self):
+        h = metrics.histogram("t_hist_buckets", buckets=(0.1, 1.0, 10.0))
+        for x in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(x)
+        assert sum(h.bucket_counts) == h.count == 5
+        assert h.attainment(1.0) == 3 / 5
+        assert h.attainment(0.01) == 0.0
+        assert metrics.histogram("t_hist_buckets").value["count"] == 5
+
+    def test_truncated_falls_back_to_buckets(self, monkeypatch):
+        monkeypatch.setattr(metrics, "SAMPLE_CAP", 8)
+        h = metrics.Histogram("t_hist_trunc")
+        for x in np.linspace(0.01, 0.3, 40):
+            h.observe(x)
+        assert h.truncated
+        p = h.percentile(50)
+        assert p is not None and 0.0 < p < 1.0
+
+
+class TestExposition:
+    def test_prometheus_text_format(self):
+        metrics.counter("t_prom_c").set(9)
+        metrics.gauge("t_prom_g").set(3)
+        h = metrics.histogram("t_prom_h", buckets=(1.0, 2.0))
+        h.reset()
+        h.observe(0.5)
+        h.observe(1.5)
+        text = metrics.prometheus_text()
+        assert "# TYPE cylon_tpu_t_prom_c counter" in text
+        assert "cylon_tpu_t_prom_c 9" in text
+        assert "cylon_tpu_t_prom_g 3" in text
+        assert 'cylon_tpu_t_prom_h_bucket{le="1"} 1' in text
+        assert 'cylon_tpu_t_prom_h_bucket{le="2"} 2' in text
+        assert 'cylon_tpu_t_prom_h_bucket{le="+Inf"} 2' in text
+        assert "cylon_tpu_t_prom_h_count 2" in text
+        # name sanitization: dots become underscores
+        metrics.counter("t.prom.dotted").inc()
+        assert "cylon_tpu_t_prom_dotted 1" in metrics.prometheus_text()
+
+    def test_snapshot_carries_phase_collector(self):
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        with timing.region("t.snapcol"):
+            pass
+        snap = metrics.snapshot()
+        assert "t.snapcol" in snap["phases"]
+
+    def test_json_snapshot_write_and_poll(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "metrics.json")
+        metrics.write_snapshot(path)
+        doc = json.load(open(path, encoding="utf-8"))
+        assert "ts" in doc and isinstance(doc["metrics"], dict)
+        os.unlink(path)
+        # armed poll: first call writes, second call inside the interval
+        # does not
+        monkeypatch.setenv("CYLON_TPU_METRICS_JSON", path)
+        monkeypatch.setenv("CYLON_TPU_METRICS_INTERVAL_S", "3600")
+        metrics._rearm_snapshots()
+        assert metrics.maybe_write_snapshot() is True
+        assert os.path.exists(path)
+        os.unlink(path)
+        assert metrics.maybe_write_snapshot() is False
+        assert not os.path.exists(path)
+
+    def test_unarmed_poll_writes_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        metrics._rearm_snapshots()
+        assert metrics.maybe_write_snapshot() is False
+        assert os.listdir(tmp_path) == []
+
+
+class TestBenchDetail:
+    """The dedupe satellite's schema guarantee: the shared collector
+    reports EXACTLY the keys each bench script always carried."""
+
+    def test_default_selection_matches_bench_py(self):
+        bd = obs.bench_detail()
+        assert set(bd) == {
+            "recovery_events",
+            "spill_events", "bytes_spilled", "peak_ledger_bytes",
+            "donated_bytes_reused",
+            "checkpoint_events", "bytes_checkpointed",
+            "resume_fast_forwarded_pieces", "resume_resharded_pieces",
+            "resume_world_mismatch"}
+        assert isinstance(bd["recovery_events"], list)
+
+    def test_q3q5_selection(self):
+        bd = obs.bench_detail(spill_keys=("spill_events", "bytes_spilled",
+                                          "peak_ledger_bytes"))
+        assert set(bd) == {
+            "recovery_events", "spill_events", "bytes_spilled",
+            "peak_ledger_bytes",
+            "checkpoint_events", "bytes_checkpointed",
+            "resume_fast_forwarded_pieces", "resume_resharded_pieces",
+            "resume_world_mismatch"}
+
+    def test_serving_selection(self):
+        bd = obs.bench_detail(
+            spill_keys=("spill_events", "bytes_spilled", "readmit_events",
+                        "cross_session_evictions", "peak_ledger_bytes"),
+            ckpt_keys=())
+        assert set(bd) == {
+            "recovery_events", "spill_events", "bytes_spilled",
+            "readmit_events", "cross_session_evictions",
+            "peak_ledger_bytes"}
+
+    def test_streaming_selection_no_events(self):
+        bd = obs.bench_detail(spill_keys=("window_evictions",
+                                          "bytes_spilled"),
+                              ckpt_keys=(), events=None)
+        assert set(bd) == {"window_evictions", "bytes_spilled"}
+
+    def test_drain_vs_keep(self):
+        from cylon_tpu.exec import recovery
+        recovery.reset_events()
+        recovery._record("t.site", "predicted", "retry")
+        kept = obs.bench_detail(events="keep")["recovery_events"]
+        assert len(kept) == 1
+        drained = obs.bench_detail()["recovery_events"]
+        assert len(drained) == 1
+        assert obs.bench_detail()["recovery_events"] == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestTraceRecorder:
+    def test_regions_and_bumps_land_without_bench_flag(self, tmp_path):
+        """Arming the recorder alone makes regions record — the trace
+        tier must not require CYLON_TPU_BENCH."""
+        assert not config.BENCH_TIMINGS
+        path = str(tmp_path / "tr.json")
+        trace.arm(path=path, capacity=64)
+        with timing.region("t.span"):
+            time.sleep(0.001)
+        timing.bump("t.instant")
+        timing.add_bytes("t.bytes", 128)
+        out = trace.export()
+        doc = json.load(open(out, encoding="utf-8"))
+        by_name = {}
+        for e in doc["traceEvents"]:
+            by_name.setdefault(e["name"], []).append(e)
+        assert by_name["t.span"][0]["ph"] == "X"
+        assert by_name["t.span"][0]["dur"] >= 1
+        assert by_name["t.instant"][0]["ph"] == "i"
+        assert by_name["t.bytes"][0]["args"]["bytes"] == 128
+        # ...and the global phase table stayed EMPTY (timings off)
+        assert "t.span" not in timing.snapshot()
+
+    def test_ring_wrap_keeps_newest(self):
+        rec = trace.arm(capacity=8)
+        for i in range(20):
+            rec.instant(f"ev{i}")
+        evs = rec.events()
+        assert len(evs) == 8
+        assert [e[3] for e in evs] == [f"ev{i}" for i in range(12, 20)]
+        assert rec.dropped == 12
+
+    def test_ts_monotone_and_ids_present(self, tmp_path):
+        path = str(tmp_path / "tr.json")
+        trace.arm(path=path, capacity=32)
+        for i in range(5):
+            trace.instant(f"t.mono{i}")
+        doc = json.load(open(trace.export(), encoding="utf-8"))
+        tss = [e["ts"] for e in doc["traceEvents"] if "ts" in e]
+        assert tss == sorted(tss)
+        for e in doc["traceEvents"]:
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+
+    def test_session_tagged_spans(self, tmp_path):
+        path = str(tmp_path / "tr.json")
+        trace.arm(path=path, capacity=32)
+        with timing.attribution_scope("tenant_x"):
+            with timing.region("t.sess"):
+                pass
+        doc = json.load(open(trace.export(), encoding="utf-8"))
+        ev = next(e for e in doc["traceEvents"] if e["name"] == "t.sess")
+        assert ev["args"]["session"] == "tenant_x"
+
+    def test_async_pairs(self, tmp_path):
+        path = str(tmp_path / "tr.json")
+        trace.arm(path=path, capacity=32)
+        trace.async_begin("t.piece", 3, piece=3)
+        trace.async_end("t.piece", 3)
+        doc = json.load(open(trace.export(), encoding="utf-8"))
+        pair = [e for e in doc["traceEvents"] if e["name"] == "t.piece"]
+        assert [e["ph"] for e in pair] == ["b", "e"]
+        assert all(e["id"] == 3 and e["cat"] == "piece" for e in pair)
+
+    def test_postmortem_dump_content(self, tmp_path):
+        trace.arm(capacity=16)
+        for i in range(20):
+            timing.bump(f"t.pm{i}")
+        with timing.region("t.last"):
+            pass
+        out = trace.postmortem("unit test", dir_path=str(tmp_path), n=8)
+        doc = json.load(open(out, encoding="utf-8"))
+        assert doc["reason"] == "unit test"
+        assert doc["pid"] == os.getpid()
+        assert len(doc["events"]) == 8
+        assert doc["events"][-1]["name"] == "t.last"
+        assert doc["dropped_events"] > 0
+
+    def test_flush_for_abort_writes_postmortem(self, tmp_path,
+                                               monkeypatch):
+        """The drain/final-rung flush drops the breadcrumb next to the
+        manifests — superseding the single last_region() string."""
+        from cylon_tpu.exec import checkpoint
+        ckdir = str(tmp_path / "ckpt")
+        monkeypatch.setenv("CYLON_TPU_CKPT_DIR", ckdir)
+        trace.arm(capacity=16)
+        timing.bump("t.pre_abort")
+        checkpoint.flush_for_abort("unit")
+        doc = json.load(open(os.path.join(ckdir, "TRACE_POSTMORTEM.json"),
+                             encoding="utf-8"))
+        assert any(e["name"] == "t.pre_abort" for e in doc["events"])
+        assert doc["reason"] == "abort flush: unit"
+
+    def test_export_injection_surfaces_typed(self, tmp_path):
+        from cylon_tpu.exec import recovery
+        trace.arm(path=str(tmp_path / "tr.json"), capacity=16)
+        recovery.install_faults("obs.export::1=predicted")
+        with pytest.raises(PredictedResourceExhausted):
+            trace.export()
+        recovery.install_faults("")
+        assert trace.export() is not None   # recovers once disarmed
+
+    def test_export_oserror_surfaces_typed(self, tmp_path):
+        trace.arm(capacity=16)
+        missing = str(tmp_path / "no" / "such" / "dir" / "tr.json")
+        with pytest.raises(ExecutionError):
+            trace.export(missing)
+
+
+class TestUnarmedContract:
+    """The happy-path acceptance: with nothing armed, zero filesystem
+    writes and no recording — the same no-op style the checkpoint
+    tier's unarmed assertions use."""
+
+    def test_unarmed_records_and_writes_nothing(self, tmp_path,
+                                                monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        assert not trace.armed() and timing._TRACE[0] is None
+        with timing.region("t.off"):
+            pass
+        timing.bump("t.off_bump")
+        trace.instant("t.off_instant")
+        trace.complete("t.off_span", time.perf_counter())
+        assert trace.export() is None
+        assert trace.postmortem("nothing armed") is None
+        assert not rank_report.armed()
+        assert metrics.maybe_write_snapshot() is False
+        assert os.listdir(tmp_path) == []
+
+    def test_autoarm_needs_env(self, monkeypatch):
+        monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+        trace.autoarm()
+        assert not trace.armed()
+        monkeypatch.setenv("CYLON_TPU_TRACE", "/tmp/t.json")
+        trace.autoarm()
+        assert trace.armed()
+        assert trace.recorder().path == "/tmp/t.json"
+
+
+# ---------------------------------------------------------------------------
+# scheduler integration: baton handoffs on the timeline
+# ---------------------------------------------------------------------------
+
+def test_scheduler_baton_events_session_tagged(env4, tmp_path):
+    from cylon_tpu.exec.scheduler import QueryScheduler
+    trace.arm(path=str(tmp_path / "tr.json"), capacity=256)
+    sched = QueryScheduler(env4, policy="fifo")
+    sched.submit("tA", lambda: 1)
+    sched.submit("tB", lambda: 2)
+    sessions = sched.run(raise_errors=True)
+    assert [s.result for s in sessions] == [1, 2]
+    doc = json.load(open(trace.export(), encoding="utf-8"))
+    grants = [e for e in doc["traceEvents"] if e["name"] == "sched.grant"]
+    assert {g["args"]["session"] for g in grants} >= {"tA", "tB"}
+
+
+# ---------------------------------------------------------------------------
+# utils/timing edge cases (the satellite fixes)
+# ---------------------------------------------------------------------------
+
+class TestTimingEdgeCases:
+    def test_reset_clears_last_region(self):
+        with timing.region("t.lastreg"):
+            pass
+        assert timing.last_region() == "t.lastreg"
+        timing.reset()
+        assert timing.last_region() == ""
+
+    def test_park_time_netted_from_global_table(self):
+        """The satellite fix: global phase seconds must not include
+        baton-park time inside spanning regions (the scope table
+        already netted it)."""
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        with timing.region("t.gpark"):
+            time.sleep(0.05)
+            timing.exclude_from_scope(0.05)   # the scheduler's call
+        s = timing.snapshot()["t.gpark"]["s"]
+        assert s < 0.02, s
+        timing.reset()
+        with timing.region("t.gnopark"):
+            time.sleep(0.05)
+        assert timing.snapshot()["t.gnopark"]["s"] >= 0.04
+
+    def test_exclusion_nets_across_nesting_in_both_tables(self):
+        """A park inside the INNER region must net out of inner AND
+        outer, in the scope table and the global table alike."""
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        with timing.attribution_scope("t_nest") as sc:
+            with timing.region("t.outer"):
+                with timing.region("t.inner"):
+                    time.sleep(0.05)
+                    timing.exclude_from_scope(0.05)
+        snap = sc.snapshot()
+        assert snap["t.inner"]["s"] < 0.02, snap
+        assert snap["t.outer"]["s"] < 0.02, snap
+        gsnap = timing.snapshot()
+        assert gsnap["t.inner"]["s"] < 0.02, gsnap
+        assert gsnap["t.outer"]["s"] < 0.02, gsnap
+
+    def test_nested_scopes_are_disjoint(self):
+        """Inner scope shadows: its regions land in the inner table
+        only, and exclusion inside the inner scope does not drain the
+        outer scope's unrelated regions."""
+        timing.reset()
+        with timing.attribution_scope("t_out") as so:
+            with timing.region("t.only_outer"):
+                time.sleep(0.02)
+            with timing.attribution_scope("t_in") as si:
+                with timing.region("t.only_inner"):
+                    time.sleep(0.02)
+                    timing.exclude_from_scope(0.02)
+        assert "t.only_inner" not in so.snapshot()
+        assert "t.only_outer" not in si.snapshot()
+        assert si.snapshot()["t.only_inner"]["s"] < 0.01
+        assert so.snapshot()["t.only_outer"]["s"] >= 0.015
+
+    def test_sync_region_split_snapshot_roundtrip(self):
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        with timing.region("t.phase"):
+            time.sleep(0.002)
+        with timing.sync_region("t.phase"):
+            time.sleep(0.002)
+        # idempotent suffixing: an already-suffixed name stays single
+        with timing.sync_region("t.phase" + timing.BLOCK_SUFFIX):
+            pass
+        snap = timing.snapshot()
+        assert "t.phase" in snap
+        assert "t.phase" + timing.BLOCK_SUFFIX in snap
+        assert "t.phase" + timing.BLOCK_SUFFIX * 2 not in snap
+        dispatch, block = timing.split_snapshot(snap)
+        assert "t.phase" in dispatch and "t.phase" in block
+        assert block["t.phase"] == snap["t.phase.block"]["s"]
+        assert dispatch["t.phase"] == snap["t.phase"]["s"]
+
+
+# ---------------------------------------------------------------------------
+# per-rank report
+# ---------------------------------------------------------------------------
+
+class TestRankReport:
+    def test_unarmed_by_default_armed_by_env(self, monkeypatch):
+        assert not rank_report.armed()
+        monkeypatch.setenv("CYLON_TPU_RANK_REPORT", "1")
+        assert rank_report.armed()
+        monkeypatch.delenv("CYLON_TPU_RANK_REPORT")
+        rank_report.arm()
+        assert rank_report.armed()
+        rank_report.arm(False)
+        assert not rank_report.armed()
+
+    def test_single_process_report_shape(self):
+        config.BENCH_TIMINGS = True
+        timing.reset()
+        with timing.region("t.rank_phase"):
+            time.sleep(0.01)
+        timing.bump("t.rank_bump")     # zero-second phase: skew None
+        rep = rank_report.report()
+        assert rep["ranks"] == 1
+        ent = rep["phases"]["t.rank_phase"]
+        assert ent["min_s"] == ent["median_s"] == ent["max_s"]
+        assert ent["skew"] == 1.0
+        assert rep["phases"]["t.rank_bump"]["skew"] is None
+
+
+# ---------------------------------------------------------------------------
+# slow: the CI schema validation drive (satellite 6)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_smoke_emits_valid_chrome_trace(tmp_path):
+    """Drives scripts/bench_smoke.py with CYLON_TPU_TRACE armed and
+    validates the emitted Chrome-trace JSON: schema fields, ts
+    monotonicity, per-piece dispatch spans, balanced async in-flight
+    pairs — the pipelined-join timeline the overlap scheduler's
+    acceptance reads in Perfetto."""
+    out = str(tmp_path / "smoke_trace.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CYLON_TPU_TRACE=out)
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_smoke.py"),
+         "--rows=16384"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert p.returncode == 0, p.stdout + p.stderr
+    doc = json.load(open(out, encoding="utf-8"))
+    events = doc["traceEvents"]
+    assert events, "empty trace"
+    tss = []
+    for e in events:
+        assert e["ph"] in ("X", "i", "b", "e", "M"), e
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if "ts" in e:
+            tss.append(e["ts"])
+        if e["ph"] == "X":
+            assert e["dur"] >= 1
+    assert tss == sorted(tss), "ts not monotone"
+    names = [e["name"] for e in events]
+    # the pipelined phase spans are on the timeline...
+    for phase in ("pipe.build_sort", "pipe.piece_join", "pipe.consume"):
+        assert phase in names, phase
+    # ...with one dispatch span per piece, piece-indexed
+    disp = [e for e in events if e["name"] == "pipe.piece_dispatch"]
+    assert len(disp) >= 2
+    pieces = [e["args"]["piece"] for e in disp]
+    assert len(set(pieces)) == len(pieces)
+    assert all(isinstance(x, int) for x in pieces)
+    # the sink's async in-flight spans pair up per chunk id
+    begins = [e["id"] for e in events
+              if e["name"] == "sink.chunk_inflight" and e["ph"] == "b"]
+    ends = [e["id"] for e in events
+            if e["name"] == "sink.chunk_inflight" and e["ph"] == "e"]
+    assert begins and sorted(begins) == sorted(ends)
