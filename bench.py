@@ -155,7 +155,17 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
         config.TIMING_ASYNC = timing_async
         timing.reset()
         t0 = time.perf_counter()
-        step()  # profiled (async mode: one block at the final sync)
+        # profiled (async mode: one block at the final sync), wrapped in
+        # the query profiler: the bench JSON carries the EXPLAIN ANALYZE
+        # plan tree alongside the phase table it reconciles with
+        # (obs/plan, docs/observability.md).  profile_keys=False: the
+        # key sampler would add device programs + mid-iteration host
+        # pulls, breaking profiled_iter_s comparability with the
+        # BENCH_rNN baselines and the one-designated-block async
+        # contract above (the --skew heavy-hitter profile below runs
+        # OUTSIDE the timed iteration instead)
+        qplan = obs.explain_analyze(step, reset_timings=False,
+                                    profile_keys=False)
         profiled_s = time.perf_counter() - t0
     finally:
         config.BENCH_TIMINGS = prev_flag
@@ -207,6 +217,17 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # Unarmed: not called, zero extra collectives.
             **({"rank_phase_skew": obs.rank_report.report()}
                if obs.rank_report.armed() else {}),
+            # heavy-hitter profile of the skewed key column (obs/plan
+            # key_profile — Misra-Gries over shard-weighted samples):
+            # names the hot keys and their estimated share, the ROADMAP
+            # item 2 detection baseline.  Only computed when --skew
+            # asked for a skewed workload (one small device sample).
+            **({"heavy_hitters": obs.plan.key_profile(lt, "k")}
+               if skew > 0.0 else {}),
+            # armed comm matrix (CYLON_TPU_COMM_MATRIX=1): the
+            # per-(src,dst) rows/bytes report rides the plan section
+            # below (detail.plan.comm_matrix — QueryPlan.to_dict embeds
+            # it; a second top-level copy would just be payload drift)
             # recovery events + spill-tier + durable-checkpoint counters
             # (cylon_tpu.obs.bench_detail — the collector every bench
             # script shares): recovery_events says whether the number
@@ -215,8 +236,10 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # checkpoint_events > 0 paid page writes in-loop, and
             # resume_world_mismatch vs resume_resharded_pieces tells
             # "resharded and fast-forwarded" apart from "threw the
-            # checkpoint away" after a topology change (elastic resume)
-            **obs.bench_detail(),
+            # checkpoint away" after a topology change (elastic resume);
+            # plan= attaches the profiled iteration's EXPLAIN ANALYZE
+            # tree as the "plan" section
+            **obs.bench_detail(plan=qplan),
         },
     }
 

@@ -170,6 +170,15 @@ _STREAM_OK_FILES = ("exec/pipeline.py", "exec/memory.py")
 #: (cylon_tpu/obs/metrics — counter/group/namespace); the obs package
 #: itself is the defining module and exempt by construction
 _STATS_NAME_RE = re.compile(r"^_?[A-Z0-9_]*(STATS|COUNTERS|METRICS)$")
+
+#: plan-node stack primitives callable ONLY from the obs/plan.py
+#: context-manager facade (TS113): an operator that calls push_node/
+#: pop_node directly can leave the query-scoped node stack unbalanced —
+#: every later operator in the query then parents under a dead node and
+#: EXPLAIN trees stop matching the plan that actually ran.  Scoped to
+#: the operator directories that push plan nodes.
+_PLAN_STACK_FUNCS = {"push_node", "pop_node"}
+_PLAN_DIRS = ("relational", "exec", "stream")
 #: the defining package, matched as a QUALIFIED path pair (a workspace
 #: directory that merely happens to be called "obs" must not disable
 #: the rule for everything under it)
@@ -439,6 +448,7 @@ class _ModuleLint:
         self._check_foreign_rank_read()
         self._check_stream_state()
         self._check_stats_dicts()
+        self._check_plan_stack()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -742,6 +752,34 @@ class _ModuleLint:
                         "metrics counter/group/namespace) so Prometheus "
                         "exposition, JSON snapshots and bench_detail see "
                         "every counter (docs/observability.md)")
+
+    def _check_plan_stack(self) -> None:
+        """TS113: a direct ``push_node``/``pop_node`` call in
+        ``relational/``, ``exec/`` or ``stream/`` — plan nodes must open
+        through the obs/plan.py context-manager facade
+        (``plan.node(...)`` / ``plan.annotate(...)``), whose balanced
+        __enter__/__exit__ is what keeps the query-scoped node stack
+        consistent across typed-fault unwinds and the recovery ladder's
+        retries.  The defining module (cylon_tpu/obs/plan.py) is exempt
+        by construction (it sits outside the scoped directories)."""
+        parts = self.path.replace(os.sep, "/").split("/")
+        if not any(d in parts for d in _PLAN_DIRS):
+            return
+        if _OBS_PKG_PAIR in "/" + self.path.replace(os.sep, "/"):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name(node.func)
+            if fname.split(".")[-1] in _PLAN_STACK_FUNCS:
+                self._emit(
+                    "TS113", node,
+                    f"`{fname}` manipulates the plan-node stack directly "
+                    "— open plan nodes through the cylon_tpu.obs.plan "
+                    "context-manager facade (plan.node(...) / "
+                    "plan.annotate(...)) so the query-scoped stack stays "
+                    "balanced across typed-fault unwinds "
+                    "(docs/trace_safety.md)")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

@@ -58,6 +58,11 @@ RULES = {
              "outside cylon_tpu/obs/ — counters must route through the "
              "metrics registry facade (cylon_tpu.obs.metrics) so "
              "exposition, snapshots and bench detail see every counter",
+    "TS113": "plan-node push/pop outside the obs/plan.py context-manager "
+             "facade in relational/, exec/ or stream/ — operators must "
+             "open plan nodes via plan.node()/annotate(); a raw "
+             "push_node/pop_node call can unbalance the query-scoped "
+             "stack and reparent every later operator's tree",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..obs import plan as _plan
 from ..obs import trace as _trace
 from ..utils.cache import program_cache
 from ..core.column import Column
@@ -174,26 +175,33 @@ def pipelined_set_op(a: Table, b: Table, op: str, n_chunks: int = 4):
     if op not in ("union", "intersect", "subtract"):
         raise InvalidError(f"unknown set op {op!r}")
     env = check_same_env(a, b)
-    a, b = _align_schemas(a, b)
-    names = a.column_names
-    if env.world_size > 1 and op != "union":
-        b = shuffle_table(b, names)     # resident side: ONCE
-    parts = []
-    for chunk in chunk_table(a, n_chunks):
-        _interleave()   # chunk boundary = serving-tier interleave point
+    with _plan.node("pipelined_set_op", kind=op,
+                    n_chunks=int(n_chunks)) as pn:
+        if pn:
+            pn.set(rows_in=a.row_count + b.row_count)
+        a, b = _align_schemas(a, b)
+        names = a.column_names
+        if env.world_size > 1 and op != "union":
+            b = shuffle_table(b, names)     # resident side: ONCE
+        parts = []
+        for chunk in chunk_table(a, n_chunks):
+            _interleave()   # chunk boundary = serving interleave point
+            if op == "union":
+                # unique_table shuffles internally; a pre-shuffle of `a`
+                # would be a redundant third pass over its rows
+                parts.append(unique_table(chunk))
+            else:
+                if env.world_size > 1:
+                    chunk = shuffle_table(chunk, names)
+                parts.append(_set_operation_impl(chunk, b, op,
+                                                 assume_colocated=True))
         if op == "union":
-            # unique_table shuffles internally; a pre-shuffle of `a`
-            # would be a redundant third pass over its rows
-            parts.append(unique_table(chunk))
-        else:
-            if env.world_size > 1:
-                chunk = shuffle_table(chunk, names)
-            parts.append(_set_operation_impl(chunk, b, op,
-                                             assume_colocated=True))
-    if op == "union":
-        parts.append(unique_table(b))
-    combined = concat_tables(parts) if len(parts) > 1 else parts[0]
-    return unique_table(combined)
+            parts.append(unique_table(b))
+        combined = concat_tables(parts) if len(parts) > 1 else parts[0]
+        res = unique_table(combined)
+        if pn:
+            pn.set(rows_out=res.row_count)
+        return res
 
 
 class GroupBySink:
@@ -614,6 +622,20 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     if how not in ("inner", "left", "right", "outer"):
         raise InvalidError(
             "pipelined_join supports how in ('inner','left','right','outer')")
+    with _plan.node("pipelined_join", how=how, n_chunks=int(n_chunks),
+                    sink=(type(sink).__name__ if sink is not None
+                          else None)) as pn:
+        if pn:
+            pn.set(rows_in=left.row_count + right.row_count)
+        res = _pipelined_join_impl(left, right, left_on, right_on, how,
+                                   n_chunks, suffixes, sink, pn)
+        if pn and type(res) is Table:
+            pn.set(rows_out=res.row_count)
+        return res
+
+
+def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
+                         how: str, n_chunks: int, suffixes, sink, pn):
     env = check_same_env(left, right)
     left_on = [left_on] if isinstance(left_on, str) else list(left_on)
     right_on = [right_on] if isinstance(right_on, str) else list(right_on)
@@ -639,6 +661,8 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
 
     n_ranges = max(int(n_chunks), 1)
     if n_ranges == 1 or rwork.row_count == 0 or lwork.row_count == 0:
+        if pn:
+            pn.annotate(route="monolithic")
         res = join_tables(lwork, rwork, left_on, right_on, how=how,
                           suffixes=suffixes, assume_colocated=True,
                           allow_defer=False)
@@ -749,6 +773,13 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
               for r in range(n_ranges)]
     caps_r = [config.pow2ceil(max(int(r_lens[:, r].max()), 1))
               for r in range(n_ranges)]
+    if pn:
+        # the plan-facing piece geometry: route + chunking + dispatch
+        # rungs — the static attrs EXPLAIN prints for this node
+        pn.annotate(route="range_pipeline", n_ranges=n_ranges,
+                    max_cap_l=max(caps_l), max_cap_r=max(caps_r),
+                    packed=bool(config.PACKED_PIECES),
+                    overlap=bool(overlap), donate=bool(donate))
 
     # piece-cap-sizing consult of the HBM ledger (exec/memory): admission
     # of the packed sources accounts for the transient sort-operand set
@@ -769,6 +800,8 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                             donate=donate)
         timing.maybe_block(src_r.arrs)
     del lsorted, rsorted
+    if pn:
+        pn.annotate(spilled=bool(src_l.spilled or src_r.spilled))
 
     packed = config.PACKED_PIECES
 
@@ -829,6 +862,8 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
             tuple(int(x) for x in r_lens.sum(axis=0)))
         stage = ckpt.open_stage(env, "pipelined_join", token,
                                 base_token=base)
+        if pn:
+            pn.annotate(ckpt=True)
         if isinstance(sink, GroupBySink):
             sink.attach_checkpoint(stage)
 

@@ -16,6 +16,16 @@ Three cooperating pieces (docs/observability.md):
 * :mod:`cylon_tpu.obs.rank_report` — an explicitly-armed end-of-run
   allgather of each rank's phase table, reduced to a min/median/max
   skew report (``CYLON_TPU_RANK_REPORT=1``).
+* :mod:`cylon_tpu.obs.plan` — the query profiler: every distributed
+  operator pushes a typed plan node; :func:`~cylon_tpu.obs.plan.
+  explain` returns the static tree, :func:`~cylon_tpu.obs.plan.
+  explain_analyze` attaches per-node rows/bytes/seconds (reconciling
+  with the global phase table) and heavy-hitter key profiles
+  (:mod:`cylon_tpu.obs.sketch`, Misra-Gries).
+* :mod:`cylon_tpu.obs.comm` — the shuffle communication matrix:
+  per-(src,dst) rows/bytes accumulated from the exchange's count
+  sidecar (``CYLON_TPU_COMM_MATRIX=1``), row/column sums reconciling
+  with the always-on exchange byte counters.
 
 Overhead contract: with nothing armed, the whole subsystem costs one
 extra list load per timed region and one per scheduler loop — zero
@@ -24,7 +34,8 @@ tests/test_obs.py).  Module-level ad-hoc counter dicts outside this
 package are a lint finding (TS112, docs/trace_safety.md).
 """
 
-from . import metrics, rank_report, trace  # noqa: F401
+from . import comm, metrics, plan, rank_report, sketch, trace  # noqa: F401
 from .metrics import (bench_detail, counter, gauge,  # noqa: F401
                       histogram, maybe_write_snapshot, prometheus_text,
                       snapshot, write_prometheus, write_snapshot)
+from .plan import explain, explain_analyze  # noqa: F401

@@ -1,0 +1,149 @@
+"""Shuffle communication matrix — per-(src,dst) rows and bytes.
+
+The exchange engine's count sidecar (parallel/shuffle.exchange) already
+knows exactly which rank pair carried which rows: ``counts[s, d]`` is
+the number of rows rank ``s`` sent to rank ``d``, replicated to every
+process by the count-matrix pull the exchange needs anyway.  This module
+turns that free information into the operator-facing N×N view ROADMAP
+item 5 (topology-aware shuffle) will be judged against: armed
+(``CYLON_TPU_COMM_MATRIX=1`` or :func:`arm` — same contract as
+``CYLON_TPU_RANK_REPORT``), every exchange accumulates its count matrix
+(rows and bytes) host-side, and :func:`report` reduces them to one
+cumulative matrix whose row sums are per-source sent totals, column sums
+per-destination received totals, and whose grand totals must equal the
+always-on registry counters ``exchange_rows_total`` /
+``exchange_bytes_total`` (asserted in tests/test_explain.py and
+cross-checked byte-identical across ranks in tests/multihost_driver.py).
+
+Unarmed and with no plan profile active, :func:`record` is never called
+— the exchange guards on ``armed()`` (one env-cached list load): zero
+extra collectives, zero host syncs, zero allocations.  Recording itself
+is pure host numpy over the already-pulled sidecar — arming adds no
+device work either; the one collective lives in :func:`report`'s
+OPTIONAL cross-rank verification, at the explicit call site.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["arm", "armed", "record", "reset", "report", "matrix"]
+
+_ARMED: list = [False]
+
+#: env arming, read ONCE at first check (None = unread): armed() sits on
+#: the per-exchange hot path, so it must stay a list load, not an
+#: environ lookup — arm at launch via the env var, or at runtime via
+#: :func:`arm`; a mid-process env change needs :func:`_rearm` (tests)
+_ENV_ARMED: list = [None]
+
+#: cumulative state: [world, rows (W,W) int64, bytes (W,W) int64,
+#: n_exchanges] — None until the first record
+_STATE: list = [None]
+
+#: per-exchange log (site, rows_total, bytes_total), newest last, bounded
+_LOG: list = []
+_LOG_CAP = 256
+
+
+def arm(on: bool = True) -> None:
+    _ARMED[0] = bool(on)
+
+
+def armed() -> bool:
+    if _ARMED[0]:
+        return True
+    e = _ENV_ARMED[0]
+    if e is None:
+        e = _ENV_ARMED[0] = \
+            os.environ.get("CYLON_TPU_COMM_MATRIX") == "1"
+    return e
+
+
+def _rearm() -> None:
+    """Re-read the env on the next armed() check (tests; env changed
+    mid-run) — the metrics._rearm_snapshots pattern."""
+    _ENV_ARMED[0] = None
+
+
+def reset() -> None:
+    _STATE[0] = None
+    del _LOG[:]
+
+
+def record(counts, row_bytes: int, site: str = "exchange") -> None:
+    """Accumulate one exchange's (W, W) count sidecar into the
+    cumulative matrices + the bounded per-exchange log.  Called (via
+    ``obs.plan.record_exchange``) only when :func:`armed`; pure host
+    work on the replicated sidecar — the plan profiler computes its
+    node totals from the same sidecar independently, so an unarmed
+    profile never touches this module's state."""
+    counts = np.asarray(counts, np.int64)
+    w = counts.shape[0]
+    bmat = counts * int(row_bytes)
+    st = _STATE[0]
+    if st is None or st[0] != w:
+        # world change (new mesh mid-process): restart the accumulation
+        # — matrices of different shapes cannot legally sum
+        st = _STATE[0] = [w, np.zeros((w, w), np.int64),
+                          np.zeros((w, w), np.int64), 0]
+    st[1] += counts
+    st[2] += bmat
+    st[3] += 1
+    _LOG.append({"site": site, "rows": int(counts.sum()),
+                 "bytes": int(bmat.sum()), "row_bytes": int(row_bytes)})
+    if len(_LOG) > _LOG_CAP:
+        del _LOG[:len(_LOG) - _LOG_CAP]
+
+
+def matrix() -> tuple | None:
+    """The cumulative (rows, bytes) matrices, or None before the first
+    recorded exchange."""
+    st = _STATE[0]
+    if st is None:
+        return None
+    return st[1], st[2]
+
+
+def report(verify_across_ranks: bool = True) -> dict | None:
+    """The cumulative communication matrix with row/column sums, or None
+    when nothing was recorded.  In a multiprocess session (armed runs
+    only — the caller honors :func:`armed`) the matrix is allgathered
+    and must be BYTE-IDENTICAL on every rank: each process accumulated
+    the same replicated count sidecars, so any divergence means the
+    ranks ran different exchanges — a typed
+    :class:`~cylon_tpu.status.RankDesyncError`, never a silently
+    per-rank report (the obs/rank_report contract)."""
+    st = _STATE[0]
+    if st is None:
+        return None
+    w, rows, bts, n = st[0], st[1], st[2], st[3]
+
+    import jax
+    nproc = jax.process_count()
+    if verify_across_ranks and nproc > 1:
+        from jax.experimental import multihost_utils
+        from ..status import RankDesyncError
+        wire = np.concatenate([[np.int64(n)], rows.ravel(), bts.ravel()])
+        gathered = np.asarray(
+            multihost_utils.process_allgather(wire)).reshape(nproc, -1)
+        for r in range(1, nproc):
+            if not np.array_equal(gathered[0], gathered[r]):
+                raise RankDesyncError(
+                    "comm matrix: ranks accumulated different exchange "
+                    "sidecars — the ranks ran different shuffles",
+                    site="obs.comm")
+
+    return {
+        "world": w,
+        "exchanges": n,
+        "rows": rows.tolist(),
+        "bytes": bts.tolist(),
+        "row_sums_bytes": bts.sum(axis=1).tolist(),   # per-src sent
+        "col_sums_bytes": bts.sum(axis=0).tolist(),   # per-dst received
+        "total_rows": int(rows.sum()),
+        "total_bytes": int(bts.sum()),
+        "recent": list(_LOG[-16:]),
+    }

@@ -140,10 +140,25 @@ class Histogram:
 
     def percentile(self, p: float):
         """Quantile at percent ``p`` in [0, 100] — ``np.percentile``
-        (linear interpolation) over the retained samples; None when
-        nothing was observed."""
+        (linear interpolation) over the retained samples.
+
+        Edge contract (regression-tested in tests/test_obs.py): ``p``
+        outside [0, 100] raises typed
+        :class:`~cylon_tpu.status.InvalidError`; an EMPTY histogram
+        returns ``nan`` (not None — a report can carry it through
+        arithmetic and JSON without type forks); a FULLY-truncated one
+        (samples observed but none retained, ``SAMPLE_CAP`` exhausted
+        before the first observation) returns ``nan`` too — bucket
+        interpolation with zero retained samples would fabricate a
+        quantile from the bucket grid alone.  Partial truncation keeps
+        the documented bucket-interpolation fallback."""
+        p = float(p)
+        if not 0.0 <= p <= 100.0:
+            from ..status import InvalidError
+            raise InvalidError(
+                f"percentile {p!r} outside [0, 100] on {self.name!r}")
         if not self._samples:
-            return None
+            return float("nan")
         if not self.truncated:
             import numpy as np
             return float(np.percentile(
@@ -151,7 +166,7 @@ class Histogram:
         return self._bucket_percentile(p)
 
     def _bucket_percentile(self, p: float) -> float:
-        target = (p / 100.0) * (self.count - 1)
+        target = (p / 100.0) * max(self.count - 1, 0)
         seen = 0
         lo = 0.0
         for i, n in enumerate(self.bucket_counts):
@@ -179,8 +194,15 @@ class Histogram:
 
     @property
     def value(self):
+        # the exposition/JSON-snapshot view: NaN quantiles (empty or
+        # fully-truncated histogram — the percentile() edge contract)
+        # export as None/null, which strict JSON parsers accept where a
+        # literal NaN token would be rejected
+        def _j(x):
+            return None if x != x else x
         return {"count": self.count, "sum": round(self.sum, 6),
-                "p50": self.percentile(50), "p99": self.percentile(99)}
+                "p50": _j(self.percentile(50)),
+                "p99": _j(self.percentile(99))}
 
     def reset(self) -> None:
         self.bucket_counts = [0] * (len(self.buckets) + 1)
@@ -489,14 +511,19 @@ BENCH_CKPT_KEYS = ("checkpoint_events", "bytes_checkpointed",
 
 
 def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
-                 events: str | None = "drain") -> dict:
+                 events: str | None = "drain", plan=None) -> dict:
     """The counter block every bench script previously hand-rolled:
     recovery events (``events="drain"`` empties the log like bench.py
     always did; ``"keep"`` reads without draining; ``None`` omits),
     the selected spill-tier counters (exec/memory.stats) and the
     selected checkpoint counters (exec/checkpoint.stats).  Key names
     are exactly the stats() keys — the bench JSONs' schema is asserted
-    stable in tests/test_obs.py."""
+    stable in tests/test_obs.py.
+
+    ``plan``: a :class:`~cylon_tpu.obs.plan.QueryPlan` (or an already
+    rendered dict) adds a ``plan`` section — the EXPLAIN/ANALYZE tree
+    the bench drivers emit alongside the phase table (absent by
+    default, so unprofiled schemas are unchanged)."""
     from ..exec import checkpoint, memory, recovery
     out: dict = {}
     if events == "drain":
@@ -507,4 +534,6 @@ def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
     out.update({k: mem[k] for k in spill_keys})
     ck = checkpoint.stats()
     out.update({k: ck[k] for k in ckpt_keys})
+    if plan is not None:
+        out["plan"] = plan.to_dict() if hasattr(plan, "to_dict") else plan
     return out

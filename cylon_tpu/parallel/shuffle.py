@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
+from ..obs import comm as _comm, metrics as _metrics, plan as _plan
 from ..utils.cache import program_cache
 from ..ctx.context import ROW_AXIS
 from ..ops import hashing
@@ -357,6 +358,18 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
                 "shard would materialize the bulk of the table",
                 site="shuffle.recv_guard")
 
+    # always-on exchange totals (host arithmetic on the already-pulled
+    # count sidecar — no device work, no sync): the registry counters
+    # the armed comm matrix's row/column sums must reconcile against
+    # (obs/comm, docs/observability.md)
+    _metrics.counter("exchange_rows_total").inc(total)
+    _metrics.counter("exchange_bytes_total").inc(total * row_bytes)
+    _metrics.counter("exchange_count").inc()
+    if _comm.armed() or _plan.active():
+        # per-(src,dst) matrix + plan-node attribution (armed runs /
+        # active EXPLAIN ANALYZE only — the happy path skips on two
+        # cached loads)
+        _plan.record_exchange(counts, row_bytes, site=owner)
     if rounds > 1:
         # countable path marker (tests/test_fuzz.py regime tier): the
         # multi-round protocol actually engaged for this exchange

@@ -295,3 +295,118 @@ def check_same_env(a: Table, b: Table) -> CylonEnv:
     if a.env is not b.env and a.env.mesh is not b.env.mesh:
         raise InvalidError("tables belong to different CylonEnvs")
     return a.env
+
+
+# ---------------------------------------------------------------------------
+# key-value sampling for the heavy-hitter profiler (obs/plan, obs/sketch)
+# ---------------------------------------------------------------------------
+
+from ..utils.cache import program_cache  # noqa: E402
+
+
+@program_cache()
+def _key_sample_fn(mesh, m: int, nkeys: int):
+    """Evenly spaced per-shard sample of RAW key values plus the
+    canonicalizing row hash — the sort-splitter sampling machinery
+    (:func:`sample_positions`, relational/sort._sample_fn) applied to
+    the profiler's needs: values NAME the hot keys (single integer-ish
+    keys), the hash covers multi-column/float/string tuples with exactly
+    the shuffle-routing predicate (ops/hashing.hash_rows).  Pure-local
+    per-shard program: no collective, no widening (jaxpr-gate
+    registered)."""
+    from ..ops import hashing
+
+    def per_shard(vc, *args):
+        datas = list(args[:nkeys])
+        valids = list(args[nkeys:])
+        cap = datas[0].shape[0]
+        my = jax.lax.axis_index(ROW_AXIS)
+        n = vc[my]
+        h = hashing.hash_rows(datas, valids)
+        idx = sample_positions(n, m, cap)
+        live = jnp.full((m,), n > 0)
+        return tuple(d[idx] for d in datas) + (h[idx], live)
+
+    specs = (REP,) + (ROW,) * (2 * nkeys)
+    nouts = nkeys + 2
+    return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=specs,
+                                 out_specs=(ROW,) * nouts))
+
+
+def _key_value_repr(col: Column, vals: np.ndarray):
+    """Host-side naming of sampled key values: raw numerics pass
+    through; sorted-dictionary string codes decode to their strings;
+    hashed-string codes stay codes (stable but opaque)."""
+    if col.type == LogicalType.STRING:
+        d = col.dictionary
+        if isinstance(d, np.ndarray) and len(d):
+            return d[np.clip(vals.astype(np.int64), 0, len(d) - 1)]
+        # hashed-string codes (HashedStrings) fall through: stable but
+        # opaque identities — decoding would need the value store lookup
+    return vals
+
+
+def sample_keys(table: Table, key_names: list, m: int | None = None):
+    """Sample ``table``'s key columns for the heavy-hitter profiler:
+    returns ``(values, weights, total_rows)`` — a flat host array of
+    sampled key identities (values for a single key column, row hashes
+    for composite keys), a parallel weight array normalizing each
+    shard's samples by its true row share (the join skew detector's
+    weighting, relational/join._heavy_keys), and the global live row
+    count.  None for empty tables.  Armed-profiler path only: one small
+    device program + one host pull."""
+    from .. import config
+    from ..utils.host import host_array
+
+    env = table.env
+    total = int(table.valid_counts.sum())
+    if total == 0:
+        return None
+    w = env.world_size
+    if m is None:
+        m = config.SKEW_SAMPLE
+    m = min(max(int(table.capacity), 1), int(m))
+    cols = [table.column(n) for n in key_names]
+    cap = cols[0].data.shape[0]
+    datas = tuple(c.data for c in cols)
+    valids = tuple(c.validity if c.validity is not None
+                   else np.ones(cap, bool) for c in cols)
+    outs = _key_sample_fn(env.mesh, m, len(cols))(
+        np.asarray(table.valid_counts, np.int32), *datas, *valids)
+    vals0 = host_array(outs[0]).reshape(w, m)
+    hashes = host_array(outs[-2]).reshape(w, m)
+    live = host_array(outs[-1]).reshape(w, m)
+    if len(cols) == 1:
+        raw = np.asarray(_key_value_repr(cols[0], vals0))
+    else:
+        raw = hashes
+    vc = np.asarray(table.valid_counts, np.float64)
+    values, weights = [], []
+    for s in range(w):
+        lv = raw[s][live[s]]
+        if lv.size == 0:
+            continue
+        values.append(lv)
+        # each shard contributes its true row share, split evenly over
+        # its samples — unweighted pooling would let a tiny shard's
+        # keys dominate the estimate
+        weights.append(np.full(lv.size, vc[s] / total / lv.size))
+    if not values:
+        return None
+    return (np.concatenate(values), np.concatenate(weights) * total,
+            total)
+
+
+def _trace_key_sample(mesh):
+    w = int(mesh.devices.size)
+    cap, S = 1024, jax.ShapeDtypeStruct
+    fn = _key_sample_unwrap(_key_sample_fn(mesh, 64, 1))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64),
+                              S((w * cap,), np.bool_))
+
+
+from ..analysis.registry import declare_builder as _declare_builder, \
+    unwrap as _key_sample_unwrap  # noqa: E402
+
+_declare_builder(f"{__name__}._key_sample_fn", _trace_key_sample,
+                 tags=("profile",))

@@ -670,18 +670,38 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
     (exec/pipeline.GroupBySink) when every op decomposes through public
     partial aggregations (sum/count/min/max/mean/var/std)."""
     from ..exec.pipeline import GroupBySink, chunk_table
+    from ..obs import plan as _plan
     from .common import run_with_oom_fallback
 
     def fallback(nc):
+        _plan.annotate(route="chunked_sink", n_chunks=nc)
         sink = GroupBySink(by, aggs, ddof=ddof)
         for ch in chunk_table(table, nc):
             sink(ch)
         return sink.finalize()
 
-    return run_with_oom_fallback(
-        lambda: _groupby_aggregate_impl(table, by, aggs, ddof),
-        can_fallback=all(a[1] in GroupBySink._DECOMP for a in aggs),
-        fallback=fallback, label="groupby", env=table.env)
+    by_l = [by] if isinstance(by, str) else list(by)
+    with _plan.node("groupby", by=tuple(by_l),
+                    aggs=tuple((a[0], a[1]) for a in aggs
+                               if isinstance(a, (list, tuple))
+                               and len(a) >= 2)) as pn:
+        if pn:
+            from ..core.table import DeferredTable
+            # a DeferredTable input (fused join→groupby pushdown) stays
+            # untouched: reading its counts or sampling its keys would
+            # force the materialization the pushdown exists to avoid
+            if not isinstance(table, DeferredTable):
+                pn.set(rows_in=table.row_count)
+                _plan.profile_keys(pn, table, by_l)
+            else:
+                pn.annotate(deferred_input=True)
+        res = run_with_oom_fallback(
+            lambda: _groupby_aggregate_impl(table, by, aggs, ddof),
+            can_fallback=all(a[1] in GroupBySink._DECOMP for a in aggs),
+            fallback=fallback, label="groupby", env=table.env)
+        if pn and type(res) is Table:
+            pn.set(rows_out=res.row_count)
+        return res
 
 
 def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
@@ -694,9 +714,11 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     # keys aggregates straight off the pre-expansion sorted state
     # (relational/fused.py) — must run before any column access below,
     # which would materialize the join
+    from ..obs import plan as _plan
     from .fused import try_join_groupby_pushdown
     pushed = try_join_groupby_pushdown(table, by, specs, ddof)
     if pushed is not None:
+        _plan.annotate(route="fused_pushdown")
         return pushed
     by_cols = [table.column(n) for n in by]
     val_cols = [table.column(c) for c, _, _, _ in specs]
@@ -732,6 +754,7 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     if distributed and all_assoc and not grouped:
         # phase 1: local pre-combine (reference groupby.cpp:76-81), riding
         # the sort path when the columns lane-pack (see _raw_fn/vspec)
+        _plan.annotate(route="combine_shuffle")
         by_datas, by_valids = col_arrays(by_cols)
         uniq_names = list(dict.fromkeys(c for c, _, _, _ in specs))
         val_map = tuple(uniq_names.index(c) for c, _, _, _ in specs)
@@ -829,6 +852,7 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
         return out
 
     # non-associative ops (or local, or grouped input): co-locate raw rows
+    _plan.annotate(route="grouped_fastpath" if grouped else "raw")
     work = table.project(list(dict.fromkeys(by + [c for c, _, _, _ in specs])))
     if distributed and not grouped:
         work = shuffle_table(work, by)

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
+from ..obs import plan as _plan
 from ..utils.cache import program_cache
 from ..core.column import Column
 from ..core.table import Table
@@ -183,11 +184,13 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
             and lwork.row_count >= 4 * max(rwork.row_count, 1)):
         # countable path marker (tests/test_fuzz.py regime tier)
         timing.bump("join.broadcast")
+        _plan.annotate(route="broadcast", broadcast_side="right")
         return lwork, allgather_table(rwork), True
     if (how in ("inner", "right")
             and lwork.row_count <= bc
             and rwork.row_count >= 4 * max(lwork.row_count, 1)):
         timing.bump("join.broadcast")
+        _plan.annotate(route="broadcast", broadcast_side="left")
         return allgather_table(lwork), rwork, True
 
     if how in ("inner", "left", "right", "semi", "anti"):
@@ -212,8 +215,10 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
             if (build_heavy.row_count * env.world_size
                     > config.SKEW_GUARD_RATIO * max(build.row_count, 1)
                     and build_heavy.row_count > config.SKEW_GUARD_ROWS):
+                _plan.annotate(route="hash", skew_guard_fallback=True)
                 return (shuffle_table(lwork, left_on),
                         shuffle_table(rwork, right_on), False)
+            _plan.annotate(route="skew_split", heavy_keys=int(len(heavy)))
             build_light = filter_table(build, ~flag)
             build_out = concat_tables(
                 [shuffle_table(build_light, build_on),
@@ -1020,21 +1025,46 @@ def join_tables(left: Table, right: Table, left_on, right_on,
     from .common import run_with_oom_fallback
 
     if isinstance(left, PackedPiece) or isinstance(right, PackedPiece):
-        return _join_packed_entry(left, right, left_on, right_on, how,
-                                  suffixes, coalesce_keys, allow_defer)
+        # per-piece plan node (docs/pipeline.md): the window caps ARE the
+        # piece geometry the pipelined node's children are judged by
+        with _plan.node(
+                "join.piece", how=how,
+                cap_l=int(getattr(left, "piece_cap", 0)),
+                cap_r=int(getattr(right, "piece_cap", 0))) as pn:
+            if pn:
+                pn.set(rows_in=int(getattr(left, "lens", np.zeros(1)).sum()
+                                   + getattr(right, "lens",
+                                             np.zeros(1)).sum()))
+            res = _join_packed_entry(left, right, left_on, right_on, how,
+                                     suffixes, coalesce_keys, allow_defer)
+            if pn and type(res) is Table:
+                pn.set(rows_out=res.row_count)
+            return res
 
     def fallback(nc):
         from ..exec.pipeline import pipelined_join
         return pipelined_join(left, right, left_on, right_on, how=how,
                               n_chunks=nc, suffixes=suffixes)
 
-    return run_with_oom_fallback(
-        lambda: _join_tables_impl(left, right, left_on, right_on, how,
-                                  suffixes, coalesce_keys, assume_colocated,
-                                  allow_defer),
-        can_fallback=(not assume_colocated and coalesce_keys
-                      and how not in ("semi", "anti")),
-        fallback=fallback, label="join", env=left.env)
+    lo = [left_on] if isinstance(left_on, str) else list(left_on)
+    ro = [right_on] if isinstance(right_on, str) else list(right_on)
+    with _plan.node(
+            "join", how=how, left_on=tuple(lo), right_on=tuple(ro),
+            route=("colocated" if assume_colocated
+                   or left.env.world_size == 1 else "hash")) as pn:
+        if pn:
+            pn.set(rows_in=left.row_count + right.row_count)
+            _plan.profile_keys(pn, left, lo)
+        res = run_with_oom_fallback(
+            lambda: _join_tables_impl(left, right, left_on, right_on, how,
+                                      suffixes, coalesce_keys,
+                                      assume_colocated, allow_defer),
+            can_fallback=(not assume_colocated and coalesce_keys
+                          and how not in ("semi", "anti")),
+            fallback=fallback, label="join", env=left.env)
+        if pn and type(res) is Table:
+            pn.set(rows_out=res.row_count)
+        return res
 
 
 def join_tables_multi(tables: list, ons: list, how: str = "inner",
@@ -1150,6 +1180,7 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
         # table.cpp:861 DistributedJoin + SURVEY §7 hard-part 4.
         from .repart import concat_tables
         from .setops import unique_table
+        _plan.annotate(route="skew_outer_decomposition")
         lj = join_tables(left, right, left_on, right_on, how="left",
                          suffixes=suffixes, coalesce_keys=coalesce_keys)
         lkeys = unique_table(

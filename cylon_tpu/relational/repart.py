@@ -139,16 +139,21 @@ def shuffle_table(table: Table, key_names,
     scheduler.maybe_yield()
     if env.world_size == 1:
         return table
-    keys = [table.column(n) for n in key_names]
-    datas, valids = col_arrays(keys)
-    tgt = shuffle.hash_targets(env.mesh, datas, valids, table.valid_counts)
-    counts = shuffle.count_targets(env.mesh, tgt)
-    flat, recipe = _flatten_for_exchange(table)
-    # hash shuffles run under join/groupby/setops OOM fallbacks: the
-    # receive-budget guard may preempt a doomed allocation
-    new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat,
-                                           guard=True, owner=owner)
-    return _rebuild(recipe, new_flat, new_valid, env)
+    from ..obs import plan as _plan
+    with _plan.node("shuffle", keys=tuple(key_names), owner=owner) as pn:
+        if pn:
+            pn.set(rows_in=table.row_count, rows_out=table.row_count)
+        keys = [table.column(n) for n in key_names]
+        datas, valids = col_arrays(keys)
+        tgt = shuffle.hash_targets(env.mesh, datas, valids,
+                                   table.valid_counts)
+        counts = shuffle.count_targets(env.mesh, tgt)
+        flat, recipe = _flatten_for_exchange(table)
+        # hash shuffles run under join/groupby/setops OOM fallbacks: the
+        # receive-budget guard may preempt a doomed allocation
+        new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts, flat,
+                                               guard=True, owner=owner)
+        return _rebuild(recipe, new_flat, new_valid, env)
 
 
 def exchange_by_targets(table: Table, tgt, counts: np.ndarray) -> Table:
@@ -216,6 +221,7 @@ def even_partition_counts(total: int, w: int) -> np.ndarray:
 
 def repartition(table: Table, rows_per_partition=None) -> Table:
     """Redistribute preserving global row order; default = even split."""
+    from ..obs import plan as _plan
     env = table.env
     w = env.world_size
     total = table.row_count
@@ -230,17 +236,21 @@ def repartition(table: Table, rows_per_partition=None) -> Table:
         return table
     if np.array_equal(dest, table.valid_counts):
         return table
-    tgt = _order_preserving_targets(table, dest)
-    # count matrix is fully determined host-side: source s's global range
-    # [offs, offs+vc) intersected with each destination range
-    soff = np.concatenate([[0], np.cumsum(table.valid_counts)[:-1]])
-    dof = np.concatenate([[0], np.cumsum(dest)[:-1]])
-    counts = np.zeros((w, w), np.int64)
-    for s in range(w):
-        lo, hi = soff[s], soff[s] + table.valid_counts[s]
-        for d in range(w):
-            counts[s, d] = max(0, min(hi, dof[d] + dest[d]) - max(lo, dof[d]))
-    return exchange_by_targets(table, tgt, counts)
+    with _plan.node("repartition", order_preserving=True) as pn:
+        if pn:
+            pn.set(rows_in=total, rows_out=total)
+        tgt = _order_preserving_targets(table, dest)
+        # count matrix is fully determined host-side: source s's global
+        # range [offs, offs+vc) intersected with each destination range
+        soff = np.concatenate([[0], np.cumsum(table.valid_counts)[:-1]])
+        dof = np.concatenate([[0], np.cumsum(dest)[:-1]])
+        counts = np.zeros((w, w), np.int64)
+        for s in range(w):
+            lo, hi = soff[s], soff[s] + table.valid_counts[s]
+            for d in range(w):
+                counts[s, d] = max(
+                    0, min(hi, dof[d] + dest[d]) - max(lo, dof[d]))
+        return exchange_by_targets(table, tgt, counts)
 
 
 @program_cache()

@@ -89,21 +89,30 @@ def unique_table(table: Table, subset=None, keep: str = "first") -> Table:
             raise InvalidError(
                 f"unique on list passthrough column {n!r} is not supported "
                 "(codes are row ids, not value-equal)")
-    if env.world_size > 1:
-        table = shuffle_table(table, subset)
-    key_datas, key_valids = col_arrays([table.column(n) for n in subset])
-    vc = np.asarray(table.valid_counts, np.int32)
-    counts = host_array(_unique_count_fn(env.mesh, keep)(
-        vc, key_datas, key_valids)).astype(np.int64)
-    out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
-    items = list(table.columns.items())
-    datas = tuple(c.data for _, c in items)
-    valids = tuple(c.validity for _, c in items)
-    from .common import table_lane_spec
-    out_d, out_v = _unique_mat_fn(env.mesh, keep, out_cap,
-                                  table_lane_spec([c for _, c in items]))(
-        vc, key_datas, key_valids, datas, valids)
-    return rebuild_like(items, out_d, out_v, counts, env)
+    from ..obs import plan as _plan
+    with _plan.node("unique", subset=tuple(subset), keep=keep) as pn:
+        if pn:
+            pn.set(rows_in=table.row_count)
+        if env.world_size > 1:
+            table = shuffle_table(table, subset)
+        key_datas, key_valids = col_arrays(
+            [table.column(n) for n in subset])
+        vc = np.asarray(table.valid_counts, np.int32)
+        counts = host_array(_unique_count_fn(env.mesh, keep)(
+            vc, key_datas, key_valids)).astype(np.int64)
+        out_cap = config.pow2ceil(int(counts.max()) if counts.size else 1)
+        items = list(table.columns.items())
+        datas = tuple(c.data for _, c in items)
+        valids = tuple(c.validity for _, c in items)
+        from .common import table_lane_spec
+        out_d, out_v = _unique_mat_fn(env.mesh, keep, out_cap,
+                                      table_lane_spec(
+                                          [c for _, c in items]))(
+            vc, key_datas, key_valids, datas, valids)
+        res = rebuild_like(items, out_d, out_v, counts, env)
+        if pn:
+            pn.set(rows_out=res.row_count)
+        return res
 
 
 # ---------------------------------------------------------------------------
@@ -203,10 +212,18 @@ def set_operation(a: Table, b: Table, op: str,
         from ..exec.pipeline import pipelined_set_op
         return pipelined_set_op(a, b, op, n_chunks=nc)
 
-    return run_with_oom_fallback(
-        lambda: _set_operation_impl(a, b, op, assume_colocated),
-        can_fallback=not assume_colocated, fallback=fb, label="set_op",
-        env=a.env)
+    from ..obs import plan as _plan
+    with _plan.node("set_op", kind=op,
+                    colocated=bool(assume_colocated)) as pn:
+        if pn:
+            pn.set(rows_in=a.row_count + b.row_count)
+        res = run_with_oom_fallback(
+            lambda: _set_operation_impl(a, b, op, assume_colocated),
+            can_fallback=not assume_colocated, fallback=fb, label="set_op",
+            env=a.env)
+        if pn and type(res) is Table:
+            pn.set(rows_out=res.row_count)
+        return res
 
 
 def _set_operation_impl(a: Table, b: Table, op: str,

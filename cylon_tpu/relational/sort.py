@@ -322,6 +322,19 @@ def sort_table(table: Table, by, ascending=True,
     by = [by] if isinstance(by, str) else list(by)
     if not by:
         raise InvalidError("sort needs at least one key column")
+    from ..obs import plan as _plan
+    with _plan.node("sort", by=tuple(by), method=method) as pn:
+        if pn:
+            pn.set(rows_in=table.row_count, rows_out=table.row_count)
+        return _sort_table_impl(table, by, ascending, nulls_position,
+                                num_samples, method, pn)
+
+
+def _sort_table_impl(table: Table, by: list, ascending,
+                     nulls_position: str, num_samples: int, method: str,
+                     pn) -> Table:
+    env = table.env
+    from ..obs import plan as _plan
     # hashed-string keys: rewrite to value-stable byte lanes, sort on the
     # lanes, drop them — lexical order on arbitrary-cardinality strings
     expanded = _expand_hashed_string_keys(table, by, ascending)
@@ -362,6 +375,19 @@ def sort_table(table: Table, by, ascending=True,
         if num_samples <= 0:
             num_samples = config.sort_samples(w)
         m = min(max(table.capacity, 1), num_samples)
+        if pn:
+            # profiler piggyback on the splitter sampling path: the same
+            # evenly-spaced per-shard positions (common.sample_positions)
+            # feed a Misra-Gries key profile (obs/plan), so a skewed sort
+            # key is named here before the range exchange concentrates
+            # it.  It is a second small device program, not a reuse of
+            # _sample_fn's outputs: those are TRANSFORMED sort operands
+            # (direction-flipped, null-folded, bias-rebased — pack.
+            # key_operands) from which the original key VALUES are not
+            # recoverable.  Armed ANALYZE runs only.
+            pn.annotate(route="sample_sort", num_samples=m,
+                        splitters=w - 1)
+            _plan.profile_keys(pn, table, by)
         sample_ops, live = _sample_fn(env.mesh, m, descendings, npos,
                                       narrow_keys)(
             vc, by_datas, by_valids)

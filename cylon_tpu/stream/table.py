@@ -82,16 +82,22 @@ class StreamTable:
         """Ingest one micro-batch; returns the shuffled device-resident
         batch Table (the unit subscribers absorbed)."""
         from ..exec import memory, recovery, scheduler
+        from ..obs import plan as _plan
         from ..utils import timing
         # the streaming session's interleave point: one append per baton
         # slice, so continuous ingest coexists with the query tenant mix
         scheduler.maybe_yield()
         recovery.maybe_inject("stream.append")
-        with timing.region("stream.append"):
+        with _plan.node("stream.append", stream=self.name,
+                        keys=tuple(self.key)) as pn, \
+                timing.region("stream.append"):
             tbl = _as_table(batch, self.env)
             if self.env.world_size > 1:
                 tbl = shuffle_table(tbl, self.key, owner="stream.recv")
             nbytes = _table_nbytes(tbl)
+            if pn:
+                pn.set(rows_in=tbl.row_count, rows_out=tbl.row_count,
+                       batch=self.batches_appended)
             # scheduler-mediated admission (TS109): ingest state counts
             # against the mesh budget like any tenant's resident state
             scheduler.admit_allocation(self.env, nbytes)

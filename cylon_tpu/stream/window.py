@@ -322,13 +322,20 @@ class TumblingWindowJoin:
         through the spill tier (device → host → released — the ledger
         balance drains by the window's full byte count)."""
         from ..exec import memory
+        from ..obs import plan as _plan
         from ..utils import timing
         bufs = self._open.pop(wid)
-        with timing.region("stream.window_close"):
+        with _plan.node("stream.window_close", stream=self.name,
+                        window=int(wid), how=self.how) as pn, \
+                timing.region("stream.window_close"):
             parts = [b.table() for b in bufs]
             probe = concat_tables(parts) if len(parts) > 1 else parts[0]
+            if pn:
+                pn.set(rows_in=probe.row_count)
             out = join_tables(probe, self.build, self.key, self.build_on,
                               how=self.how, allow_defer=False)
+            if pn:
+                pn.set(rows_out=out.row_count)
             del probe, parts
             for b in bufs:
                 memory.evict_release(b.reg)

@@ -1,5 +1,5 @@
-"""TPC-H subset: data generator + a 17-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
+"""TPC-H subset: data generator + an 18-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -20,7 +20,10 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   plan shapes — scalar-subquery HAVING (Q11), an aggregate view with a
   scalar-max equi-select (Q15) and a correlated-avg subquery (Q17), and
   — round 9, alongside the streaming ingest tier — Q20's nested
-  IN-subqueries over streaming-friendly partsupp semantics;
+  IN-subqueries over streaming-friendly partsupp semantics, and — round
+  12, the query profiler's acceptance workload — Q13's customer
+  count-distribution (LEFT join + two-level groupby, its EXPLAIN
+  ANALYZE plan recorded in the bench detail);
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -209,6 +212,15 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
     # baseline rule as the rng2/rng3/rng4 blocks above)
     rng5 = np.random.default_rng(seed + 32452843)
     part["p_name"] = PNAMES[rng5.integers(0, len(PNAMES), n_part)]
+    # Q13 addition (round 12, the profiler's acceptance workload) draws
+    # from a SIXTH independent stream, same regression-baseline rule.
+    # o_comment is a closed two-value vocabulary: the spec's
+    # `NOT LIKE '%special%requests%'` becomes an exact != over the
+    # "special requests" entries (~5% of orders) — the same documented
+    # substring simplification as Q22's phone prefix and Q20's p_name.
+    rng6 = np.random.default_rng(seed + 86028121)
+    orders["o_comment"] = np.where(rng6.random(n_ord) < 0.05,
+                                   "special requests", "ok")
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
             "part": part, "partsupp": partsupp}
@@ -541,6 +553,53 @@ def q12_pandas(pdfs: dict, mode1: str = "MAIL", mode2: str = "SHIP",
          .agg(high_line_count=("high_line", "sum"),
               low_line_count=("low_line", "sum")))
     return g.sort_values("l_shipmode").reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# Q13 — customer distribution (LEFT join + two-level groupby)
+# ---------------------------------------------------------------------------
+
+def q13(dfs: dict, env=None, word: str = "special requests"):
+    """SELECT c_count, count(*) AS custdist FROM (SELECT c_custkey,
+    count(o_orderkey) AS c_count FROM customer LEFT OUTER JOIN orders ON
+    c_custkey = o_custkey AND o_comment NOT LIKE '%special%requests%'
+    GROUP BY c_custkey) GROUP BY c_count ORDER BY custdist DESC, c_count
+    DESC.  The comment filter applies to the RIGHT side before the left
+    join (filtering after would drop the no-order customers the query
+    counts); o_comment is a closed vocabulary so NOT LIKE is an exact !=
+    (documented generator simplification).  count(o_orderkey) counts
+    NON-NULL keys only, so customers whose every order was filtered (or
+    who never ordered) land in the c_count = 0 bucket — the left join's
+    null extension is exactly what the count distribution measures.
+    This is the profiler's acceptance workload: its EXPLAIN ANALYZE plan
+    is recorded in the tpch bench JSON detail (docs/observability.md)."""
+    o = dfs["orders"]
+    o = o[o["o_comment"] != word][["o_custkey", "o_orderkey"]]
+    j = dfs["customer"][["c_custkey"]].merge(
+        o, how="left", left_on="c_custkey", right_on="o_custkey", env=env)
+    per_cust = (j.groupby(["c_custkey"], env=env)
+                .agg([("o_orderkey", "count")])
+                .rename({"o_orderkey_count": "c_count"}))
+    dist = (per_cust.groupby(["c_count"], env=env)
+            .agg([("c_custkey", "count")])
+            .rename({"c_custkey_count": "custdist"}))
+    out = dist.sort_values(["custdist", "c_count"],
+                           ascending=[False, False], env=env)
+    return out[["c_count", "custdist"]]
+
+
+def q13_pandas(pdfs: dict, word: str = "special requests") -> pd.DataFrame:
+    o = pdfs["orders"]
+    o = o[o.o_comment != word][["o_custkey", "o_orderkey"]]
+    j = pdfs["customer"][["c_custkey"]].merge(
+        o, how="left", left_on="c_custkey", right_on="o_custkey")
+    per_cust = (j.groupby("c_custkey", as_index=False)
+                .agg(c_count=("o_orderkey", "count")))
+    dist = (per_cust.groupby("c_count", as_index=False)
+            .agg(custdist=("c_custkey", "count")))
+    return (dist.sort_values(["custdist", "c_count"],
+                             ascending=[False, False])
+            .reset_index(drop=True)[["c_count", "custdist"]])
 
 
 # ---------------------------------------------------------------------------
@@ -1039,7 +1098,7 @@ def q20_pandas(pdfs: dict, name_prefix: str = "forest",
 # ---------------------------------------------------------------------------
 
 def bench_tpch(scale: float = 1.0, iters: int = 3) -> dict:
-    """Runs the 13-query suite at ``scale``; on device OOM the scale halves
+    """Runs the full query suite at ``scale``; on device OOM the scale halves
     (the whole-working-set analog of bench.py's rows halving: TPC-H keeps
     every base table plus query intermediates resident, so past the HBM
     ceiling no operator-level chunking can save a single chip — the
@@ -1142,10 +1201,16 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q10": q10, "q11": q11, "q12": q12, "q14": q14, "q15": q15,
-               "q16": q16, "q17": q17, "q18": q18, "q19": q19, "q20": q20,
-               "q21": q21, "q22": q22}
+               "q10": q10, "q11": q11, "q12": q12, "q13": q13, "q14": q14,
+               "q15": q15, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
+               "q20": q20, "q21": q21, "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
+    # the profiler's acceptance workload (docs/observability.md): one
+    # extra ANALYZE-profiled Q13 run whose plan tree — per-node
+    # rows/bytes/seconds with the phase-table reconciliation block —
+    # rides the bench JSON detail
+    from cylon_tpu import obs
+    q13_plan = obs.explain_analyze(lambda: q13(dfs, env=env).to_pandas())
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"
                   " wall time",
@@ -1171,6 +1236,9 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                       ("checkpoint_events", "bytes_checkpointed",
                        "resume_fast_forwarded_pieces",
                        "resume_resharded_pieces", "resume_world_mismatch")},
+                   # EXPLAIN ANALYZE of Q13 (obs/plan): the plan tree
+                   # with per-node seconds + the reconcile block
+                   "q13_plan": q13_plan.to_dict(),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 

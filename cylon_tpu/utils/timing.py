@@ -109,6 +109,18 @@ class AttributionScope:
     def total_seconds(self) -> float:
         return sum(v[0] for v in self._accum.values())
 
+    def absorb(self, other: "AttributionScope") -> None:
+        """Merge another scope's table into this one — the plan
+        profiler's node scopes shadow an enclosing serving-session scope
+        exactly like nested scopes always did, so on node exit the
+        node's SELF table is absorbed into the session scope: the
+        tenant's fair-share clock and phase table see the same seconds
+        with profiling on or off (obs/plan.py)."""
+        for k, v in other._accum.items():
+            self._add(k, v[0], v[1])
+        for k, b in other._bytes.items():
+            self._add_bytes(k, b)
+
     def snapshot(self) -> dict:
         out = {}
         for k, v in sorted(self._accum.items(), key=lambda kv: -kv[1][0]):

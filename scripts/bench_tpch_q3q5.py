@@ -56,6 +56,7 @@ def main():
     del pdfs
 
     times = {}
+    plans = {}
     for name, fn in (("q3", tpch.q3), ("q5", tpch.q5)):
         def step():
             out = fn(dfs, env=env)
@@ -68,6 +69,10 @@ def main():
             step()
             ts.append(time.perf_counter() - t0)
         times[name] = min(ts)
+        # one extra ANALYZE-profiled run per query: the emitted JSON
+        # carries the plan tree (per-node rows/bytes/seconds + the
+        # phase-table reconcile block) alongside the wall times
+        plans[name] = obs.explain_analyze(step).to_dict()
         print(f"# {name}: {times[name]:.3f}s", flush=True)
 
     print(json.dumps({
@@ -83,6 +88,8 @@ def main():
                    **obs.bench_detail(spill_keys=(
                        "spill_events", "bytes_spilled",
                        "peak_ledger_bytes")),
+                   # EXPLAIN ANALYZE trees, one per query (obs/plan)
+                   "plans": plans,
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }))
 

@@ -1,0 +1,116 @@
+"""EXPLAIN / ANALYZE plan inspector — print and diff saved plan trees.
+
+Usage:
+    python scripts/explain.py PLAN.json            # annotated tree
+    python scripts/explain.py A.json B.json        # diff two runs
+
+Accepts either a raw ``QueryPlan.to_dict()`` payload (what
+``obs.explain_analyze(...).to_dict()`` serializes) or a bench JSON that
+carries one — ``detail.plan`` (bench.py), ``detail.plans.<q>``
+(scripts/bench_tpch_q3q5.py: the first query is shown; name one with
+``A.json:q5``) or ``detail.q13_plan`` (the tpch driver).
+
+The diff aligns the two trees positionally, flags structural divergence
+(a different op or child count means the engine CHOSE a different plan
+— route flips, chunk-count changes), and reports per-node deltas of
+self seconds, rows and exchanged bytes for structurally matching nodes
+— how "the same query got slower" decomposes into "which operator".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cylon_tpu.obs.plan import render_tree  # noqa: E402
+
+
+def load_plan(spec: str) -> dict:
+    """Load a plan payload from ``path`` or ``path:query``."""
+    path, _, qname = spec.partition(":")
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "roots" in doc:
+        return doc
+    det = doc.get("detail", doc)
+    if qname:
+        plans = det.get("plans", {})
+        if qname in plans:
+            return plans[qname]
+        if f"{qname}_plan" in det:
+            return det[f"{qname}_plan"]
+        raise SystemExit(f"no plan for query {qname!r} in {path}")
+    for key in ("plan", "q13_plan"):
+        if key in det:
+            return det[key]
+    plans = det.get("plans")
+    if plans:
+        return plans[sorted(plans)[0]]
+    raise SystemExit(f"no plan payload found in {path}")
+
+
+def _flatten(d: dict, path: str = "") -> list[tuple[str, dict]]:
+    me = f"{path}/{d['op']}"
+    out = [(me, d)]
+    for i, c in enumerate(d.get("children", ())):
+        out.extend(_flatten(c, f"{me}[{i}]"))
+    return out
+
+
+def diff_plans(a: dict, b: dict) -> str:
+    """Human-readable diff of two plan payloads (see module docstring)."""
+    fa = [p for r in a.get("roots", ()) for p in _flatten(r)]
+    fb = [p for r in b.get("roots", ()) for p in _flatten(r)]
+    lines = []
+    n = max(len(fa), len(fb))
+    for i in range(n):
+        if i >= len(fa):
+            lines.append(f"+ only in B: {fb[i][0]}")
+            continue
+        if i >= len(fb):
+            lines.append(f"- only in A: {fa[i][0]}")
+            continue
+        pa, da = fa[i]
+        pb, db = fb[i]
+        if pa != pb or da["op"] != db["op"]:
+            lines.append(f"! structure diverges at #{i}: A={pa} B={pb}")
+            continue
+        attrs_a, attrs_b = da.get("attrs", {}), db.get("attrs", {})
+        for k in sorted(set(attrs_a) | set(attrs_b)):
+            if attrs_a.get(k) != attrs_b.get(k):
+                lines.append(f"! {pa} attr {k}: "
+                             f"{attrs_a.get(k)!r} -> {attrs_b.get(k)!r}")
+        deltas = []
+        for k, fmt in (("self_s", "{:+.4f}s"), ("rows_out", "{:+d}"),
+                       ("bytes_exchanged", "{:+d}B")):
+            va, vb = da.get(k), db.get(k)
+            if va is not None and vb is not None and va != vb:
+                deltas.append(f"{k} " + fmt.format(
+                    (vb - va) if isinstance(va, (int, float)) else 0))
+        if deltas:
+            lines.append(f"  {pa}: " + ", ".join(deltas))
+    ra, rb = a.get("reconcile"), b.get("reconcile")
+    if ra and rb:
+        lines.append(f"total: {ra['phase_s']}s -> {rb['phase_s']}s "
+                     f"({rb['phase_s'] - ra['phase_s']:+.4f}s)")
+    return "\n".join(lines) if lines else "plans are identical"
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) not in (2, 3) or argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    a = load_plan(argv[1])
+    if len(argv) == 2:
+        print(render_tree(a))
+        return 0
+    b = load_plan(argv[2])
+    print(diff_plans(a, b))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -1,0 +1,25 @@
+"""TS113 fixture: plan-node push/pop outside the obs/plan.py
+context-manager facade — operator modules (relational/, exec/, stream/)
+must open plan nodes via ``plan.node(...)``/``plan.annotate(...)``."""
+
+
+def my_operator(table, plan):
+    # flagged: raw push leaves the query-scoped stack unbalanced when a
+    # typed fault unwinds before the matching pop
+    n = plan.push_node("join", {"how": "inner"}, None)
+    out = table
+    # flagged: the raw inverse, same hazard
+    plan.pop_node(n)
+    return out
+
+
+def my_other_operator(push_node):
+    # flagged: bare-name call of the stack primitive
+    push_node("groupby", {}, None)
+
+
+def fine_operator(table, plan):
+    # NOT flagged: the sanctioned context-manager facade
+    with plan.node("sort", by=("k",)) as pn:
+        plan.annotate(route="sample_sort")
+        return table, pn

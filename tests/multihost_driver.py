@@ -265,6 +265,40 @@ rep_sigs = np.atleast_1d(multihost_utils.process_allgather(rep_sig))
 assert len({int(s) for s in rep_sigs}) == 1, (rep, rep_sigs)
 print(f"RANKREPORT_OK pid={pid} phases={len(rep['phases'])}", flush=True)
 
+# Communication matrix (cylon_tpu/obs/comm, docs/observability.md): arm
+# the matrix, run one hash shuffle + one join, and cross-check (a) the
+# cumulative matrix's grand totals equal the always-on exchange
+# counters, (b) the report — which internally allgathers and verifies
+# the matrix — is BYTE-IDENTICAL across ranks (each process accumulated
+# the same replicated count sidecars, so any divergence is a typed
+# RankDesyncError; the crc allgather proves the serialized report
+# matches too).
+from cylon_tpu.obs import comm as _comm, metrics as _metrics
+
+env.barrier()
+_comm.arm()
+_comm.reset()
+_rows0 = _metrics.counter("exchange_rows_total").value
+_bytes0 = _metrics.counter("exchange_bytes_total").value
+join_tables(lt, rt, "k", "k", how="inner")
+crep = _comm.report()   # allgathers + verifies matrix identity itself
+_comm.arm(False)
+m_rows = np.asarray(crep["rows"], np.int64)
+m_bytes = np.asarray(crep["bytes"], np.int64)
+assert crep["world"] == env.world_size, crep["world"]
+assert int(m_rows.sum()) == crep["total_rows"] \
+    == _metrics.counter("exchange_rows_total").value - _rows0
+assert int(m_bytes.sum()) == crep["total_bytes"] \
+    == _metrics.counter("exchange_bytes_total").value - _bytes0
+assert m_bytes.sum(axis=1).tolist() == crep["row_sums_bytes"]
+assert m_bytes.sum(axis=0).tolist() == crep["col_sums_bytes"]
+comm_sig = np.int64(zlib.crc32(_json.dumps(crep, sort_keys=True).encode()))
+comm_sigs = np.atleast_1d(multihost_utils.process_allgather(comm_sig))
+assert len({int(s) for s in comm_sigs}) == 1, (crep, comm_sigs)
+_comm.reset()
+print(f"COMMMATRIX_OK pid={pid} exchanges={crep['exchanges']} "
+      f"rows={crep['total_rows']}", flush=True)
+
 # Streaming window-close determinism (cylon_tpu/stream, docs/
 # streaming.md): both processes ingest the same seeded micro-batches
 # into a TumblingWindowJoin; the watermark min-vote

@@ -150,6 +150,42 @@ class TestHistogram:
         p = h.percentile(50)
         assert p is not None and 0.0 < p < 1.0
 
+    def test_empty_histogram_percentile_is_nan(self):
+        """The edge contract (satellite fix): an EMPTY histogram's
+        quantile is NaN — not None, not whatever np does on an empty
+        array — so reports carry it through arithmetic and JSON."""
+        import math
+        h = metrics.Histogram("t_hist_empty")
+        assert math.isnan(h.percentile(50))
+        assert math.isnan(h.percentile(0)) and math.isnan(h.percentile(100))
+        # the live-exposition property must not raise either — and it
+        # exports the NaN as None so JSON snapshots stay strict-parseable
+        v = h.value
+        assert v["count"] == 0 and v["p50"] is None
+
+    def test_fully_truncated_percentile_is_nan(self, monkeypatch):
+        """Samples observed but NONE retained (cap exhausted before the
+        first observation): bucket interpolation would fabricate a
+        quantile from the grid alone — NaN by contract."""
+        import math
+        monkeypatch.setattr(metrics, "SAMPLE_CAP", 0)
+        h = metrics.Histogram("t_hist_fully_trunc")
+        for x in (0.5, 1.5, 2.5):
+            h.observe(x)
+        assert h.truncated and h.count == 3
+        assert math.isnan(h.percentile(50))
+        assert h.value["p99"] is None
+        # attainment still answers from bucket counts
+        assert h.attainment(100.0) > 0
+
+    def test_percentile_range_is_typed(self):
+        h = metrics.Histogram("t_hist_range")
+        h.observe(1.0)
+        for bad in (-1, 100.5, 1e9):
+            with pytest.raises(InvalidError):
+                h.percentile(bad)
+        assert h.percentile(0) == h.percentile(100) == 1.0
+
 
 class TestExposition:
     def test_prometheus_text_format(self):
@@ -243,6 +279,19 @@ class TestBenchDetail:
                                           "bytes_spilled"),
                               ckpt_keys=(), events=None)
         assert set(bd) == {"window_evictions", "bytes_spilled"}
+
+    def test_plan_section_opt_in(self):
+        """The profiler satellite: bench_detail(plan=...) adds a "plan"
+        section; the default schema (asserted above) stays plan-free."""
+        assert "plan" not in obs.bench_detail()
+        bd = obs.bench_detail(plan={"mode": "analyze", "roots": []})
+        assert bd["plan"] == {"mode": "analyze", "roots": []}
+
+        class _QP:
+            def to_dict(self):
+                return {"mode": "explain", "roots": [{"op": "join"}]}
+        assert obs.bench_detail(plan=_QP())["plan"]["roots"][0]["op"] \
+            == "join"
 
     def test_drain_vs_keep(self):
         from cylon_tpu.exec import recovery

@@ -363,11 +363,42 @@ def test_package_lints_clean():
     assert found == [], "\n".join(map(str, found))
 
 
+def test_ts113_plan_stack_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_plan_push.py"))
+        if f.rule == "TS113"]
+    # push_node, pop_node, bare-name push_node — the context-manager
+    # facade call stays clean
+    assert len(found) == 3, found
+    assert all("obs.plan" in f.message for f in found)
+
+
+def test_ts113_scoping():
+    src = "def f(plan, n):\n    plan.push_node('join', {}, None)\n"
+    # scoped to the operator directories...
+    assert any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/join.py", src))
+    assert any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", src))
+    assert any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/stream/table.py", src))
+    # ...not the rest of the package, and the defining module is exempt
+    assert not any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/obs/plan.py", src))
+    assert not any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/parallel/shuffle.py", src))
+    # the facade itself never flags
+    ok = "def f(plan):\n    with plan.node('join'):\n        pass\n"
+    assert not any(f.rule == "TS113" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/join.py", ok))
+
+
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
-                                       "TS109", "TS110", "TS111", "TS112"}
+                                       "TS109", "TS110", "TS111", "TS112",
+                                       "TS113"}
 
 
 # ---------------------------------------------------------------------------
