@@ -74,6 +74,16 @@ function within the same module) — and flags:
   ``counter``/``group``/``namespace``), whose dict-like views are the
   sanctioned migration shim;
 
+* **TS114** spill-file path construction or raw spill page file IO
+  outside ``exec/memory.py`` — an ``open``/``np.save``/``np.load`` (or
+  an ``os.path.join`` path build) naming a ``.spill`` page, a
+  ``spill_dir`` variable or ``CYLON_TPU_SPILL_DIR``: the disk tier's
+  pages carry IN-MEMORY sha256 hashes, take the bounded IO retry and
+  count demote/promote traffic — ad-hoc page IO elsewhere skips all
+  three, so a resume-era read could adopt a torn page and the ledger's
+  residency picture stops describing reality (the disk-tier analog of
+  TS106 for residency and TS107 for checkpoints);
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -170,6 +180,15 @@ _STREAM_OK_FILES = ("exec/pipeline.py", "exec/memory.py")
 #: (cylon_tpu/obs/metrics — counter/group/namespace); the obs package
 #: itself is the defining module and exempt by construction
 _STATS_NAME_RE = re.compile(r"^_?[A-Z0-9_]*(STATS|COUNTERS|METRICS)$")
+
+#: the one module that may construct spill-file paths or do raw spill
+#: page IO (TS114): the disk tier (exec/memory) hashes every page,
+#: wraps writes/reads in the bounded IO retry and counts the traffic —
+#: ad-hoc page IO elsewhere skips all three
+_SPILL_SANCTIONED_FILE = "exec/memory.py"
+#: a ``.spill`` page-file segment in a string literal (the disk tier's
+#: on-disk naming: ``<owner>.a<j>.s<k>.spill.npy``)
+_SPILL_PAGE_RE = re.compile(r"\.spill(\.|$)")
 
 #: plan-node stack primitives callable ONLY from the obs/plan.py
 #: context-manager facade (TS113): an operator that calls push_node/
@@ -449,6 +468,7 @@ class _ModuleLint:
         self._check_stream_state()
         self._check_stats_dicts()
         self._check_plan_stack()
+        self._check_spill_file_io()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -781,6 +801,41 @@ class _ModuleLint:
                     "balanced across typed-fault unwinds "
                     "(docs/trace_safety.md)")
 
+    def _check_spill_file_io(self) -> None:
+        """TS114: spill-file path construction or raw spill page IO
+        anywhere outside ``exec/memory.py`` — an IO call
+        (``open``/``np.save``/``np.load``/pickle) or an
+        ``os.path.join``-style path build whose argument subtree names a
+        ``.spill`` page, a ``spill_dir`` variable/attribute or the
+        ``CYLON_TPU_SPILL_DIR`` env var.  The disk tier's pages are only
+        safe behind the ledger facade: content-hashed at demote,
+        sha-verified at promote, written/read under the bounded IO
+        retry, and counted in the demote/promote traffic — a direct
+        page read can adopt a torn write, and a direct page write is
+        invisible to the residency accounting (docs/trace_safety.md)."""
+        norm = self.path.replace(os.sep, "/")
+        if norm.endswith(_SPILL_SANCTIONED_FILE):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name(node.func)
+            leaf = fname.split(".")[-1]
+            root = fname.split(".")[0]
+            is_io = (fname == "open"
+                     or (leaf in _CKPT_IO_LEAVES
+                         and root in _NUMPY_MODULES | {"jnp", "pickle"}))
+            if ((is_io or leaf == "join")
+                    and _mentions_spill_path(node)):
+                self._emit(
+                    "TS114", node,
+                    f"`{fname}` constructs or touches a spill page file "
+                    "outside exec/memory.py — disk-tier pages are "
+                    "content-hashed, retried and accounted only behind "
+                    "the ledger facade (demote/promote_host/"
+                    "upload_window); ad-hoc page IO can adopt a torn "
+                    "write and skews the residency picture")
+
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position
         and read after the donating call (see module docstring).  Scans
@@ -957,6 +1012,26 @@ def _mentions_rank_dir(node: ast.Call) -> bool:
     for sub in ast.walk(node):
         if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
                 and _RANK_DIR_LITERAL.search(sub.value)):
+            return True
+    return False
+
+
+def _mentions_spill_path(node: ast.Call) -> bool:
+    """Does the call's argument subtree reference the disk tier's spill
+    pages — a ``.spill`` page-file literal, a ``spill_dir``-named
+    name/attribute, or the ``CYLON_TPU_SPILL_DIR`` env var?  Keyed on
+    the on-disk naming like TS107/TS111, so ordinary uses of the word
+    "spill" (``spill_events``, ``spill_consensus``, ``spilled``) never
+    fire."""
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and ("CYLON_TPU_SPILL_DIR" in sub.value
+                     or _SPILL_PAGE_RE.search(sub.value))):
+            return True
+        if isinstance(sub, ast.Name) and "spill_dir" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) \
+                and "spill_dir" in sub.attr.lower():
             return True
     return False
 

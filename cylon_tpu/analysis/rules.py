@@ -63,6 +63,12 @@ RULES = {
              "open plan nodes via plan.node()/annotate(); a raw "
              "push_node/pop_node call can unbalance the query-scoped "
              "stack and reparent every later operator's tree",
+    "TS114": "spill-file path construction or raw spill page IO outside "
+             "exec/memory.py — disk-tier pages are content-hashed, "
+             "written/read under the bounded IO retry and counted in "
+             "the demote/promote traffic only behind the ledger facade; "
+             "ad-hoc page IO can adopt a torn write and skews the "
+             "residency accounting",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -201,6 +201,22 @@ HBM_BUDGET_BYTES = int(os.environ.get("CYLON_TPU_HBM_BUDGET", "0"))
 #: typed abort).
 SPILL_ENABLED = _env_flag("CYLON_TPU_SPILL", True)
 
+#: Host-side ledger budget for the DISK tier (bytes of host-resident
+#: spill pages across the process; 0 = unlimited, disk tier disarmed).
+#: When device→host evictions push the host-resident spill balance past
+#: this, cold host pages demote to per-rank spill files under
+#: ``CYLON_TPU_SPILL_DIR`` — the residency ladder's final rung
+#: (docs/robustness.md "Disk tier & scan pushdown").  With it unset the
+#: disk tier adds ZERO filesystem writes and zero extra work.
+HOST_BUDGET_BYTES = int(os.environ.get("CYLON_TPU_HOST_BUDGET", "0"))
+
+#: Root directory for the disk tier's per-rank spill page files
+#: (``<dir>/rank<r>/<owner>.a<j>.s<k>.spill.npy``).  Empty = a private
+#: temp directory created lazily on the first demote.  Spill files are
+#: PROCESS-TRANSIENT (unlike checkpoints): their hashes live in memory
+#: and a fresh process never reads a predecessor's files.
+SPILL_DIR = os.environ.get("CYLON_TPU_SPILL_DIR", "")
+
 #: Exchange watchdog deadline in seconds (0 = off, the default): blocking
 #: multihost exchange host-syncs run under this timeout and a peer hang
 #: surfaces as a typed RankDesyncError (site + last-known phase attached)

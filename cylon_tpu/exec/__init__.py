@@ -4,7 +4,8 @@ SURVEY.md §2 C9)."""
 
 from ..relational.piece import PackedPiece, PieceSource  # noqa: F401
 from .pipeline import (GroupBySink, chunk_table,  # noqa: F401
-                       pipelined_join, pipelined_set_op)
+                       pipelined_join, pipelined_scan_join,
+                       pipelined_set_op)
 from . import checkpoint  # noqa: F401  — durable checkpoint/resume rung
 from . import memory  # noqa: F401  — HBM budget ledger + host spill tier
 from . import preempt  # noqa: F401  — SIGTERM preemption-grace drain

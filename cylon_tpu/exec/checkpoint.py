@@ -478,13 +478,21 @@ class Stage:
                "world": int(self.env.world_size), "procs": _procs(),
                "pieces": {str(k): v for k, v in self.committed.items()}}
         staged = self._manifest_path + ".staged"
-        with open(staged, "w", encoding="utf-8") as f:
-            json.dump(man, f)
-            f.flush()
-            os.fsync(f.fileno())
+
+        def stage_write():
+            with open(staged, "w", encoding="utf-8") as f:
+                json.dump(man, f)
+                f.flush()
+                os.fsync(f.fileno())
+
+        # bounded IO retry (exec/recovery.retry_io): a transient OSError
+        # on shared storage — an NFS blip during a GKE drain — used to
+        # abort a drain a 3-attempt backoff saves
+        recovery.retry_io(stage_write, "ckpt.write")
         recovery.ckpt_commit_consensus(getattr(self.env, "mesh", None),
                                        self.epoch)
-        os.replace(staged, self._manifest_path)
+        recovery.retry_io(lambda: os.replace(staged, self._manifest_path),
+                          "ckpt.write")
 
     def has_piece(self, i: int) -> bool:
         return int(i) in self.committed
@@ -582,9 +590,16 @@ class Stage:
     def _atomic_write(self, fname: str, raw: bytes) -> None:
         path = os.path.join(self.dir, fname)
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(raw)
-        os.replace(tmp, path)
+
+        def write():
+            with open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+
+        # page writes share the checkpoint tier's bounded transient-
+        # OSError backoff (exec/recovery.retry_io) with the disk tier
+        from . import recovery
+        recovery.retry_io(write, "ckpt.write")
 
     # -- load (resume fast-forward) ----------------------------------------
     def load_piece(self, i: int):

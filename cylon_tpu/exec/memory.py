@@ -55,26 +55,64 @@ mechanism a training stack uses for activation offload:
    free resident bytes without discarding any completed work; chunk
    escalation remains the backstop (docs/robustness.md).
 
+5. **Disk tier** (the residency ladder's FINAL rung — docs/robustness.md
+   "Disk tier & scan pushdown"): a second, HOST-side budget
+   (``CYLON_TPU_HOST_BUDGET``) bounds the host-resident spill pages.
+   When device→host evictions push the host balance past it, cold host
+   pages DEMOTE to per-rank spill files under ``CYLON_TPU_SPILL_DIR``
+   (one ``.spill.npy`` page per array per addressable shard, sha256 over
+   the page content — the same bit-exact round-trip contract as
+   checkpoints, except spill pages are PROCESS-transient: hashes live in
+   memory and a fresh process never reads a predecessor's files).
+   Promotion is ON-TOUCH: a piece access of a disk-resident source
+   verifies the owner's pages once (full sequential read, streamed —
+   never the whole working set in RAM) and then windows read straight
+   off memory-mapped pages through the same :func:`upload_window`
+   double-buffering the host tier uses, so piece r+1's disk reads
+   overlap piece r's compute.  Demote decisions ride the SAME
+   rank-coherent count-consensus wire as evictions (same owners, same
+   order on every rank).  Robustness: page writes/reads take the bounded
+   IO retry (:func:`cylon_tpu.exec.recovery.retry_io`); a failed or
+   ENOSPC'd demotion degrades to keeping the page host-resident (typed
+   recovery event, never a crash); a corrupt page on promote surfaces as
+   a typed :class:`~cylon_tpu.status.CheckpointCorruptError` at site
+   ``disk.read`` and the ladder recomputes that owner's stage (never a
+   wrong answer); a stalled page transfer surfaces via the exchange
+   watchdog as a typed RankDesyncError.  Injector sites ``disk.write``
+   (kinds ``corrupt``/``stall``/``enospc``/``kill``) and ``disk.read``
+   (``corrupt``/``stall``) make every path testable on the CPU rig.
+
 Escape hatches: ``CYLON_TPU_SPILL=0`` disables eviction entirely (the
 ledger keeps accounting); ``CYLON_TPU_HBM_BUDGET`` overrides the
 detected budget.  With spill disabled and no faults armed, the happy
 path through :func:`ensure_headroom` is a couple of dict lookups — no
-collectives, no host syncs.
+collectives, no host syncs; with ``CYLON_TPU_HOST_BUDGET`` unset the
+disk tier adds ZERO filesystem writes (asserted in tests/test_memory.py
+and the chaos ``--oocore`` happy-path leg).
 
-Trace-safety note (TS106): this module is the ONE sanctioned place that
-changes residency of lane-sized arrays — a bare
+Trace-safety notes: this module is the ONE sanctioned place that
+changes residency of lane-sized arrays (TS106) — a bare
 ``jax.device_put``/``jax.device_get`` in ``relational/`` or
-``parallel/`` bypasses the ledger and is a lint finding.
+``parallel/`` bypasses the ledger and is a lint finding — AND the one
+sanctioned place that constructs spill-file paths or does raw spill
+page IO (TS114): a direct ``open``/``np.save`` of a spill page
+elsewhere would skip the sha contract, the bounded IO retry and the
+demote/promote accounting.
 """
 
 from __future__ import annotations
 
+import errno
+import hashlib
+import os
+import re
 import threading
 import weakref
 
 import numpy as np
 
 from .. import config
+from ..status import CheckpointCorruptError
 from ..utils import timing
 
 #: injector kinds at the spill sites that RAISE as typed faults (the
@@ -149,7 +187,8 @@ class Registration:
     eviction pull and the re-upload collective-free."""
 
     __slots__ = ("owner", "nbytes", "spillable", "seq", "arrays", "host",
-                 "sharding", "world", "live", "session", "__weakref__")
+                 "disk", "disk_ok", "disk_views", "sharding", "world",
+                 "live", "session", "__weakref__")
 
     def __init__(self, owner: str, arrays, spillable: bool, sharding,
                  seq: int):
@@ -169,11 +208,25 @@ class Registration:
                       if sharding is not None else 1)
         self.seq = seq
         self.host: tuple | None = None
+        #: disk-tier page table while demoted (per-array tuples of
+        #: per-shard ``{"path", "sha", "nbytes"}`` entries, None for
+        #: remote shards); ``disk_ok`` records the one on-touch sha
+        #: verification per demote cycle (windows mmap after it), and
+        #: ``disk_views`` caches the post-verification mmap views so a
+        #: P-piece loop opens each page once, not P times
+        self.disk: tuple | None = None
+        self.disk_ok = False
+        self.disk_views: tuple | None = None
         self.live = True
 
     @property
     def spilled(self) -> bool:
-        return self.host is not None
+        """Off-device: host-resident (spill tier) OR disk-resident."""
+        return self.host is not None or self.disk is not None
+
+    @property
+    def on_disk(self) -> bool:
+        return self.disk is not None
 
 
 class Ledger:
@@ -200,6 +253,14 @@ class Ledger:
         with self._lock:
             return sum(r.nbytes for r in self._live.values()
                        if r.spillable and not r.spilled)
+
+    def host_balance(self) -> int:
+        """Bytes of live registrations currently HOST-resident (spilled
+        to RAM, not yet demoted to disk) — the disk tier's budget
+        predicate (``CYLON_TPU_HOST_BUDGET``)."""
+        with self._lock:
+            return sum(r.nbytes for r in self._live.values()
+                       if r.host is not None)
 
     def owners(self) -> list[str]:
         with self._lock:
@@ -235,8 +296,9 @@ class Ledger:
             reg.seq = self._seq
 
     def release(self, reg: Registration | None) -> None:
-        """Drop a registration (idempotent): device and host copies are
-        unpinned and the balance drains — never below zero."""
+        """Drop a registration (idempotent): device, host and disk
+        copies are unpinned (spill page files deleted best-effort) and
+        the balance drains — never below zero."""
         if reg is None or not reg.live:
             return
         with self._lock:
@@ -244,6 +306,11 @@ class Ledger:
             self._live.pop(reg.owner, None)
             reg.arrays = ()
             reg.host = None
+            disk, reg.disk = reg.disk, None
+            reg.disk_ok = False
+            reg.disk_views = None
+        if disk is not None:
+            _remove_disk_pages(disk)
 
     # -- spill tier --------------------------------------------------------
     def evict(self, reg: Registration, stall: bool = False) -> int:
@@ -276,12 +343,16 @@ class Ledger:
     def readmit(self, reg: Registration, stall: bool = False) -> tuple:
         """Re-upload a spilled registration's FULL arrays to the device
         (the whole-matrix complement of the per-window
-        :func:`upload_window` path) and return them.  Not on the
-        overlap-critical path, so with ``CYLON_TPU_WATCHDOG_S`` armed
-        the readiness check blocks under the watchdog — a hung transfer
-        surfaces typed at ``spill.upload``."""
+        :func:`upload_window` path) and return them.  A DISK-resident
+        registration first promotes its pages back to host (sha-verified
+        full read, :meth:`promote_host`).  Not on the overlap-critical
+        path, so with ``CYLON_TPU_WATCHDOG_S`` armed the readiness check
+        blocks under the watchdog — a hung transfer surfaces typed at
+        ``spill.upload``."""
         if not (reg.live and reg.spilled):
             return reg.arrays
+        if reg.host is None:
+            self.promote_host(reg, stall=stall)
         arrs = _upload(list(reg.host), reg.sharding, stall=stall)
         if config.EXCHANGE_WATCHDOG_S > 0 and not stall:
             import jax
@@ -299,6 +370,221 @@ class Ledger:
         _STATS["bytes_readmitted"] += reg.nbytes
         timing.add_bytes("spill.upload", reg.nbytes)
         return reg.arrays
+
+    # -- disk tier (host → spill files → back) -----------------------------
+    def demote(self, reg: Registration, stall: bool = False) -> int:
+        """Move one HOST-resident registration's pages to per-rank spill
+        files — the residency ladder's final rung.  One ``.spill.npy``
+        page per array per addressable shard, sha256 over the page
+        content recorded in the (in-memory) page table; writes take the
+        bounded IO retry.  Returns the bytes moved off host RAM.
+
+        Degrades, never crashes: a write that still fails after the
+        retry budget (ENOSPC, quota, a dead disk) abandons the demotion
+        — partial pages are deleted, the registration STAYS
+        host-resident, and a typed ``disk.write`` recovery event records
+        the degrade.  An injected ``stall`` (or a real hang surfaced the
+        same way) raises typed through the exchange watchdog; ``corrupt``
+        flips a byte of the first page AFTER hashing so the promote-side
+        verification catches it; ``kill`` is the chaos harness's
+        mid-demote crash."""
+        if not (reg.live and reg.host is not None):
+            return 0
+        from . import recovery
+        kind = recovery.maybe_inject(
+            "disk.write", intercept=("corrupt", "stall", "enospc"))
+        root = _rank_spill_dir()
+        safe = _safe_owner(reg.owner)
+        written: list[str] = []
+        first = [True]
+
+        def write_all():
+            out = []
+            for j, blocks in enumerate(reg.host):
+                per = []
+                for k, blk in enumerate(blocks):
+                    if blk is None:
+                        per.append(None)
+                        continue
+                    path = os.path.join(root, f"{safe}.a{j}.s{k}.spill.npy")
+                    if kind == "enospc" and first[0]:
+                        raise OSError(errno.ENOSPC,
+                                      "injected ENOSPC mid-demote")
+                    sha = _sha_arr(blk)
+                    recovery.retry_io(lambda p=path, b=blk: np.save(p, b),
+                                      "disk.write", on_retry=_note_retry)
+                    written.append(path)
+                    if kind == "corrupt" and first[0]:
+                        # flip a DATA byte after hashing: the promote
+                        # verification must catch it (the acceptance
+                        # path for corrupt-on-promote → recompute)
+                        _flip_last_byte(path)
+                    first[0] = False
+                    per.append({"path": path, "sha": sha,
+                                "nbytes": int(blk.nbytes)})
+                out.append(tuple(per))
+            return tuple(out)
+
+        try:
+            with timing.region("disk.write"):
+                if stall or kind == "stall":
+                    meta = recovery.exchange_watchdog(
+                        "disk.write", write_all,
+                        timeout_s=_stall_timeout(True), stalled=True)
+                else:
+                    meta = write_all()
+        except OSError as e:
+            _remove_paths(written)
+            is_enospc = e.errno == errno.ENOSPC
+            _DSTATS["write_degrades"] += 1
+            recovery._record("disk.write",
+                             "enospc" if is_enospc else "os_error",
+                             "degrade_in_memory")
+            from ..utils.logging import log
+            log.warning("memory: demotion of %s to disk failed (%s); page "
+                        "stays host-resident — degraded, not crashed",
+                        reg.owner, e)
+            return 0
+        except BaseException:
+            # typed stall/desync (or anything else) propagates — but the
+            # pages already written must not strand on disk (best-effort:
+            # a watchdogged writer thread may still be mid-write; the
+            # first-use purge above is the backstop)
+            _remove_paths(list(written))
+            raise
+        with self._lock:
+            reg.disk = meta
+            reg.host = None
+            reg.disk_ok = False
+            reg.disk_views = None
+        moved = sum(e["nbytes"] for per in meta for e in per
+                    if e is not None)
+        _DSTATS["events"] += 1
+        _DSTATS["bytes_demoted"] += moved
+        # counted only on SUCCESS: a degraded demotion wrote no durable
+        # pages the accounting should claim
+        _DSTATS["pages_demoted"] += sum(1 for per in meta for e in per
+                                        if e is not None)
+        _DEMOTION_LOG.append(reg.owner)
+        timing.add_bytes("disk.write", moved)
+        timing.bump("memory.disk.demote")
+        from ..utils.logging import log
+        log.info("memory: %s -> disk (%d B, %s)", reg.owner, moved, root)
+        return moved
+
+    def verify_disk(self, reg: Registration, stall: bool = False) -> None:
+        """The on-touch promotion gate: sha-verify EVERY page of a
+        disk-resident registration once per demote cycle (streamed —
+        one page in RAM at a time), after which window reads mmap the
+        pages directly.  A mismatch (or an injected ``corrupt`` at site
+        ``disk.read``) retires the poisoned owner (released, files
+        deleted) and raises a typed :class:`CheckpointCorruptError` —
+        the recovery ladder recomputes that owner's stage; corruption
+        degrades to recompute, never to a wrong answer."""
+        if reg.disk is None or reg.disk_ok:
+            return
+        from . import recovery
+        kind = recovery.maybe_inject("disk.read",
+                                     intercept=("corrupt", "stall"))
+
+        def check():
+            if kind == "corrupt":
+                raise CheckpointCorruptError(
+                    "injected spill-page corruption on promote",
+                    site="disk.read")
+            for per in reg.disk:
+                for ent in per:
+                    if ent is None:
+                        continue
+                    arr = _read_page(ent["path"])
+                    if _sha_arr(arr) != ent["sha"]:
+                        raise CheckpointCorruptError(
+                            f"spill page {ent['path']} failed its "
+                            "content-hash check (torn write or on-disk "
+                            "corruption)", site="disk.read")
+
+        try:
+            with timing.region("disk.read"):
+                if stall or kind == "stall":
+                    recovery.exchange_watchdog(
+                        "disk.read", check,
+                        timeout_s=_stall_timeout(True), stalled=True)
+                else:
+                    check()
+        except CheckpointCorruptError:
+            _DSTATS["corrupt_degrades"] += 1
+            recovery._record("disk.read", "corrupt", "recompute_owner")
+            self.release(reg)
+            raise
+        reg.disk_ok = True
+
+    def promote_host(self, reg: Registration, stall: bool = False) -> None:
+        """Full disk → host promotion (sha-verified): read every page
+        back into host block lists and delete the spill files — the
+        whole-owner complement of the per-window mmap reads."""
+        if reg.disk is None:
+            return
+        self.verify_disk(reg, stall=stall)
+        moved = 0
+        with timing.region("disk.read"):
+            hosts = []
+            for per in reg.disk:
+                blocks: list = []
+                for ent in per:
+                    if ent is None:
+                        blocks.append(None)
+                        continue
+                    arr = _read_page(ent["path"])
+                    blocks.append(arr)
+                    moved += int(arr.nbytes)
+                    _DSTATS["pages_promoted"] += 1
+                hosts.append(blocks)
+        with self._lock:
+            disk, reg.disk = reg.disk, None
+            reg.host = tuple(hosts)
+            reg.disk_ok = False
+            reg.disk_views = None
+        _remove_disk_pages(disk)
+        _DSTATS["events"] += 1
+        _DSTATS["bytes_promoted"] += moved
+        timing.add_bytes("disk.read", moved)
+        timing.bump("memory.disk.promote")
+
+    def _demote_cands(self) -> list[Registration]:
+        """Host-resident entries, oldest ``seq`` first — the
+        deterministic LRU demotion order (mirrors :meth:`_spill_cands`
+        one rung down)."""
+        with self._lock:
+            return sorted((r for r in self._live.values()
+                           if r.host is not None), key=lambda r: r.seq)
+
+    def demote_count_for(self, budget: int) -> int:
+        """How many LRU demotions bring the host balance under the host
+        budget — the number, not the balance, is what multiprocess
+        sessions agree on (max across ranks), exactly like
+        :meth:`evict_count_for` one rung up."""
+        if budget <= 0:
+            return 0
+        bal = self.host_balance()
+        if bal <= budget:
+            return 0
+        n = 0
+        for r in self._demote_cands():
+            n += 1
+            bal -= r.nbytes
+            if bal <= budget:
+                break
+        return n
+
+    def demote_n(self, n: int) -> list[str]:
+        """Demote the ``n`` oldest host-resident entries (fewer if the
+        ledger has fewer candidates).  Returns the demoted owner names
+        in demotion order — identical on every rank by construction."""
+        out: list[str] = []
+        for reg in self._demote_cands()[:max(int(n), 0)]:
+            if self.demote(reg):
+                out.append(reg.owner)
+        return out
 
     def _spill_cands(self) -> list[Registration]:
         """Spillable, still-resident entries, oldest ``seq`` first — the
@@ -435,6 +721,168 @@ def spillable_bytes() -> int:
     return _LEDGER.spillable_bytes()
 
 
+def host_balance() -> int:
+    return _LEDGER.host_balance()
+
+
+def demote(reg) -> int:
+    return _LEDGER.demote(reg)
+
+
+def promote_host(reg) -> None:
+    _LEDGER.promote_host(reg)
+
+
+# ---------------------------------------------------------------------------
+# disk tier plumbing (TS114: the ONE sanctioned spill-file IO site)
+# ---------------------------------------------------------------------------
+
+def _disk_armed() -> bool:
+    """The disk tier engages only when a host budget is configured (and
+    spilling is on) — rank-uniform by construction (config, not a
+    balance read), so consensus-poll gating may key on it."""
+    return config.SPILL_ENABLED and config.HOST_BUDGET_BYTES > 0
+
+
+_SPILL_ROOT: list[str] = []  # [path] once resolved; empty = not yet
+
+
+def spill_root() -> str:
+    """The spill-file root: ``CYLON_TPU_SPILL_DIR``, else a private temp
+    directory created lazily on the first demote (so an unarmed run
+    never touches the filesystem)."""
+    if config.SPILL_DIR:
+        return config.SPILL_DIR
+    if not _SPILL_ROOT:
+        import tempfile
+        _SPILL_ROOT.append(tempfile.mkdtemp(prefix="cylon_tpu_spill_"))
+    return _SPILL_ROOT[0]
+
+
+_PURGED_DIRS: set = set()
+
+
+def _rank_spill_dir() -> str:
+    """This process's per-rank spill directory (created on demand).  On
+    FIRST use of a given directory this process purges any ``.spill.npy``
+    orphans a crashed/killed predecessor left behind: spill pages are
+    process-transient by contract (hashes live in memory — a fresh
+    process never reads a predecessor's files), so without the purge a
+    fixed ``CYLON_TPU_SPILL_DIR`` volume would accumulate orphans run
+    over run until a real ENOSPC degrades every future demotion.
+    (Concurrent processes of the SAME rank must use distinct spill
+    roots — the default private temp dir does — since owner names
+    repeat across processes.)"""
+    import glob as _glob
+    import jax
+    d = os.path.join(spill_root(), f"rank{jax.process_index()}")
+    os.makedirs(d, exist_ok=True)
+    if d not in _PURGED_DIRS:
+        _PURGED_DIRS.add(d)
+        _remove_paths(_glob.glob(os.path.join(d, "*.spill.npy")))
+    return d
+
+
+_SAFE_OWNER_RE = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def _safe_owner(owner: str) -> str:
+    return _SAFE_OWNER_RE.sub("_", owner)
+
+
+def _sha_arr(a) -> str:
+    """sha256 over an array's raw content bytes — the spill pages' half
+    of the checkpoint tier's bit-exact round-trip contract."""
+    return hashlib.sha256(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
+def _flip_last_byte(path: str) -> None:
+    """Corrupt a written page in place (injection support): XOR the LAST
+    file byte — data, not the npy header — after the content hash was
+    computed over the good bytes."""
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _read_page(path: str):
+    """One page file → array, under the bounded IO retry; a page that is
+    still unreadable after the budget surfaces as the same typed
+    corruption the hash check raises (an absent page IS corruption of
+    the owner's disk state).  ValueError/EOFError cover the TORN-page
+    shapes np.load raises itself (truncated data → reshape mismatch,
+    truncated npy header) — a torn write must end typed → recompute,
+    never an unhandled crash."""
+    from . import recovery
+    try:
+        return recovery.retry_io(lambda: np.load(path), "disk.read",
+                                 on_retry=_note_retry)
+    except (OSError, ValueError, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"spill page {path} unreadable or torn: {e}",
+            site="disk.read") from e
+
+
+def _mmap_page(path: str):
+    """Memory-mapped page view for window reads (post-verification):
+    row slices touch only the pages the window covers — the disk tier's
+    out-of-core read path.  Same torn-page conversion as
+    :func:`_read_page` (a too-short file fails the mmap length check
+    with ValueError)."""
+    from . import recovery
+    try:
+        return recovery.retry_io(lambda: np.load(path, mmap_mode="r"),
+                                 "disk.read", on_retry=_note_retry)
+    except (OSError, ValueError, EOFError) as e:
+        raise CheckpointCorruptError(
+            f"spill page {path} unreadable or torn: {e}",
+            site="disk.read") from e
+
+
+def _remove_paths(paths) -> None:
+    for p in paths:
+        try:
+            os.remove(p)
+        except OSError:
+            pass  # best-effort cleanup; a leftover file is never re-read
+
+
+def _remove_disk_pages(disk) -> None:
+    _remove_paths(e["path"] for per in disk for e in per if e is not None)
+
+
+def _note_retry() -> None:
+    _DSTATS["retries"] += 1
+
+
+def _maybe_demote(env, multi: bool) -> None:
+    """Host-budget admission (the disk tier's analog of the eviction
+    poll above): when the host-resident spill balance exceeds
+    ``CYLON_TPU_HOST_BUDGET``, demote the agreed COUNT of LRU host pages
+    to spill files.  The count rides the same one-int32 consensus wire
+    as evictions in multiprocess sessions (the poll gate —
+    :func:`_disk_armed` — is config, rank-uniform by construction), so
+    every rank demotes the same owners in the same order.  Unarmed: one
+    attribute read, no filesystem, no collectives."""
+    if not _disk_armed():
+        return
+    want = _LEDGER.demote_count_for(config.HOST_BUDGET_BYTES)
+    if multi:
+        from . import recovery
+        mesh = getattr(env, "mesh", env)
+        want = recovery.count_consensus(mesh, want)
+    if want <= 0:
+        return
+    demoted = _LEDGER.demote_n(want)
+    if demoted:
+        from ..utils.logging import log
+        log.warning("memory: demoted %s to disk under host pressure "
+                    "(host %d B, host budget %d B)", demoted,
+                    _LEDGER.host_balance(), config.HOST_BUDGET_BYTES)
+
+
 def ensure_headroom(env, need: int, scratch: int = 0,
                     site: str = "spill.evict", reuse: int = 0) -> None:
     """Admission control for a new resident allocation of ``need`` bytes
@@ -485,15 +933,18 @@ def ensure_headroom(env, need: int, scratch: int = 0,
     if multi:
         mesh = getattr(env, "mesh", env)
         want = recovery.count_consensus(mesh, want)
-    if want <= 0:
-        return
-    stall = kind in ("stall", "spill_stall")
-    evicted = _LEDGER.evict_n(want, stall=stall)
-    if evicted:
-        from ..utils.logging import log
-        log.warning("memory: evicted %s to host under pressure "
-                    "(balance %d B, budget %d B)", evicted,
-                    _LEDGER.balance(), b)
+    if want > 0:
+        stall = kind in ("stall", "spill_stall")
+        evicted = _LEDGER.evict_n(want, stall=stall)
+        if evicted:
+            from ..utils.logging import log
+            log.warning("memory: evicted %s to host under pressure "
+                        "(balance %d B, budget %d B)", evicted,
+                        _LEDGER.balance(), b)
+    # disk-tier rung: evictions above may have pushed the HOST balance
+    # past CYLON_TPU_HOST_BUDGET — demote cold host pages to spill files
+    # (count-consensus'd like the evictions; no-op unarmed)
+    _maybe_demote(env, multi)
 
 
 def spill_for_retry() -> int:
@@ -516,6 +967,14 @@ def spill_for_retry() -> int:
                        key=lambda r: r.seq)
     for reg in cands:
         freed += _LEDGER.evict(reg)
+    # the rung's evictions can overrun the HOST budget too: demote the
+    # deterministic LRU overflow to disk.  No extra consensus — the
+    # take-the-rung decision was already agreed (spill_consensus) and
+    # the demote set is a pure function of the rank-uniform ledger (a
+    # straggling-GC shortfall only shortens a rank-local file write,
+    # never a collective).
+    if _disk_armed():
+        _LEDGER.demote_n(_LEDGER.demote_count_for(config.HOST_BUDGET_BYTES))
     return freed
 
 
@@ -663,15 +1122,39 @@ def upload_window(reg: Registration, starts, window: int):
     content is byte-identical to the resident path's dynamic slice, so
     packed joins over uploaded windows are bit-equal to unspilled runs.
     Uploads are async dispatches: the pipelined range loop prefetches
-    piece r+1's windows so this overlaps piece r's compute."""
+    piece r+1's windows so this overlaps piece r's compute.
+
+    DISK-resident registrations promote ON TOUCH: the first window
+    access after a demote sha-verifies the owner's pages once
+    (:meth:`Ledger.verify_disk` — a mismatch degrades that owner to
+    recompute, typed, never a wrong answer), and every window then
+    reads its rows straight off MEMORY-MAPPED pages — only the touched
+    rows come off disk, so the working set never rematerializes in host
+    RAM, and the same prefetch double-buffering overlaps the disk reads
+    with piece compute."""
     if not reg.spilled:
         raise ValueError(f"{reg.owner} is device-resident; slice in-program")
     _LEDGER.touch(reg)
     starts = np.asarray(starts, np.int64)
     window = int(window)
+    from_disk = reg.host is None
+    if from_disk:
+        _LEDGER.verify_disk(reg)
+        sources = reg.disk_views
+        if sources is None:
+            # one mmap open per page per demote CYCLE (not per window):
+            # the views stay valid until promote/release/re-demote,
+            # which clear the cache
+            with timing.region("disk.read"):
+                sources = tuple(
+                    [None if ent is None else _mmap_page(ent["path"])
+                     for ent in per] for per in reg.disk)
+            reg.disk_views = sources
+    else:
+        sources = reg.host
     outs = []
     with timing.region("spill.upload"):
-        for blocks in reg.host:
+        for blocks in sources:
             wins: list = [None] * len(blocks)
             for i, blk in enumerate(blocks):
                 if blk is None:     # remote shard: another process's block
@@ -687,6 +1170,10 @@ def upload_window(reg: Registration, starts, window: int):
     moved = _nbytes(devs)
     _STATS["readmit_events"] += 1
     _STATS["bytes_readmitted"] += moved
+    if from_disk:
+        _DSTATS["events"] += 1
+        _DSTATS["bytes_promoted"] += moved
+        timing.add_bytes("disk.read", moved)
     timing.add_bytes("spill.upload", moved)
     return devs
 
@@ -706,16 +1193,33 @@ _STATS = _metrics.group("memory", (
     "donated_bytes_reused", "cross_session_evictions",
     "window_evictions"))
 
+#: disk-tier counters (registry names ``memory_disk_*``): demote/promote
+#: events and page/byte traffic, bounded-IO retries taken at the disk
+#: sites, corrupt-page degrades (owner recomputed) and write degrades
+#: (ENOSPC/exhausted-retry demotions that stayed in memory)
+_DSTATS = _metrics.group("memory_disk", (
+    "events", "pages_demoted", "pages_promoted",
+    "bytes_demoted", "bytes_promoted",
+    "retries", "corrupt_degrades", "write_degrades"))
+
 _metrics.gauge("memory_ledger_bytes",
                help="current resident-ledger balance (bytes)",
                fn=lambda: _LEDGER.balance())
 _metrics.gauge("memory_peak_ledger_bytes",
                help="resident-ledger high-water mark (bytes)",
                fn=lambda: _LEDGER.peak)
+_metrics.gauge("memory_host_ledger_bytes",
+               help="host-resident spill-page balance (bytes) — the "
+                    "disk tier's CYLON_TPU_HOST_BUDGET predicate",
+               fn=lambda: _LEDGER.host_balance())
 
 #: owners in eviction order since the last reset — the multihost driver
 #: asserts this sequence is IDENTICAL across ranks
 _EVICTION_LOG: list[str] = []
+
+#: owners in DEMOTION (host→disk) order since the last reset — the disk
+#: tier's rank-coherence audit, mirroring the eviction log one rung down
+_DEMOTION_LOG: list[str] = []
 
 
 def _note_spill(site: str, reg: Registration) -> None:
@@ -742,20 +1246,44 @@ def stats() -> dict:
     ``cross_session_evictions`` (one tenant's registrations evicted under
     another tenant's — or the scheduler's — admission pressure),
     ``window_evictions`` (closed event-time windows retired through the
-    device→host→released lifecycle, :func:`evict_release`) and
-    ``peak_ledger_bytes`` (high-water resident balance)."""
+    device→host→released lifecycle, :func:`evict_release`),
+    ``peak_ledger_bytes`` (high-water resident balance) — plus the DISK
+    tier block: ``disk_events`` (demote/promote operations),
+    ``bytes_to_disk``/``bytes_from_disk``, per-page
+    ``disk_pages_demoted``/``disk_pages_promoted``, ``disk_retries``
+    (bounded-IO retries at the disk sites), ``disk_corrupt_degrades``
+    (owners retired to recompute after a failed page hash) and
+    ``disk_write_degrades`` (demotions that stayed in memory after an
+    ENOSPC or exhausted retry budget)."""
     return dict(_STATS, peak_ledger_bytes=_LEDGER.peak,
-                ledger_bytes=_LEDGER.balance())
+                ledger_bytes=_LEDGER.balance(),
+                host_ledger_bytes=_LEDGER.host_balance(),
+                disk_events=_DSTATS["events"],
+                bytes_to_disk=_DSTATS["bytes_demoted"],
+                bytes_from_disk=_DSTATS["bytes_promoted"],
+                disk_pages_demoted=_DSTATS["pages_demoted"],
+                disk_pages_promoted=_DSTATS["pages_promoted"],
+                disk_retries=_DSTATS["retries"],
+                disk_corrupt_degrades=_DSTATS["corrupt_degrades"],
+                disk_write_degrades=_DSTATS["write_degrades"])
 
 
 def eviction_log() -> list[str]:
     return list(_EVICTION_LOG)
 
 
+def demotion_log() -> list[str]:
+    return list(_DEMOTION_LOG)
+
+
 def reset_stats() -> None:
-    """Zero the counters, the eviction log and the peak high-water mark
-    (live registrations are untouched — their handles stay valid)."""
+    """Zero the counters, the eviction/demotion logs and the peak
+    high-water mark (live registrations are untouched — their handles
+    stay valid)."""
     for k in _STATS:
         _STATS[k] = 0
+    for k in _DSTATS:
+        _DSTATS[k] = 0
     _EVICTION_LOG.clear()
+    _DEMOTION_LOG.clear()
     _LEDGER.peak = _LEDGER.balance()

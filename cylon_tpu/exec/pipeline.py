@@ -423,6 +423,111 @@ class GroupBySink:
 
 
 # ---------------------------------------------------------------------------
+# scan-pushdown join: stream an out-of-core input straight into the loop
+# ---------------------------------------------------------------------------
+
+def pipelined_scan_join(scan, build: Table, scan_on, build_on,
+                        how: str = "inner", suffixes=("_x", "_y"),
+                        sink=None):
+    """Feed a streaming scan (``io.scan_parquet_dist`` — row-group
+    batches) DIRECTLY into the pipelined join/groupby loop: the build
+    side shuffles ONCE and stays resident; each scan batch is admitted
+    against the ledger, shuffled, joined against the resident build and
+    consumed (``sink`` absorbs and the batch is released) — so the scan
+    side never materializes at full size and the input of an
+    out-of-core query never enters the ledger beyond one batch
+    (asserted via ``memory.ledger().peak`` in tests/test_io.py).  This
+    is the reference's read→partition→operate streaming stack (SURVEY
+    §3.5, distributed_io.py:146) on the TPU pipeline.
+
+    Completeness argument: batches partition the scan's ROWS, and every
+    scan row's matches live entirely in the resident build — so
+    ``inner`` and ``left`` (left = scan side) are complete per batch
+    and their union over batches is the full join.  ``right``/``outer``
+    would need cross-batch unmatched-build bookkeeping and are typed
+    errors here (use :func:`pipelined_join` on a materialized read).
+    Dictionary-encoded KEY columns are typed errors too: their codes
+    are per-batch, so hash colocation against the once-shuffled build
+    would silently diverge — numeric keys (the fact-table case) promote
+    batch-independently and are supported."""
+    from ..status import CylonIOError
+    if how not in ("inner", "left"):
+        raise InvalidError(
+            "pipelined_scan_join supports how in ('inner','left'): "
+            "right/outer need cross-batch unmatched-build bookkeeping — "
+            "materialize the read and use pipelined_join instead")
+    scan_on = [scan_on] if isinstance(scan_on, str) else list(scan_on)
+    build_on = [build_on] if isinstance(build_on, str) else list(build_on)
+    env = build.env
+    from ..utils import timing
+    from . import memory, scheduler
+    with _plan.node("pipelined_scan_join", how=how,
+                    sink=(type(sink).__name__ if sink is not None
+                          else None)) as pn:
+        bwork = None
+        outs: list = []
+        rows_in = 0
+        n_batches = 0
+        for batch in scan:
+            _interleave()   # batch boundary = serving interleave point
+            rows_in += batch.row_count
+            n_batches += 1
+            # per-batch key promotion against the (already promoted,
+            # already shuffled) build columns: numeric promotion is
+            # batch-independent, so the build side promotes exactly once
+            bk = [batch.column(n) for n in scan_on]
+            rk = [(build if bwork is None else bwork).column(n)
+                  for n in build_on]
+            pairs = [promote_key_pair(a, b) for a, b in zip(bk, rk)]
+            if any(p.dictionary is not None for pair in pairs
+                   for p in pair):
+                raise InvalidError(
+                    "pipelined_scan_join: dictionary-encoded join keys "
+                    "are per-batch-coded and cannot hash-colocate "
+                    "against a once-shuffled build — materialize the "
+                    "read and use pipelined_join")
+            batch = batch.with_columns(
+                {n: p for n, (p, _) in zip(scan_on, pairs)})
+            if bwork is None:
+                bwork = build.with_columns(
+                    {n: p for n, (_, p) in zip(build_on, pairs)})
+                if env.world_size > 1:
+                    bwork = shuffle_table(bwork, build_on)  # ONCE
+                memory.register_table("scan_build", bwork)
+            # ledger admission per batch (scheduler-mediated, TS109):
+            # cold spillable owners evict — and, under a host budget,
+            # demote — BEFORE the batch's rows land
+            need = sum(int(c.data.nbytes)
+                       + (int(c.validity.nbytes)
+                          if c.validity is not None else 0)
+                       for c in batch.columns.values())
+            scheduler.admit_allocation(env, need)
+            reg = memory.register_table("scan_batch", batch)
+            if env.world_size > 1:
+                batch = shuffle_table(batch, scan_on)
+            with timing.region("pipe.scan_join"):
+                res = join_tables(batch, bwork, scan_on, build_on,
+                                  how=how, suffixes=suffixes,
+                                  assume_colocated=True,
+                                  allow_defer=(sink is not None))
+            with timing.region("pipe.consume"):
+                outs.append(sink(res) if sink is not None else res)
+            memory.release(reg)
+        if n_batches == 0:
+            raise CylonIOError("pipelined_scan_join: the scan yielded "
+                               "no batches")
+        if pn:
+            pn.set(rows_in=rows_in + build.row_count)
+            pn.annotate(route="scan_pushdown", n_batches=n_batches)
+        if sink is not None:
+            return outs
+        out = concat_tables(outs) if len(outs) > 1 else outs[0]
+        if pn:
+            pn.set(rows_out=out.row_count)
+        return out
+
+
+# ---------------------------------------------------------------------------
 # range-partitioned pipelined join
 # ---------------------------------------------------------------------------
 

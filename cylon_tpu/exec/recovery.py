@@ -77,6 +77,7 @@ escalation state.
 
 from __future__ import annotations
 
+import errno
 import os
 import threading
 
@@ -120,25 +121,38 @@ shard_map = jax.shard_map
 #: ``obs.export`` wraps the flight recorder's Chrome-trace write
 #: (cylon_tpu/obs/trace.export): injecting there proves a hung or
 #: corrupt trace write surfaces TYPED instead of silently losing the
-#: timeline the operator armed.
+#: timeline the operator armed.  The disk-tier sites (exec/memory):
+#: ``disk.write`` wraps one registration's host→disk demotion (kinds
+#: ``corrupt`` = flip a page byte after hashing so the promote-side
+#: verification catches it, ``stall`` = hang the page write inside the
+#: watchdog, ``enospc`` = the write fails with a non-transient
+#: ``OSError(ENOSPC)`` and the demotion degrades to keeping the page
+#: host-resident — never a crash) and ``disk.read`` wraps the
+#: disk→host/device promotion's verify pass (``corrupt`` simulates a
+#: failed sha check — the owner degrades to recompute, never a wrong
+#: answer; ``stall`` hangs the verify read inside the watchdog).
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
+         "disk.write", "disk.read",
          "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
          "stream.append", "stream.watermark", "obs.export")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
 #: analog of ``stall``); ``corrupt`` flips checkpoint page bytes (write)
-#: or simulates a failed hash check (load/reshard); ``kill`` SIGKILLs
-#: the PROCESS at the site — the chaos-soak harness's hard-crash
-#: primitive (the parent reruns the workload with ``CYLON_TPU_RESUME=1``)
-#: — and ``term`` delivers SIGTERM to the process at the site: the
-#: spot-VM preemption notice (exec/preempt) — with the grace handler
-#: armed the process keeps running and DRAINS at its next checkpoint
-#: boundary; unarmed, default disposition applies, exactly like a real
-#: preemption
+#: or simulates a failed hash check (load/reshard); ``enospc`` makes a
+#: disk-tier page write fail with a NON-transient ``OSError(ENOSPC)``
+#: (the bounded IO retry gives up immediately — a full disk does not
+#: heal in milliseconds — and the demotion degrades in-memory);
+#: ``kill`` SIGKILLs the PROCESS at the site — the chaos-soak harness's
+#: hard-crash primitive (the parent reruns the workload with
+#: ``CYLON_TPU_RESUME=1``) — and ``term`` delivers SIGTERM to the
+#: process at the site: the spot-VM preemption notice (exec/preempt) —
+#: with the grace handler armed the process keeps running and DRAINS at
+#: its next checkpoint boundary; unarmed, default disposition applies,
+#: exactly like a real preemption
 KINDS = ("predicted", "device_oom", "capacity", "desync", "stall",
-         "spill_stall", "corrupt", "kill", "term")
+         "spill_stall", "corrupt", "enospc", "kill", "term")
 
 
 # ---------------------------------------------------------------------------
@@ -163,9 +177,19 @@ def classify(e: Exception) -> CylonError | None:
     Typed faults pass through unchanged.  Foreign exceptions carrying XLA
     OOM text become :class:`PredictedResourceExhausted` (when the message
     says ``(predicted)`` — the pre-allocation guard shape) or
-    :class:`DeviceOOMError`, with the original on ``__cause__``.  Returns
-    ``None`` for everything else (not a recovery fault: re-raise it)."""
+    :class:`DeviceOOMError`, with the original on ``__cause__``.  A
+    :class:`CheckpointCorruptError` from a DISK-TIER site (``disk.*``,
+    exec/memory) is a fault too: a corrupt spill page's owner has no
+    other copy of its data, so the ladder's remedy is ONE recompute of
+    the stage at the same streaming configuration (never a wrong
+    answer).  Checkpoint-site corruption keeps its existing non-fault
+    classification — the pipeline handles it locally (restore degrades
+    to recompute of remaining pieces).  Returns ``None`` for everything
+    else (not a recovery fault: re-raise it)."""
     if isinstance(e, FAULT_TYPES):
+        return e
+    if isinstance(e, CheckpointCorruptError) \
+            and str(getattr(e, "site", "") or "").startswith("disk."):
         return e
     if isinstance(e, CylonError):
         return None  # typed engine errors (Invalid/Type/...) are not faults
@@ -444,6 +468,8 @@ def make_fault(kind: str, site: str) -> Exception:
     if kind == "corrupt":
         return CheckpointCorruptError(
             f"injected checkpoint corruption at {site}", site=site)
+    if kind == "enospc":
+        return OSError(errno.ENOSPC, f"injected ENOSPC at {site}")
     return RankDesyncError(f"injected rank desync at {site}", site=site,
                            phase=_last_phase())
 
@@ -655,6 +681,11 @@ def _fault_from_wire(wire: int, msg: str) -> CylonError:
                 else DeviceOOMError(msg))
     if code == Code.CapacityError:
         return CapacityOverflowError(msg)
+    if code == Code.SerializationError:
+        # a peer's disk-tier spill page failed verification: every rank
+        # takes the identical recompute rung (the corrupt owner's data
+        # exists nowhere else — recompute, never a wrong answer)
+        return CheckpointCorruptError(msg, site="disk.read")
     return RankDesyncError(msg, phase=_last_phase())
 
 
@@ -881,14 +912,78 @@ def exchange_watchdog(site: str, thunk, timeout_s: float | None = None,
 
 
 # ---------------------------------------------------------------------------
+# bounded IO retry — the shared transient-OSError backoff helper
+# ---------------------------------------------------------------------------
+
+#: registry counter: transient-OSError retries taken by retry_io across
+#: every adopter (checkpoint page/manifest writes, disk-tier spill pages)
+from ..obs import metrics as _obs_metrics  # noqa: E402
+
+_IO_RETRIES = _obs_metrics.counter(
+    "recovery_io_retries",
+    help="transient-OSError retries taken by the bounded IO backoff")
+
+#: errno values retry_io treats as NON-transient: a full disk (or quota)
+#: does not heal on a millisecond backoff — the caller's typed degrade
+#: path owns those, not the retry loop
+_NON_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in ("ENOSPC", "EDQUOT", "EROFS", "ENOENT", "EISDIR")
+    if hasattr(errno, name))
+
+
+def retry_io(fn, site: str, attempts: int = 3, base_delay_s: float = 0.05,
+             on_retry=None):
+    """Run a filesystem thunk with a SMALL bounded exponential-backoff
+    retry on transient ``OSError`` — the shared-storage-blip helper
+    (docs/robustness.md "Disk tier & scan pushdown"): a single NFS hiccup
+    during a GKE drain used to abort a checkpoint commit that a
+    3-attempt backoff saves.  Bounded by construction: at most
+    ``attempts`` calls, delays ``base * 2^i`` (≈0.15 s total at the
+    defaults) — never an unbounded loop.  Non-transient errnos (ENOSPC,
+    EDQUOT, EROFS, ENOENT, EISDIR) re-raise IMMEDIATELY: the caller's
+    typed degrade/classification path owns those.  Non-OSError
+    exceptions propagate untouched.  ``on_retry`` (optional thunk) runs
+    once per retry — adopters bump their own counters through it; the
+    shared ``recovery_io_retries`` registry counter and a
+    ``io_retry.<site>`` timing bump always fire."""
+    import time as _time
+    last: OSError | None = None
+    for i in range(max(int(attempts), 1)):
+        if i:
+            from ..utils import timing
+            from ..utils.logging import log
+            _IO_RETRIES.inc()
+            timing.bump(f"io_retry.{site}")
+            if on_retry is not None:
+                on_retry()
+            log.warning("%s: transient OSError (%s); retry %d/%d after "
+                        "%.3fs backoff", site, last, i, attempts - 1,
+                        base_delay_s * (2 ** (i - 1)))
+            _time.sleep(base_delay_s * (2 ** (i - 1)))
+        try:
+            return fn()
+        except OSError as e:
+            if e.errno in _NON_TRANSIENT_ERRNOS:
+                raise
+            last = e
+    raise last
+
+
+# ---------------------------------------------------------------------------
 # the rank-coherent retry ladder
 # ---------------------------------------------------------------------------
 
 #: bounded deterministic escalation per agreed fault code: device/predicted
 #: OOM retries the streaming fallback at growing chunk counts; a capacity
 #: overflow takes exactly one cap-halving step (pieces are ~1/n_chunks
-#: sized, so 8 chunks halves the 4-chunk default's piece cap)
-RETRY_RUNGS = {Code.OutOfMemory: (4, 16), Code.CapacityError: (8,)}
+#: sized, so 8 chunks halves the 4-chunk default's piece cap); a DISK-TIER
+#: corruption (Code.SerializationError from a ``disk.*`` site — a spill
+#: page failed its sha check, so that owner's data exists nowhere else)
+#: takes exactly one recompute of the stage at the base streaming
+#: configuration — corruption degrades to recompute, never a wrong answer
+RETRY_RUNGS = {Code.OutOfMemory: (4, 16), Code.CapacityError: (8,),
+               Code.SerializationError: (4,)}
 
 _tls = threading.local()
 

@@ -1,5 +1,6 @@
 """IO subsystem (reference cpp/src/cylon/io + pycylon distributed_io)."""
 
-from .io import (read_csv, read_csv_dist, read_json, read_parquet,  # noqa: F401
-                 read_parquet_dist, write_csv, write_csv_dist, write_json,
+from .io import (ParquetScanSource, read_csv, read_csv_dist,  # noqa: F401
+                 read_json, read_parquet, read_parquet_dist,
+                 scan_parquet_dist, write_csv, write_csv_dist, write_json,
                  write_json_dist, write_parquet, write_parquet_dist)

@@ -501,9 +501,13 @@ def autoarm() -> None:
 # the shared bench-detail collector
 # ---------------------------------------------------------------------------
 
-#: bench.py's spill-counter selection (exec/memory.stats keys)
+#: bench.py's spill-counter selection (exec/memory.stats keys) — the
+#: disk-tier pair (``disk_events``/``bytes_to_disk``) rides along so a
+#: bench number always says whether it was achieved HBM-resident,
+#: host-spilled, or out-of-core (docs/robustness.md "Disk tier & scan
+#: pushdown")
 BENCH_SPILL_KEYS = ("spill_events", "bytes_spilled", "peak_ledger_bytes",
-                    "donated_bytes_reused")
+                    "donated_bytes_reused", "disk_events", "bytes_to_disk")
 #: the durable-checkpoint counters every bench JSON carries
 BENCH_CKPT_KEYS = ("checkpoint_events", "bytes_checkpointed",
                    "resume_fast_forwarded_pieces", "resume_resharded_pieces",

@@ -1,5 +1,5 @@
-"""TPC-H subset: data generator + an 18-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
+"""TPC-H subset: data generator + a 19-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q9 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -20,10 +20,13 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   plan shapes — scalar-subquery HAVING (Q11), an aggregate view with a
   scalar-max equi-select (Q15) and a correlated-avg subquery (Q17), and
   — round 9, alongside the streaming ingest tier — Q20's nested
-  IN-subqueries over streaming-friendly partsupp semantics, and — round
+  IN-subqueries over streaming-friendly partsupp semantics, — round
   12, the query profiler's acceptance workload — Q13's customer
   count-distribution (LEFT join + two-level groupby, its EXPLAIN
-  ANALYZE plan recorded in the bench detail);
+  ANALYZE plan recorded in the bench detail), and — round 13, alongside
+  the out-of-core disk tier — Q9's product-type profit: six tables,
+  five joins (one two-key), the suite's widest join working set and the
+  disk tier's natural TPC-H exerciser;
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -221,6 +224,12 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
     rng6 = np.random.default_rng(seed + 86028121)
     orders["o_comment"] = np.where(rng6.random(n_ord) < 0.05,
                                    "special requests", "ok")
+    # Q9 addition (round 13, the out-of-core tier's wide-join exerciser):
+    # extract(year FROM o_orderdate) rides a DERIVED int column — no new
+    # RNG draws, so every earlier table/column stays byte-identical (the
+    # engine has no device-side date-part extraction; the same documented
+    # simplification as Q22's phone-prefix column)
+    orders["o_orderyear"] = orders["o_orderdate"].dt.year.astype(np.int64)
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
             "part": part, "partsupp": partsupp}
@@ -862,6 +871,76 @@ def q21_pandas(pdfs: dict, nation: str = "SAUDI ARABIA",
 
 
 # ---------------------------------------------------------------------------
+# Q9 — product type profit (the suite's WIDEST join working set)
+# ---------------------------------------------------------------------------
+
+def q9(dfs: dict, env=None, name_part: str = "misty"):
+    """SELECT nation, o_year, sum(amount) AS sum_profit FROM (SELECT
+    n_name AS nation, extract(year FROM o_orderdate) AS o_year,
+    l_extendedprice*(1-l_discount) - ps_supplycost*l_quantity AS amount
+    FROM part, supplier, lineitem, partsupp, orders, nation WHERE
+    s_suppkey = l_suppkey AND ps_suppkey = l_suppkey AND ps_partkey =
+    l_partkey AND p_partkey = l_partkey AND o_orderkey = l_orderkey AND
+    s_nationkey = n_nationkey AND p_name LIKE '%:part%') GROUP BY
+    nation, o_year ORDER BY nation, o_year DESC.
+
+    Six tables, five joins — including the two-key
+    (l_suppkey, l_partkey) ⋈ (ps_suppkey, ps_partkey) edge — over the
+    largest fact table: the suite's widest join working set and the
+    natural out-of-core exerciser (the disk tier's TPC-H acceptance
+    query, docs/robustness.md "Disk tier & scan pushdown").  LIKE rides
+    the closed p_name vocabulary as exact-value equality and
+    extract(year) rides the generator's derived ``o_orderyear`` int
+    column (documented simplifications; the pandas oracle uses real
+    ``str.contains`` / ``dt.year``)."""
+    p = dfs["part"]
+    names = [v for v in PNAMES.tolist() if name_part in v]
+    p = p[_isin(p["p_name"], names)][["p_partkey"]]
+    j = dfs["lineitem"].merge(p, left_on="l_partkey", right_on="p_partkey",
+                              env=env)
+    ps = dfs["partsupp"][["ps_partkey", "ps_suppkey", "ps_supplycost"]]
+    j = j.merge(ps, left_on=["l_partkey", "l_suppkey"],
+                right_on=["ps_partkey", "ps_suppkey"], env=env)
+    j = j.merge(dfs["supplier"][["s_suppkey", "s_nationkey"]],
+                left_on="l_suppkey", right_on="s_suppkey", env=env)
+    j = j.merge(dfs["orders"][["o_orderkey", "o_orderyear"]],
+                left_on="l_orderkey", right_on="o_orderkey", env=env)
+    j = j.merge(dfs["nation"][["n_nationkey", "n_name"]],
+                left_on="s_nationkey", right_on="n_nationkey", env=env)
+    j["amount"] = (j["l_extendedprice"] * (1.0 - j["l_discount"])
+                   - j["ps_supplycost"] * j["l_quantity"].astype("float64"))
+    g = (j.groupby(["n_name", "o_orderyear"], env=env)[["amount"]].sum()
+         .rename({"amount": "sum_profit"}))
+    out = g.sort_values(["n_name", "o_orderyear"],
+                        ascending=[True, False], env=env)
+    return out[["n_name", "o_orderyear", "sum_profit"]]
+
+
+def q9_pandas(pdfs: dict, name_part: str = "misty") -> pd.DataFrame:
+    p = pdfs["part"]
+    p = p[p.p_name.str.contains(name_part)][["p_partkey"]]
+    j = (pdfs["lineitem"]
+         .merge(p, left_on="l_partkey", right_on="p_partkey")
+         .merge(pdfs["partsupp"][["ps_partkey", "ps_suppkey",
+                                  "ps_supplycost"]],
+                left_on=["l_partkey", "l_suppkey"],
+                right_on=["ps_partkey", "ps_suppkey"])
+         .merge(pdfs["supplier"][["s_suppkey", "s_nationkey"]],
+                left_on="l_suppkey", right_on="s_suppkey")
+         .merge(pdfs["orders"][["o_orderkey", "o_orderdate"]],
+                left_on="l_orderkey", right_on="o_orderkey")
+         .merge(pdfs["nation"][["n_nationkey", "n_name"]],
+                left_on="s_nationkey", right_on="n_nationkey"))
+    j["o_orderyear"] = j.o_orderdate.dt.year.astype(np.int64)
+    j["amount"] = (j.l_extendedprice * (1.0 - j.l_discount)
+                   - j.ps_supplycost * j.l_quantity.astype(np.float64))
+    g = (j.groupby(["n_name", "o_orderyear"], as_index=False)
+         .agg(sum_profit=("amount", "sum")))
+    return g.sort_values(["n_name", "o_orderyear"],
+                         ascending=[True, False]).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
 # Q22 — global sales opportunity (ANTI join vs orders)
 # ---------------------------------------------------------------------------
 
@@ -1201,9 +1280,9 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q10": q10, "q11": q11, "q12": q12, "q13": q13, "q14": q14,
-               "q15": q15, "q16": q16, "q17": q17, "q18": q18, "q19": q19,
-               "q20": q20, "q21": q21, "q22": q22}
+               "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
+               "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18,
+               "q19": q19, "q20": q20, "q21": q21, "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
     # the profiler's acceptance workload (docs/observability.md): one
     # extra ANALYZE-profiled Q13 run whose plan tree — per-node
@@ -1222,10 +1301,13 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                    # was this number achieved on the happy path or after
                    # in-run degradation (docs/robustness.md)?
                    "recovery_events": _recovery_events(),
-                   # resident vs host-spilled state (exec/memory)
+                   # resident vs host-spilled vs OUT-OF-CORE state
+                   # (exec/memory): disk_events/bytes_to_disk > 0 means
+                   # the number rode the disk tier
                    **{k: v for k, v in _spill_stats().items() if k in
                       ("spill_events", "bytes_spilled",
-                       "peak_ledger_bytes")},
+                       "peak_ledger_bytes", "disk_events",
+                       "bytes_to_disk", "bytes_from_disk")},
                    # durable checkpoint traffic (exec/checkpoint): did
                    # this number include checkpoint writes, and did a
                    # resumed run fast-forward instead of recomputing?

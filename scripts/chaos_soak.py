@@ -43,6 +43,18 @@ counting), and the resumed rerun must fast-forward t0's committed
 pieces while EVERY tenant's answer stays bit-equal to its solo
 (single-session) run — crash isolation under multi-tenancy.
 
+``--oocore`` switches to the OUT-OF-CORE acceptance flow (the disk
+tier, docs/robustness.md "Disk tier & scan pushdown"): the standard
+join+sink workload runs under ``CYLON_TPU_HBM_BUDGET`` +
+``CYLON_TPU_HOST_BUDGET`` caps sized below its working set, so packed
+sources evict to host AND demote to per-rank spill files.  Pinned
+schedules: a capped happy-path run (bit-equal with ``disk_events > 0``
+and ``bytes_to_disk > 0``), ENOSPC mid-demote (typed degrade to
+in-memory — no crash, bit-equal), corrupt-on-promote (the ladder
+recomputes the owner — bit-equal, never a wrong answer), SIGKILL
+mid-demote then resume (bit-equal), and the UNARMED contract leg (no
+host budget ⇒ zero disk events and zero spill-file writes, asserted).
+
 ``--elastic`` switches to the ELASTIC-RESUME acceptance flow
 (docs/robustness.md "Elastic resume & preemption grace"): a TWO-stage
 workload (sinkless pipelined join feeding a join+sink) checkpoints at
@@ -65,6 +77,7 @@ Usage::
     python scripts/chaos_soak.py --seed 7 --schedules 4 --rows 1500
     python scripts/chaos_soak.py --concurrent 3 --rows 2000
     python scripts/chaos_soak.py --elastic --rows 1500 --chunks 3
+    python scripts/chaos_soak.py --oocore --rows 2000 --chunks 3
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
 runs in CI as a slow-marked test (tests/test_checkpoint.py); the
@@ -177,11 +190,15 @@ def worker(args) -> int:
               flush=True)
         return RESUMABLE_EXIT
 
+    from cylon_tpu.exec import memory
     df = out.to_pandas().sort_values("l_orderkey").reset_index(drop=True)
     print(json.dumps({
         "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
         "events": len(recovery.recovery_events()),
         "event_list": recovery.recovery_events(),
+        # disk-tier counters: the --oocore flow asserts these
+        **{k: v for k, v in memory.stats().items()
+           if k.startswith(("disk_", "bytes_to_disk", "bytes_from_disk"))},
         **checkpoint.stats(),
     }), flush=True)
     return 0
@@ -295,6 +312,101 @@ def run_stream(args) -> int:
     if own_workdir:
         shutil.rmtree(args.workdir, ignore_errors=True)
     print(json.dumps({"stream": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
+def run_oocore(args) -> int:
+    """The ``--oocore`` acceptance flow (pinned, not drawn): the disk
+    tier's end-to-end contract.  Budget caps sized below the workload's
+    working set force evict→demote; every schedule must end bit-equal
+    to the uncapped baseline — degraded, resumed or recomputed, never
+    wrong — and the unarmed leg must write NOTHING."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_oocore_")
+    failures: list = []
+    caps = {"CYLON_TPU_HBM_BUDGET": "4096",
+            "CYLON_TPU_HOST_BUDGET": "4096"}
+
+    def spawn(tag, faults, resume=False, capped=True, spill_sub="spill"):
+        workdir = os.path.join(args.workdir, tag)
+        extra = dict(caps) if capped else {}
+        extra["CYLON_TPU_SPILL_DIR"] = os.path.join(workdir, spill_sub)
+        return _spawn(args, workdir, faults, resume=resume,
+                      extra_env=extra), os.path.join(workdir, spill_sub)
+
+    # uncapped, un-injected baseline: the bit-equality oracle
+    (p, base), _sd = spawn("base", "", capped=False)
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: oocore baseline failed", file=sys.stderr)
+        return 1
+    print(f"# oocore baseline sha={base['sha'][:16]}", flush=True)
+    if base.get("disk_events"):
+        failures.append(f"UNARMED baseline wrote to disk: {base}")
+    if os.path.isdir(_sd):
+        failures.append(f"unarmed run created the spill dir {_sd}")
+
+    # capped happy path: bit-equal THROUGH the disk tier, traffic counted
+    (p, info), _sd = spawn("capped", "")
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"capped run diverged (rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("disk_events") or not info.get("bytes_to_disk"):
+        failures.append(f"capped run never touched the disk tier: {info}")
+    else:
+        print(f"# oocore capped -> ok (disk_events={info['disk_events']} "
+              f"bytes_to_disk={info['bytes_to_disk']})", flush=True)
+
+    # ENOSPC mid-demote: typed degrade to in-memory — no crash, bit-equal
+    (p, info), _sd = spawn("enospc", "disk.write::1=enospc")
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"enospc mid-demote crashed or diverged "
+                        f"(rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("disk_write_degrades"):
+        failures.append(f"enospc degrade not counted: {info}")
+    else:
+        print("# oocore enospc -> ok (typed degrade, bit-equal)",
+              flush=True)
+
+    # corrupt-on-promote: the ladder recomputes the owner — bit-equal
+    (p, info), _sd = spawn("corrupt", "disk.read::1=corrupt")
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"corrupt-on-promote crashed or produced a WRONG "
+                        f"answer (rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("disk_corrupt_degrades"):
+        failures.append(f"corrupt degrade not counted: {info}")
+    elif info.get("events", 0) > MAX_RECOVERY_EVENTS:
+        failures.append(f"unbounded retries after corruption: {info}")
+    else:
+        print("# oocore corrupt-on-promote -> ok (recompute, bit-equal)",
+              flush=True)
+
+    # SIGKILL mid-demote, then resume: bit-equal after the crash
+    (p, _), _sd = spawn("kill", "disk.write::1=kill")
+    if p.returncode != -9:
+        failures.append(f"kill mid-demote did not crash "
+                        f"(rc={p.returncode})")
+    else:
+        workdir = os.path.join(args.workdir, "kill")
+        extra = dict(caps)
+        extra["CYLON_TPU_SPILL_DIR"] = os.path.join(workdir, "spill2")
+        p2, info2 = _spawn(args, workdir, "", resume=True, extra_env=extra)
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"resume after kill mid-demote diverged "
+                            f"(rc={p2.returncode}): {info2}\n"
+                            f"{(p2.stdout + p2.stderr)[-2000:]}")
+        else:
+            print(f"# oocore kill mid-demote + resume -> ok (ffwd="
+                  f"{info2.get('resume_fast_forwarded_pieces')})",
+                  flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"oocore": True, "failures": len(failures),
                       "detail": failures[:10]}))
     return 1 if failures else 0
 
@@ -635,6 +747,11 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
+    # the out-of-core caps are armed per-leg too (extra_env) — an
+    # inherited budget would cap the baseline legs
+    for k in ("CYLON_TPU_HBM_BUDGET", "CYLON_TPU_HOST_BUDGET",
+              "CYLON_TPU_SPILL_DIR"):
+        env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["CYLON_TPU_FAULTS"] = faults
@@ -794,6 +911,11 @@ def main() -> int:
     ap.add_argument("--only", type=int, default=None,
                     help="(worker) restrict the concurrent scheduler to "
                          "one tenant — the solo bit-equality leg")
+    ap.add_argument("--oocore", action="store_true",
+                    help="run the out-of-core acceptance flow (HBM+host "
+                         "budget caps force the disk tier; enospc/"
+                         "corrupt/kill schedules must end bit-equal, "
+                         "and the unarmed leg must write nothing)")
     ap.add_argument("--stream", action="store_true",
                     help="run the streaming-ingest acceptance flow "
                          "(SIGKILL mid-ingest with checkpointing armed; "
@@ -812,6 +934,9 @@ def main() -> int:
     if args.worker:
         sys.path.insert(0, REPO)
         return worker(args)
+
+    if args.oocore:
+        return run_oocore(args)
 
     if args.stream:
         return run_stream(args)

@@ -1,0 +1,38 @@
+"""Known-bad fixture: spill-file path construction / raw spill page IO
+outside exec/memory.py (TS114).  The disk tier's pages are only safe
+behind the ledger facade — content-hashed at demote, sha-verified at
+promote, written/read under the bounded IO retry."""
+
+import os
+
+import numpy as np
+
+
+def sneaky_page_dump(arr, spill_dir, owner):
+    # TS114 twice: the np.save IO call AND the os.path.join path build
+    # both name the spill page
+    np.save(os.path.join(spill_dir, owner + ".spill.npy"), arr)
+
+
+def sneaky_page_read(spill_dir, owner):
+    # TS114 twice: np.load + the join
+    return np.load(os.path.join(spill_dir, owner + ".spill.npy"))
+
+
+def sneaky_env_page(owner):
+    # TS114 once: the path build off CYLON_TPU_SPILL_DIR; the open()
+    # below reads through a neutral name — under-approximated, like the
+    # rest of the pass
+    path = os.path.join(os.environ["CYLON_TPU_SPILL_DIR"], owner)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def fine_non_spill_io(arr, path):
+    # clean: ordinary IO with no spill-path mention
+    np.save(path, arr)
+
+
+def fine_spill_counters(stats):
+    # clean: the WORD spill outside the on-disk naming never fires
+    return stats["spill_events"] + stats["bytes_spilled"]

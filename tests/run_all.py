@@ -20,8 +20,15 @@ import glob
 import os
 import subprocess
 import sys
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: seconds to back off before re-running a signal-killed file: the
+#: crash class this driver exists for (XLA:CPU compiler segfaults)
+#: correlates with transient memory pressure, and an immediate rerun
+#: inherits it more often than a briefly delayed one
+RETRY_BACKOFF_S = 2.0
 
 
 def run_file(path: str, extra: list[str]) -> int:
@@ -33,8 +40,15 @@ def run_file(path: str, extra: list[str]) -> int:
         # negative = killed by signal (SIGSEGV -11); retry once
         if r.returncode >= 0 or attempt == 2:
             return r.returncode
-        print(f"# {os.path.basename(path)} crashed "
-              f"(signal {-r.returncode}); retrying once", flush=True)
+        # one-line retry marker: a retried file's dots appear TWICE in
+        # the tee'd log, so the tier-1 DOTS accounting needs a greppable
+        # record of every retry that fired (and of the crashed first
+        # pass's partial dot line) to stay auditable
+        print(f"# DOTS_RETRY file={os.path.basename(path)} "
+              f"signal={-r.returncode} backoff={RETRY_BACKOFF_S:g}s "
+              "(first pass's partial dots above are superseded by the "
+              "rerun)", flush=True)
+        time.sleep(RETRY_BACKOFF_S)
     return 1
 
 

@@ -122,6 +122,36 @@ class TestPageRoundTrip:
         np.testing.assert_array_equal(t.valid_counts, back.valid_counts)
         assert checkpoint.stats()["resume_fast_forwarded_pieces"] == 1
 
+    def test_flaky_write_is_retried_not_aborted(self, env4, rng,
+                                                monkeypatch):
+        """The satellite regression (exec/recovery.retry_io adoption): a
+        transient OSError on the manifest rename — an NFS blip during a
+        GKE drain — used to abort the commit; the 3-attempt backoff now
+        saves it and the piece round-trips bit-exactly."""
+        import os as _os
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        t = ct.Table.from_pandas(
+            pd.DataFrame({"k": rng.integers(0, 9, 64).astype(np.int64)}),
+            env4)
+        stage = checkpoint.open_stage(env4, "flaky", "tok")
+        real_replace = _os.replace
+        fails = [1]
+
+        def flaky_replace(src, dst):
+            if fails[0]:
+                fails[0] -= 1
+                raise OSError(5, "transient EIO blip")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(_os, "replace", flaky_replace)
+        stage.save_piece(0, t)          # survives the blip via retry_io
+        monkeypatch.setattr(_os, "replace", real_replace)
+        back = stage.load_piece(0)
+        np.testing.assert_array_equal(np.asarray(t.column("k").data),
+                                      np.asarray(back.column("k").data))
+        from cylon_tpu.obs import metrics
+        assert metrics.counter("recovery_io_retries").value >= 1
+
     def test_manifest_commits_identical_epoch_per_piece(self, env4, rng):
         import json
         _, _, lt, rt = _tables(env4, rng, n=800)

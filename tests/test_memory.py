@@ -5,6 +5,7 @@ pipelined join, and the spill-site watchdog/injection surface.
 docs/robustness.md "Memory ledger & spill tier"."""
 
 import gc
+import os
 
 import numpy as np
 import pandas as pd
@@ -344,3 +345,269 @@ class TestSpillInjection:
         with pytest.raises(Exception) as ei:
             memory.ensure_headroom(env4, 0)
         assert isinstance(recovery.classify(ei.value), DeviceOOMError)
+
+
+# ---------------------------------------------------------------------------
+# disk tier: host pages demote to spill files (docs/robustness.md
+# "Disk tier & scan pushdown")
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def disk(tmp_path, monkeypatch):
+    """Arm the disk tier with a tiny host budget and a private spill
+    root; yields the root path."""
+    root = str(tmp_path / "spill")
+    monkeypatch.setattr(config, "HOST_BUDGET_BYTES", 4096)
+    monkeypatch.setattr(config, "SPILL_DIR", root)
+    return root
+
+
+class TestDiskTier:
+    def test_demote_window_read_bit_exact(self, env4, rng, disk):
+        """device → host → DISK → windowed mmap read: bit-equal to the
+        resident path across every lane class, with the page files on
+        disk while demoted and the traffic counted."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng)
+        w = env4.world_size
+        lens = t.valid_counts
+        src = PieceSource(t, pad=8)
+        cap = config.pow2ceil(int(lens.max()))
+        starts = np.zeros(w, np.int64)
+        ref = _host_bytes(src.packed(starts, lens, cap).to_table())
+        memory.evict(src._reg)
+        assert memory.demote(src._reg) > 0
+        assert src._reg.on_disk and src._reg.host is None
+        assert memory.demotion_log() == [src._reg.owner]
+        import glob as _glob
+        pages = _glob.glob(os.path.join(disk, "rank*", "*.spill.npy"))
+        assert pages, "no spill page files written"
+        got = _host_bytes(src.packed(starts, lens, cap).to_table())
+        for name in ref:
+            assert got[name][0] == ref[name][0], f"{name} data differs"
+        st = memory.stats()
+        assert st["disk_events"] >= 2            # demote + window read
+        assert st["bytes_to_disk"] > 0 and st["bytes_from_disk"] > 0
+        assert st["disk_pages_demoted"] == len(pages)
+        del src
+
+    def test_full_readmit_from_disk_bit_exact(self, env4, rng, disk):
+        """disk → host → device whole-registration promotion is
+        bit-exact and deletes the spill pages."""
+        from cylon_tpu.relational.piece import PieceSource
+        from cylon_tpu.utils.host import host_arrays
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        before = [np.asarray(a).tobytes()
+                  for a in host_arrays(list(src.arrs))]
+        memory.evict(src._reg)
+        memory.demote(src._reg)
+        arrs = memory.readmit(src._reg)
+        assert not src.spilled and not src._reg.on_disk
+        after = [np.asarray(a).tobytes() for a in host_arrays(list(arrs))]
+        assert before == after
+        import glob as _glob
+        assert not _glob.glob(os.path.join(disk, "rank*", "*.spill.npy"))
+        del src
+
+    def test_host_budget_drives_demotion_through_pipelined_join(
+            self, env4, rng, disk, monkeypatch):
+        """Both budgets below the working set: the pipelined join rides
+        the FULL residency ladder (device → host → disk → mmap windows)
+        and stays bit- and order-equal with no ladder escalation."""
+        from cylon_tpu.exec import pipelined_join
+        monkeypatch.setattr(config, "HOST_BUDGET_BYTES", 0)
+        _ldf, _rdf, lt, rt = _tables(env4, rng)
+        base = pipelined_join(lt, rt, "k", "k", how="inner",
+                              n_chunks=4).to_pandas()
+        gc.collect()
+        memory.reset_stats()
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        monkeypatch.setattr(config, "HOST_BUDGET_BYTES", 4096)
+        out = pipelined_join(lt, rt, "k", "k", how="inner",
+                             n_chunks=4).to_pandas()
+        st = memory.stats()
+        assert st["disk_events"] > 0 and st["bytes_to_disk"] > 0, st
+        assert memory.demotion_log(), "no demotion sequence recorded"
+        assert recovery.recovery_events() == []  # NO ladder escalation
+        pd.testing.assert_frame_equal(out, base)
+
+    def test_enospc_demotion_degrades_in_memory(self, env4, rng, disk):
+        """ENOSPC mid-demote: the page STAYS host-resident, a typed
+        recovery event records the degrade, nothing crashes."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        memory.evict(src._reg)
+        recovery.install_faults("disk.write::1=enospc")
+        assert memory.demote(src._reg) == 0
+        assert src._reg.host is not None and not src._reg.on_disk
+        assert memory.stats()["disk_write_degrades"] == 1
+        assert [(e["site"], e["kind"], e["action"])
+                for e in recovery.recovery_events()] \
+            == [("disk.write", "enospc", "degrade_in_memory")]
+        del src
+
+    def test_corrupt_promote_is_typed_and_retires_owner(self, env4, rng,
+                                                        disk):
+        """A page corrupted after hashing (injected at disk.write) fails
+        the on-touch verification: typed CheckpointCorruptError at site
+        disk.read, the poisoned owner released — never a wrong answer."""
+        from cylon_tpu.relational.piece import PieceSource
+        from cylon_tpu.status import CheckpointCorruptError
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        w = env4.world_size
+        memory.evict(src._reg)
+        recovery.install_faults("disk.write::1=corrupt")
+        assert memory.demote(src._reg) > 0
+        with pytest.raises(CheckpointCorruptError) as ei:
+            src.packed(np.zeros(w, np.int64), t.valid_counts, 64)
+        assert ei.value.site == "disk.read"
+        assert not src._reg.live        # poisoned owner retired
+        assert memory.stats()["disk_corrupt_degrades"] == 1
+        del src
+
+    def test_corrupt_promote_recomputes_through_ladder(self, env4, rng,
+                                                       disk, monkeypatch):
+        """End to end: corrupt-on-promote inside a guarded pipelined
+        join+sink workload degrades to ONE recompute rung — bit-equal,
+        bounded, never a wrong answer."""
+        from cylon_tpu.exec import GroupBySink, pipelined_join
+        ldf, rdf, lt, rt = _tables(env4, rng)
+
+        def attempt(nc):
+            sink = GroupBySink("k", [("a", "sum"), ("b", "sum")])
+            pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=nc,
+                           sink=sink)
+            return sink.finalize()
+
+        base = attempt(4).to_pandas().sort_values("k") \
+            .reset_index(drop=True)
+        gc.collect()
+        memory.reset_stats()
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        recovery.install_faults("disk.read::1=corrupt")
+        out = recovery.run_with_recovery(lambda: attempt(4), True, attempt,
+                                         "oocore", env=env4)
+        got = out.to_pandas().sort_values("k").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, base)
+        acts = [(e["site"], e["action"])
+                for e in recovery.recovery_events()]
+        assert ("disk.read", "recompute_owner") in acts
+        assert ("oocore", "retry_chunks_4") in acts
+        assert memory.stats()["disk_corrupt_degrades"] == 1
+
+    def test_torn_page_surfaces_typed_not_crash(self, env4, rng, disk):
+        """A genuinely TRUNCATED page (crash mid-write, external tamper)
+        raises ValueError inside np.load, not OSError — it must still
+        surface as the typed CheckpointCorruptError → recompute path,
+        never an unhandled crash (review finding, round 13)."""
+        import glob as _glob
+        from cylon_tpu.relational.piece import PieceSource
+        from cylon_tpu.status import CheckpointCorruptError
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        w = env4.world_size
+        memory.evict(src._reg)
+        assert memory.demote(src._reg) > 0
+        page = sorted(_glob.glob(
+            os.path.join(disk, "rank*", "*.spill.npy")))[0]
+        with open(page, "r+b") as f:       # truncate mid-data
+            f.truncate(os.path.getsize(page) // 2)
+        with pytest.raises(CheckpointCorruptError) as ei:
+            src.packed(np.zeros(w, np.int64), t.valid_counts, 64)
+        assert ei.value.site == "disk.read"
+        assert memory.stats()["disk_corrupt_degrades"] == 1
+        del src
+
+    def test_disk_stalls_surface_typed_desync(self, env4, rng, disk):
+        """A hung page write or verify read surfaces via the exchange
+        watchdog as RankDesyncError at the disk site, never a silent
+        block."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        memory.evict(src._reg)
+        recovery.install_faults("disk.write::1=stall")
+        with pytest.raises(RankDesyncError) as ei:
+            memory.demote(src._reg)
+        assert ei.value.site == "disk.write"
+        recovery.install_faults("")     # disarm; the page is still host
+        assert memory.demote(src._reg) > 0
+        recovery.install_faults("disk.read::1=stall")
+        w = env4.world_size
+        with pytest.raises(RankDesyncError) as ei:
+            src.packed(np.zeros(w, np.int64), t.valid_counts, 64)
+        assert ei.value.site == "disk.read"
+        del src
+
+    def test_transient_oserror_retries_then_succeeds(self, env4, rng,
+                                                     disk, monkeypatch):
+        """The bounded IO retry saves a flaky-then-ok page write: the
+        demotion succeeds on attempt 2 and the retry is counted."""
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        memory.evict(src._reg)
+        real_save = np.save
+        fails = [1]
+
+        def flaky_save(path, arr, **kw):
+            if fails[0]:
+                fails[0] -= 1
+                raise OSError(5, "transient EIO blip")
+            return real_save(path, arr, **kw)
+
+        monkeypatch.setattr(np, "save", flaky_save)
+        assert memory.demote(src._reg) > 0
+        assert memory.stats()["disk_retries"] == 1
+        del src
+
+    def test_unarmed_disk_tier_writes_nothing(self, env4, rng, tmp_path,
+                                              monkeypatch):
+        """The standing contract: with no host budget, a spill-heavy run
+        never creates a spill file or directory — zero filesystem
+        writes, zero disk counters."""
+        from cylon_tpu.exec import pipelined_join
+        root = str(tmp_path / "never")
+        monkeypatch.setattr(config, "SPILL_DIR", root)
+        monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+        monkeypatch.setattr(config, "HOST_BUDGET_BYTES", 0)
+        _ldf, _rdf, lt, rt = _tables(env4, rng)
+        pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=4)
+        st = memory.stats()
+        assert st["spill_events"] > 0          # the host tier DID engage
+        assert st["disk_events"] == 0 and st["bytes_to_disk"] == 0
+        assert not os.path.exists(root)
+
+    def test_predecessor_orphans_purged_on_first_use(self, env4, rng,
+                                                     disk):
+        """A crashed predecessor's leftover pages in a FIXED spill dir
+        are purged on this process's first use of it — a shared spill
+        volume cannot fill up run over run (review finding, round 13)."""
+        import jax
+        d = os.path.join(disk, f"rank{jax.process_index()}")
+        os.makedirs(d, exist_ok=True)
+        orphan = os.path.join(d, "dead_owner.a0.s0.spill.npy")
+        np.save(orphan, np.zeros(8))
+        memory._PURGED_DIRS.discard(d)   # fresh-process semantics
+        from cylon_tpu.relational.piece import PieceSource
+        t = _mixed_lane_table(env4, rng, n=256)
+        src = PieceSource(t, pad=8)
+        memory.evict(src._reg)
+        assert memory.demote(src._reg) > 0
+        assert not os.path.exists(orphan)
+        del src
+
+    def test_demotion_lru_order_is_deterministic(self, disk):
+        regs = [memory.register("dlru", (np.zeros(64, np.int64),),
+                                spillable=True) for _ in range(3)]
+        for r in regs:
+            memory.evict(r)
+        memory.touch(regs[0])   # oldest untouched host page is regs[1]
+        led = memory.ledger()
+        assert led.demote_count_for(1) >= 1
+        assert led.demote_n(1) == [regs[1].owner]
+        for r in regs:
+            memory.release(r)

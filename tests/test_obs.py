@@ -249,6 +249,9 @@ class TestBenchDetail:
             "recovery_events",
             "spill_events", "bytes_spilled", "peak_ledger_bytes",
             "donated_bytes_reused",
+            # the disk-tier pair (round 13): a bench number always says
+            # whether it rode the out-of-core rung
+            "disk_events", "bytes_to_disk",
             "checkpoint_events", "bytes_checkpointed",
             "resume_fast_forwarded_pieces", "resume_resharded_pieces",
             "resume_world_mismatch"}

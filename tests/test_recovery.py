@@ -129,6 +129,111 @@ class TestInjector:
     def test_unarmed_probe_is_silent(self):
         assert recovery.probe("shuffle.recv_guard") == (None, False)
 
+    def test_grammar_accepts_disk_sites_and_enospc(self):
+        recovery.install_faults("disk.write::1=enospc")
+        recovery.install_faults("disk.write=corrupt,disk.read=stall")
+        with pytest.raises(ValueError):
+            recovery.install_faults("shuffle.recv_guard=enospc_typo")
+
+
+# ---------------------------------------------------------------------------
+# bounded IO retry (retry_io): the shared transient-OSError backoff
+# ---------------------------------------------------------------------------
+
+class TestRetryIO:
+    def test_flaky_then_ok_succeeds(self, monkeypatch):
+        """The regression the helper exists for: a single transient
+        OSError (an NFS blip) no longer aborts — attempt 2 lands."""
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] == 1:
+                raise OSError(5, "transient EIO")
+            return "landed"
+
+        assert recovery.retry_io(flaky, "ckpt.write") == "landed"
+        assert calls[0] == 2
+
+    def test_bounded_and_reraises_last(self, monkeypatch):
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        calls = [0]
+
+        def always():
+            calls[0] += 1
+            raise OSError(5, "still down")
+
+        with pytest.raises(OSError):
+            recovery.retry_io(always, "ckpt.write", attempts=3)
+        assert calls[0] == 3        # bounded: never an unbounded loop
+
+    def test_enospc_is_non_transient(self, monkeypatch):
+        """A full disk does not heal on a millisecond backoff: ENOSPC
+        re-raises immediately so the caller's typed degrade path owns
+        it."""
+        import errno
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        calls = [0]
+
+        def full():
+            calls[0] += 1
+            raise OSError(errno.ENOSPC, "disk full")
+
+        with pytest.raises(OSError):
+            recovery.retry_io(full, "disk.write")
+        assert calls[0] == 1
+
+    def test_non_oserror_propagates_untouched(self):
+        with pytest.raises(ValueError):
+            recovery.retry_io(lambda: (_ for _ in ()).throw(
+                ValueError("not io")), "ckpt.write")
+
+    def test_on_retry_callback_and_counter(self, monkeypatch):
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        from cylon_tpu.obs import metrics
+        c0 = metrics.counter("recovery_io_retries").value
+        hits = [0]
+        calls = [0]
+
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError(5, "blip")
+            return 1
+
+        assert recovery.retry_io(
+            flaky, "disk.write",
+            on_retry=lambda: hits.__setitem__(0, hits[0] + 1)) == 1
+        assert hits[0] == 2
+        assert metrics.counter("recovery_io_retries").value == c0 + 2
+
+
+class TestDiskCorruptClassification:
+    def test_disk_site_corruption_is_a_fault(self):
+        from cylon_tpu.status import CheckpointCorruptError
+        e = CheckpointCorruptError("spill page bad", site="disk.read")
+        assert recovery.classify(e) is e
+        # the ladder's recompute rung exists for it
+        assert Code.SerializationError in recovery.RETRY_RUNGS
+
+    def test_ckpt_site_corruption_stays_non_fault(self):
+        """Checkpoint-site corruption keeps its local restore-degrade
+        handling — the ladder must NOT adopt it."""
+        from cylon_tpu.status import CheckpointCorruptError
+        assert recovery.classify(
+            CheckpointCorruptError("page bad", site="ckpt.load")) is None
+        assert recovery.classify(
+            CheckpointCorruptError("page bad")) is None
+
+    def test_wire_round_trip(self):
+        from cylon_tpu.status import CheckpointCorruptError
+        e = CheckpointCorruptError("x", site="disk.read")
+        wire = recovery._wire_code(e)
+        back = recovery._fault_from_wire(wire, "peer corrupt")
+        assert isinstance(back, CheckpointCorruptError)
+        assert back.site == "disk.read"
+
     def test_all_four_kinds_constructible(self):
         """Acceptance: every typed fault kind is constructible via
         injection on the CPU rig."""

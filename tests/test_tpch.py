@@ -106,6 +106,29 @@ def test_q14_matches_pandas(env):
     assert got == pytest.approx(exp, rel=1e-9)
 
 
+def test_q9_matches_pandas(env):
+    """Q9 (round 13, the out-of-core tier's wide-join exerciser): six
+    tables, five joins incl. the two-key partsupp edge, year-grouped
+    profit — bit-checked against the pandas oracle at env1/env4."""
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.002, seed=9)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q9(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q9_pandas(pdfs)
+    assert len(got) == len(exp) > 0
+    pd.testing.assert_frame_equal(got, exp[got.columns], check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_q9_generator_year_column_is_derived():
+    """o_orderyear consumes no RNG draws: every pre-round-13 column
+    stays byte-identical (the regression-baseline rule)."""
+    pdfs = tpch.generate_pandas(scale=0.002, seed=9)
+    o = pdfs["orders"]
+    assert (o.o_orderyear.to_numpy()
+            == o.o_orderdate.dt.year.to_numpy()).all()
+
+
 def test_q18_matches_pandas(env):
     import cylon_tpu as ct
     # lower HAVING threshold so the tiny scale keeps qualifying orders
@@ -292,3 +315,44 @@ def test_round5_generator_additions():
     assert (c.c_cntrycode == c.c_nationkey + 10).all()
     assert (c.c_phone.str.split("-").str[0].astype(int)
             == c.c_nationkey + 10).all()
+
+
+def test_tpch_out_of_core_disk_tier_bit_equal(env4, monkeypatch, tmp_path):
+    """The ISSUE-13 acceptance shape at CI scale: a TPC-H-shaped
+    pipelined join+groupby (lineitem ⋈ orders, the Q3/Q9 spine) under
+    CYLON_TPU_HBM_BUDGET + CYLON_TPU_HOST_BUDGET caps sized below its
+    working set completes BIT-EQUAL to the uncapped run, with
+    disk_events > 0 and bytes_to_disk > 0 — the whole residency ladder
+    (device → host → spill files → mmap windows) under a real TPC-H
+    data distribution.  The full-scale run is `bench.py --tpch` under
+    the same env caps; the subprocess legs live in
+    `scripts/chaos_soak.py --oocore`."""
+    import cylon_tpu as ct
+    from cylon_tpu import config
+    from cylon_tpu.exec import GroupBySink, memory, pipelined_join, recovery
+    pdfs = tpch.generate_pandas(scale=0.002, seed=13)
+    li = ct.Table.from_pandas(
+        pdfs["lineitem"][["l_orderkey", "l_quantity"]], env4)
+    o = ct.Table.from_pandas(
+        pdfs["orders"][["o_orderkey", "o_orderyear"]], env4)
+
+    def run():
+        sink = GroupBySink("o_orderyear", [("l_quantity", "sum")])
+        pipelined_join(li, o, "l_orderkey", "o_orderkey", how="inner",
+                       n_chunks=4, sink=sink)
+        return (sink.finalize().to_pandas().sort_values("o_orderyear")
+                .reset_index(drop=True))
+
+    base = run()
+    import gc
+    gc.collect()
+    memory.reset_stats()
+    recovery.reset_events()
+    monkeypatch.setattr(config, "HBM_BUDGET_BYTES", 4096)
+    monkeypatch.setattr(config, "HOST_BUDGET_BYTES", 4096)
+    monkeypatch.setattr(config, "SPILL_DIR", str(tmp_path / "spill"))
+    capped = run()
+    st = memory.stats()
+    assert st["disk_events"] > 0 and st["bytes_to_disk"] > 0, st
+    assert recovery.recovery_events() == []   # degraded, not escalated
+    pd.testing.assert_frame_equal(capped, base)   # bit-equal

@@ -393,12 +393,48 @@ def test_ts113_scoping():
         "cylon_tpu/relational/join.py", ok))
 
 
+def test_ts114_spill_file_io_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_spill_file_io.py")) if f.rule == "TS114"]
+    # save+join, load+join, env-var join — the neutral-name open, the
+    # non-spill np.save and the counter reads stay clean
+    assert len(found) == 5, found
+    assert all("exec/memory.py" in f.message for f in found)
+
+
+def test_ts114_scoping_and_negatives():
+    src = ("import os\nimport numpy as np\n\n"
+           "def demote(spill_dir, owner, arr):\n"
+           "    np.save(os.path.join(spill_dir, owner + '.spill.npy'), "
+           "arr)\n")
+    # the ledger module is the one sanctioned spill-page IO site
+    assert not any(f.rule == "TS114" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/memory.py", src))
+    assert any(f.rule == "TS114" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", src))
+    assert any(f.rule == "TS114" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/piece.py", src))
+    # the WORD spill outside the on-disk naming never fires: counters,
+    # the consensus verb, ordinary residency flags
+    clean = ("def f(memory, stats, mesh, recovery):\n"
+             "    n = stats['spill_events']\n"
+             "    recovery.spill_consensus(mesh, True)\n"
+             "    return n\n")
+    assert not any(f.rule == "TS114" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", clean))
+    # ordinary np.save of a non-spill path stays clean
+    io_clean = ("import numpy as np\n\ndef f(arr, path):\n"
+                "    np.save(path, arr)\n")
+    assert not any(f.rule == "TS114" for f in ast_lint.lint_source(
+        "cylon_tpu/exec/pipeline.py", io_clean))
+
+
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
                                        "TS109", "TS110", "TS111", "TS112",
-                                       "TS113"}
+                                       "TS113", "TS114"}
 
 
 # ---------------------------------------------------------------------------
