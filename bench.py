@@ -94,9 +94,17 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
         lk = np.where(rng.random(n) < skew, hot, lk)
     lt = ct.Table.from_pydict(
         {"k": lk, "a": rng.integers(0, max_val, n).astype(np.int64)}, env)
+    rk = rng.integers(0, max_val, n).astype(np.int64)
+    if skew > 0.0:
+        # apples-to-apples across skew levels: the hot key appears
+        # EXACTLY once on the build side, so every probe row — hot or
+        # not — joins ~1 build row and the output stays ~n rows at any
+        # skew (a hot key that randomly drew 2+ build rows would double
+        # the skewed config's output and poison the throughput ratio)
+        rk[rk == hot] = hot + 1
+        rk[0] = hot
     rt = ct.Table.from_pydict(
-        {"k": rng.integers(0, max_val, n).astype(np.int64),
-         "b": rng.integers(0, max_val, n).astype(np.int64)}, env)
+        {"k": rk, "b": rng.integers(0, max_val, n).astype(np.int64)}, env)
 
     # Route by size: the monolithic fused join+groupby OOMs past ~48M
     # rows/chip in 16 GB HBM; the north-star config (125M rows/chip = 1B
@@ -181,6 +189,70 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     # which is how piece r+1's overlap with piece r's consume shows up.
     snap = timing.snapshot()
     dispatch_s, block_s = timing.split_snapshot(snap)
+    # capture the ARMED per-rank report of the (split-armed) profiled
+    # iteration BEFORE the unsplit baseline leg below resets the timing
+    # accumulators for its own "before" snapshot
+    rank_rep = obs.rank_report.report() if obs.rank_report.armed() else None
+    # ... and the recovery/spill/checkpoint counters: they were reset to
+    # report THIS workload's events, and the unsplit audit leg below can
+    # spill/degrade on its own (the hot key concentrates on one rank
+    # there) — its events must not read as the measured run's
+    bench_counters = obs.bench_detail(plan=qplan)
+
+    # --skew: the adaptive skew-split decision + an UNSPLIT baseline leg
+    # (CYLON_TPU_SKEW_SPLIT=0 semantics) on the same config, so the win —
+    # and the plan that bought it — are auditable in one BENCH row
+    # (docs/skew.md; ISSUE 14 acceptance: skew-0.9 throughput >= 80% of
+    # skew-0.0 on the same config).
+    skew_detail = {}
+    if skew > 0.0:
+        from cylon_tpu.relational import skew as skew_facade
+        plan_rec = skew_facade.last_plan()
+        skew_detail["skew_route"] = ("skew_split" if plan_rec is not None
+                                     else "hash")
+        skew_detail["skew_plan"] = (plan_rec.summary()
+                                    if plan_rec is not None else None)
+        if plan_rec is not None:
+            skew_detail["skew_split_keys"] = int(len(plan_rec))
+            skew_detail["skew_fanout"] = [int(f) for f in plan_rec.fanout]
+    # the audit leg only means something when the profiled run actually
+    # split — on the pipelined route (plain hashing, no plan) or a
+    # detection decline the re-run would compare two identical unsplit
+    # executions at full workload cost
+    if skew > 0.0 and skew_detail.get("skew_route") == "skew_split":
+        prev_split = config.SKEW_SPLIT
+        prev_bench2 = config.BENCH_TIMINGS
+        config.SKEW_SPLIT = False
+        config.BENCH_TIMINGS = False
+        try:
+            step()  # warmup/compile the unsplit programs
+            # min-of-N against min-of-N: `best` is the split run's best
+            # of `iters` samples, so the unsplit leg gets the same
+            # treatment — a one-shot sample would let ordinary
+            # per-iteration jitter inflate the recorded speedup
+            un_times = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                step()
+                un_times.append(time.perf_counter() - t0)
+            skew_detail["unsplit_iter_s"] = round(min(un_times), 4)
+            skew_detail["unsplit_all_iters_s"] = [round(t, 4)
+                                                  for t in un_times]
+            skew_detail["split_vs_unsplit_speedup"] = round(
+                skew_detail["unsplit_iter_s"] / best, 3)
+            if obs.rank_report.armed():
+                # the "before" half of the before/after rank-skew pair
+                # (the armed main report above is the "after")
+                config.BENCH_TIMINGS = True
+                config.TIMING_ASYNC = timing_async
+                timing.reset()
+                step()
+                skew_detail["rank_phase_skew_unsplit"] = \
+                    obs.rank_report.report()
+        finally:
+            config.SKEW_SPLIT = prev_split
+            config.BENCH_TIMINGS = prev_bench2
+            config.TIMING_ASYNC = prev_async
     return {
         "metric": ("dist join+groupby throughput (int64 keys"
                    + (f", skew={skew:g}" if skew else "") + ")"),
@@ -215,8 +287,10 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # heavy-hitter work stands on — one hot rank's piece_join
             # seconds towering over the median IS the skew signal.
             # Unarmed: not called, zero extra collectives.
-            **({"rank_phase_skew": obs.rank_report.report()}
-               if obs.rank_report.armed() else {}),
+            **({"rank_phase_skew": rank_rep}
+               if rank_rep is not None else {}),
+            # --skew: plan decision + unsplit-baseline audit leg
+            **skew_detail,
             # heavy-hitter profile of the skewed key column (obs/plan
             # key_profile — Misra-Gries over shard-weighted samples):
             # names the hot keys and their estimated share, the ROADMAP
@@ -238,8 +312,10 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
             # "resharded and fast-forwarded" apart from "threw the
             # checkpoint away" after a topology change (elastic resume);
             # plan= attaches the profiled iteration's EXPLAIN ANALYZE
-            # tree as the "plan" section
-            **obs.bench_detail(plan=qplan),
+            # tree as the "plan" section.  Snapshotted BEFORE the
+            # unsplit audit leg so its events stay out of this run's
+            # counters.
+            **bench_counters,
         },
     }
 

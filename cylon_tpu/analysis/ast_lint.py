@@ -84,6 +84,18 @@ function within the same module) — and flags:
   residency picture stops describing reality (the disk-tier analog of
   TS106 for residency and TS107 for checkpoints);
 
+* **TS115** skew-plan decisions outside the ``relational/skew.py`` plan
+  facade — a call to the split-targets primitive
+  (``skew_split_targets``), the plan vote (``skew_plan_consensus``) or
+  the ``SkewPlan`` constructor, or an assignment to a plan's salted
+  split-set fields (``fanout``/``chunk``/``start``/``home``/
+  ``src_off``) anywhere else: the facade is what guarantees the
+  finalize replication guard runs, the canonical plan hash covers every
+  field that shapes the collective sequence, and the ``Code.SkewPlan``
+  vote lands BEFORE the split's first exchange — an ad-hoc split or a
+  post-vote salt mutation can put ranks into different exchange plans
+  and silently void the stitched output's bit/order-equality contract;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -202,6 +214,17 @@ _PLAN_DIRS = ("relational", "exec", "stream")
 #: directory that merely happens to be called "obs" must not disable
 #: the rule for everything under it)
 _OBS_PKG_PAIR = "/cylon_tpu/obs/"
+
+#: skew-plan primitives callable ONLY from the relational/skew.py plan
+#: facade (TS115): the facade owns split-set construction (detect →
+#: finalize guard → canonical hash → Code.SkewPlan vote) and salt
+#: assignment — a direct call elsewhere skips all of it
+_SKEW_FACADE_FILE = "relational/skew.py"
+_SKEW_PLAN_FUNCS = {"skew_split_targets", "skew_plan_consensus",
+                    "SkewPlan"}
+#: salted split-set fields of a SkewPlan no non-facade module may
+#: assign (a post-vote mutation desyncs the voted plan hash)
+_SKEW_PLAN_FIELDS = {"fanout", "chunk", "start", "home", "src_off"}
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -469,6 +492,7 @@ class _ModuleLint:
         self._check_stats_dicts()
         self._check_plan_stack()
         self._check_spill_file_io()
+        self._check_skew_plan()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -835,6 +859,49 @@ class _ModuleLint:
                     "the ledger facade (demote/promote_host/"
                     "upload_window); ad-hoc page IO can adopt a torn "
                     "write and skews the residency picture")
+
+    def _check_skew_plan(self) -> None:
+        """TS115: a skew-plan decision outside the relational/skew.py
+        plan facade — the split-targets primitive, the plan vote or the
+        ``SkewPlan`` constructor called directly, or a plan's salted
+        split-set field assigned.  The facade is the one place where
+        detection feeds the finalize replication guard, the canonical
+        plan hash covers every collective-shaping field, and the
+        ``Code.SkewPlan`` vote runs before the split's first exchange
+        (docs/skew.md); the defining module is exempt by
+        construction."""
+        norm = self.path.replace(os.sep, "/")
+        if norm.endswith(_SKEW_FACADE_FILE):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                fname = _func_name(node.func)
+                if fname.split(".")[-1] in _SKEW_PLAN_FUNCS:
+                    self._emit(
+                        "TS115", node,
+                        f"`{fname}` makes a skew-plan decision outside "
+                        "the relational/skew.py plan facade — split-set "
+                        "construction, salt assignment and the "
+                        "Code.SkewPlan vote must go through "
+                        "detect/finalize_or_none/adopt/split_exchange "
+                        "so every rank enters ONE voted exchange plan "
+                        "(docs/trace_safety.md, docs/skew.md)")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr in _SKEW_PLAN_FIELDS
+                            and isinstance(t.value, ast.Name)
+                            and "plan" in t.value.id.lower()):
+                        self._emit(
+                            "TS115", node,
+                            f"assignment to `{t.value.id}.{t.attr}` "
+                            "mutates a SkewPlan's salted split set "
+                            "outside the relational/skew.py facade — a "
+                            "post-vote mutation desyncs the canonical "
+                            "plan hash the ranks agreed on "
+                            "(docs/trace_safety.md, docs/skew.md)")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

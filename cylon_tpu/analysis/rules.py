@@ -69,6 +69,13 @@ RULES = {
              "the demote/promote traffic only behind the ledger facade; "
              "ad-hoc page IO can adopt a torn write and skews the "
              "residency accounting",
+    "TS115": "skew-plan decision (split-set construction, salt "
+             "assignment, split targets, plan vote) outside the "
+             "relational/skew.py plan facade — an ad-hoc split skips "
+             "the finalize guard, the canonical plan hash and the "
+             "rank-coherent Code.SkewPlan vote, so ranks can enter "
+             "different exchange plans and the stitched output loses "
+             "its bit/order-equality contract",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -251,6 +251,27 @@ SKEW_MAX_KEYS = int(os.environ.get("CYLON_TPU_SKEW_MAX_KEYS", "8"))
 SKEW_GUARD_RATIO = float(os.environ.get("CYLON_TPU_SKEW_GUARD_RATIO", "2.0"))
 SKEW_GUARD_ROWS = int(os.environ.get("CYLON_TPU_SKEW_GUARD_ROWS", "65536"))
 
+# Adaptive skew-split join (relational/skew.py — the plan facade, lint
+# rule TS115; docs/skew.md).  Heavy probe keys detected through the
+# weighted Misra-Gries sketch (obs/sketch) are split across a contiguous
+# rank group (order-preserving salted sub-partitioning) with the matching
+# build rows duplicate-broadcast to the group; the output is stitched
+# back bit-equal AND order-equal to the unsplit hash plan.
+#: Master switch (default ARMED — "0" falls back to plain hashing for
+#: inner/left/right/outer; semi/anti keep the legacy round-robin spread):
+SKEW_SPLIT = os.environ.get("CYLON_TPU_SKEW_SPLIT", "1") != "0"
+#: Conservative absolute share floor: a key must hold at least this
+#: fraction of the probe side (in addition to exceeding
+#: SKEW_GLOBAL_FACTOR / world) before the facade will split it — at
+#: large worlds 1/W alone is far too eager for the stitch's extra pass:
+SKEW_SPLIT_SHARE = float(os.environ.get("CYLON_TPU_SKEW_SPLIT_SHARE",
+                                        "0.05"))
+#: Fan-out oversubscription: a key with estimated share s splits over
+#: ceil(s * world * FANOUT_FACTOR) contiguous ranks (clamped to
+#: [2, world] and to the key's exact row count):
+SKEW_FANOUT_FACTOR = float(os.environ.get("CYLON_TPU_SKEW_FANOUT_FACTOR",
+                                          "1.25"))
+
 #: Distributed-sort splitter samples per shard: grows with the world size
 #: (more shards need finer splitters for the same balance; the reference's
 #: SortOptions.num_samples is likewise caller-tunable, table.hpp:358).

@@ -709,10 +709,17 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
     inner/left/right/outer all stream (an unmatched build row's probe
     matches could only be in its own range — no cross-chunk bookkeeping).
 
-    Note: pieces shuffle with plain hashing — the monolithic join's
-    heavy-key skew split is not applied here, so an extreme single-key
-    distribution still concentrates on one shard (use join_tables for
-    skewed keys).
+    Note: pieces shuffle with plain hashing — the adaptive skew-split
+    plan (relational/skew.py, docs/skew.md) is not applied to the range
+    loop's pre-shuffle: range boundaries snap to key-group starts, so a
+    salted heavy key would straddle a range's rank group and break the
+    per-piece completeness contract every join type stands on (and the
+    key-disjoint sink fast path with it).  An extreme single-key
+    distribution therefore still concentrates one RANGE's piece on one
+    shard — use the monolithic ``join_tables`` for skewed keys, where
+    the split + stitch route engages; under EXPLAIN ANALYZE the probe
+    side's heavy-hitter profile (``est_rows_per_rank``) is attached to
+    this node so the exposure is visible in plan diffs.
 
     ``sink``: the downstream operator of the pipeline (the reference's next
     ``Op`` in the DAG).  When given, each output piece is passed to
@@ -732,6 +739,16 @@ def pipelined_join(left: Table, right: Table, left_on, right_on,
                           else None)) as pn:
         if pn:
             pn.set(rows_in=left.row_count + right.row_count)
+            # heavy-hitter exposure of the PROBE side (analyze mode
+            # only) — the right table for how='right', matching the
+            # skew route's probe choice: the pipelined route has no
+            # skew split, so the profile's est_rows_per_rank is the
+            # "why not this plan" evidence in explain.py diffs
+            # (docs/skew.md)
+            probe, probe_on = (right, right_on) if how == "right" \
+                else (left, left_on)
+            po = [probe_on] if isinstance(probe_on, str) else list(probe_on)
+            _plan.profile_keys(pn, probe, po)
         res = _pipelined_join_impl(left, right, left_on, right_on, how,
                                    n_chunks, suffixes, sink, pn)
         if pn and type(res) is Table:

@@ -391,15 +391,24 @@ def key_profile(table, key_names, k: int = SKETCH_K,
     fraction of rows the hottest rank would receive under plain hash
     partitioning: the top key's share plus a uniform spread of the
     rest — the imbalance ROADMAP item 2's splitter will be judged
-    against."""
+    against.  ``est_rows_per_rank`` places each tracked key on its
+    ACTUAL partition (``ops/hashing.partition_of`` over the sampled
+    routing hash — the exact shuffle predicate) and spreads the
+    untracked residue uniformly: the per-rank row histogram the CURRENT
+    partitioner would produce, which is what ``scripts/explain.py``
+    diffs against a split plan's balanced layout to answer "why this
+    plan" (docs/skew.md)."""
+    import numpy as np
+
     from .sketch import MisraGries
+    from ..ops.hashing import partition_of
     from ..relational.common import sample_keys
 
     key_names = [key_names] if isinstance(key_names, str) else list(key_names)
-    sampled = sample_keys(table, key_names, m=m)
+    sampled = sample_keys(table, key_names, m=m, with_hashes=True)
     if sampled is None:
         return None
-    values, weights, total_rows = sampled
+    values, weights, total_rows, hashes = sampled
     mg = MisraGries(k=k)
     mg.update(values, weights)
     w = table.env.world_size
@@ -407,7 +416,19 @@ def key_profile(table, key_names, k: int = SKETCH_K,
     heavy = [{"key": kv, "share": round(sh, 6), "err": round(err, 6)}
              for kv, sh, err in shares if sh > max(err, 1.0 / (2 * k))]
     top = shares[0][1] if shares else 0.0
-    covered = sum(sh for _, sh, _ in shares)
+    covered = min(sum(sh for _, sh, _ in shares), 1.0)
+    # identity -> routing hash (first sampled occurrence); tracked keys
+    # land on partition_of(hash), the residue spreads uniformly
+    id2hash = {}
+    for v, h in zip(values.tolist(), hashes.tolist()):
+        id2hash.setdefault(v, int(h))
+    per_rank = np.full(w, (1.0 - covered) / w * total_rows)
+    for kv, sh, _err in shares:
+        h = id2hash.get(kv)
+        if h is None:           # decayed out of the sample window
+            per_rank += sh * total_rows / w
+        else:
+            per_rank[partition_of(h, w)] += sh * total_rows
     return {
         "keys": key_names,
         "sampled": int(len(values)),
@@ -416,6 +437,7 @@ def key_profile(table, key_names, k: int = SKETCH_K,
         "heavy": heavy,
         "max_key_share": round(top, 6),
         "est_max_rank_share": round(top + max(1.0 - covered, 0.0) / w, 6),
+        "est_rows_per_rank": [int(round(x)) for x in per_rank],
     }
 
 
@@ -516,6 +538,14 @@ def _node_line(d: dict) -> str:
     if hh and hh.get("heavy"):
         top = hh["heavy"][0]
         bits.append(f"hot[{top['key']}≈{top['share']:.1%}]")
+    if hh and hh.get("est_rows_per_rank"):
+        # the "why this plan" number (docs/skew.md): the hottest rank's
+        # estimated row share under the CURRENT partitioner — what a
+        # split plan's balanced layout is judged against
+        per = hh["est_rows_per_rank"]
+        tot = sum(per) or 1
+        hot_r = max(range(len(per)), key=per.__getitem__)
+        bits.append(f"rank_max[r{hot_r}≈{per[hot_r] / tot:.1%} of rows]")
     return " ".join(bits)
 
 

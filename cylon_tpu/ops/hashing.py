@@ -79,3 +79,15 @@ def partition_targets(h: jax.Array, world: int) -> jax.Array:
     if (world & (world - 1)) == 0:
         return (h & jnp.uint32(world - 1)).astype(jnp.int32)
     return (h % jnp.uint32(world)).astype(jnp.int32)
+
+
+def partition_of(h: int, world: int) -> int:
+    """Host-side mirror of :func:`partition_targets` for ONE u32 hash —
+    the skew-plan facade (relational/skew.py) derives each heavy key's
+    HOME rank from its sampled device hash with exactly the routing
+    math, so the split plan's rank groups anchor where plain hashing
+    would have sent the key.  Keep the two in lockstep."""
+    h = int(h) & 0xFFFFFFFF
+    if (world & (world - 1)) == 0:
+        return h & (world - 1)
+    return h % world

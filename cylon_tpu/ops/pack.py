@@ -314,6 +314,17 @@ def _rows_cmp_splitters(keyops: KeyOps, splitter_ops: tuple):
     return gt, eq
 
 
+def rows_cmp_splitters(keyops: KeyOps, splitter_ops: tuple):
+    """(gt, eq) (n, S) bool pairs: row i's key tuple strictly greater
+    than / exactly equal to splitter j's under the operand total order —
+    the comparison primitive of the skew-split plan facade
+    (relational/skew.py): heavy-key membership (eq) and key-rank
+    corrections (gt ≡ "splitter sorts before row") both run in OPERAND
+    space, so they agree bit-for-bit with the join sort's own key order
+    (float canonicalization, null flags, narrow lanes and all)."""
+    return _rows_cmp_splitters(keyops, splitter_ops)
+
+
 def rows_gt_splitters(keyops: KeyOps, splitter_ops: tuple):
     """(n, S) bool: row i's key tuple strictly greater than splitter j's.
     Used by sample-sort range partitioning (reference table.cpp:564-609

@@ -146,6 +146,81 @@ def skew_targets(mesh: Mesh, key_datas, key_valids,
     return fn(vc, hv, *key_datas, *key_valids)
 
 
+@program_cache()
+def _skew_split_targets_fn(mesh: Mesh, w: int, k: int, nkeys: int,
+                           need_nf: tuple, narrow: tuple):
+    """Targets for the adaptive skew-split probe side (the plan facade,
+    relational/skew.py — lint rule TS115): light rows hash as usual;
+    rows equal (in sort-OPERAND space) to one of the K heavy tuples are
+    salted by their WITHIN-KEY arrival index STRIDED over the key's
+    contiguous rank group — global row j of the key goes to member
+    ``j mod fanout``.  The strided (round-robin) salt keeps every
+    member's rows an order-preserving SUBSEQUENCE of the key's global
+    (source rank, source position) order — the property the stitch's
+    bit/order-equality contract stands on — while spreading EVERY
+    source's heavy rows evenly over the whole group, so the exchange's
+    per-(src,dst) cells stay uniform-sized and single-round (a
+    contiguous-chunk salt would map each source's heavy block onto one
+    or two members and quadruple the padded exchange's rounds;
+    docs/skew.md).  Pure-local: the plan sidecars are replicated host
+    arrays; no collective."""
+    from ..ops import pack
+
+    def per_shard(vc, srcoff, fan, start, *args):
+        datas = list(args[:nkeys])
+        valids = list(args[nkeys:2 * nkeys])
+        tup = args[2 * nkeys:]
+        cap = datas[0].shape[0]
+        my = jax.lax.axis_index(ROW_AXIS)
+        mask = jnp.arange(cap) < vc[my]
+        h = hashing.hash_rows(datas, valids)
+        base = hashing.partition_targets(h, w)
+        ko_t = pack.key_operands(list(tup[:nkeys]), list(tup[nkeys:]),
+                                 need_null_flags=need_nf, narrow32=narrow)
+        ko_r = pack.key_operands(datas, valids, need_null_flags=need_nf,
+                                 narrow32=narrow)
+        _gt, eq = pack.rows_cmp_splitters(ko_r, ko_t.ops)
+        eq = eq & mask[:, None]
+        heavy = jnp.any(eq, axis=1)
+        kidx = jnp.argmax(eq, axis=1).astype(jnp.int32)
+        # born-wide int64 (JX203): within-key indices are GLOBAL row
+        # counts — a single heavy key can exceed int32 at target scale
+        eqi = eq.astype(jnp.int64)
+        loc = jnp.cumsum(eqi, axis=0) - eqi          # within-shard index
+        loc_k = jnp.take_along_axis(loc, kidx[:, None], axis=1)[:, 0]
+        j = srcoff[my, kidx] + loc_k
+        # fan arrives born-wide int64 (K,) so the row-scale modulus never
+        # widens an int32 lane (JX203)
+        ordn = (j % fan[kidx]).astype(jnp.int32)
+        tgt_h = (start[kidx] + ordn) % w
+        tgt = jnp.where(heavy, tgt_h, base)
+        return jnp.where(mask, tgt, jnp.int32(w))
+
+    specs = (P(), P(), P(), P()) + (P(ROW_AXIS),) * (2 * nkeys) \
+        + (P(),) * (2 * nkeys)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+                             out_specs=P(ROW_AXIS)))
+
+
+def skew_split_targets(mesh: Mesh, key_datas, key_valids,
+                       valid_counts: np.ndarray, k: int, need_nf: tuple,
+                       narrow: tuple, tuple_args: tuple,
+                       src_off: np.ndarray, fanout: np.ndarray,
+                       start: np.ndarray):
+    """Per-row targets for a skew-split probe exchange — called ONLY by
+    the plan facade (relational/skew.py, lint rule TS115), which owns
+    every sidecar's derivation.  ``key_valids`` entries must be real
+    arrays (all-ones for non-nullable columns)."""
+    w = valid_counts.shape[0]
+    vc = np.asarray(valid_counts, np.int32)
+    fn = _skew_split_targets_fn(mesh, w, int(k), len(key_datas), need_nf,
+                                narrow)
+    return fn(vc, np.asarray(src_off, np.int64),
+              np.asarray(fanout, np.int64),
+              np.asarray(start, np.int32), *key_datas, *key_valids,
+              *tuple_args)
+
+
 # ---------------------------------------------------------------------------
 # Phase B: padded exchange, multi-round + order-preserving placement
 #
@@ -444,6 +519,16 @@ def _trace_skew_targets(mesh):
                               S((w * cap,), np.bool_))
 
 
+def _trace_skew_split_targets(mesh):
+    w, cap, S = _decl_shapes(mesh)
+    fn = _unwrap(_skew_split_targets_fn(mesh, w, 2, 1, (True,), (False,)))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w, 2), np.int64),
+                              S((2,), np.int64), S((2,), np.int32),
+                              S((w * cap,), np.int64),
+                              S((w * cap,), np.bool_),
+                              S((2,), np.int64), S((2,), np.bool_))
+
+
 def _trace_prep(mesh):
     w, cap, S = _decl_shapes(mesh)
     fn = _unwrap(_prep_fn(mesh, w))
@@ -460,4 +545,6 @@ declare_builder(f"{__name__}._hash_targets_fn", _trace_hash_targets,
 declare_builder(f"{__name__}._count_fn", _trace_count, tags=("shuffle",))
 declare_builder(f"{__name__}._skew_targets_fn", _trace_skew_targets,
                 tags=("shuffle", "skew"))
+declare_builder(f"{__name__}._skew_split_targets_fn",
+                _trace_skew_split_targets, tags=("shuffle", "skew"))
 declare_builder(f"{__name__}._prep_fn", _trace_prep, tags=("shuffle",))

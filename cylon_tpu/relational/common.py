@@ -305,15 +305,18 @@ from ..utils.cache import program_cache  # noqa: E402
 
 
 @program_cache()
-def _key_sample_fn(mesh, m: int, nkeys: int):
+def _key_sample_fn(mesh, m: int, nkeys: int, with_valids: bool = False):
     """Evenly spaced per-shard sample of RAW key values plus the
     canonicalizing row hash — the sort-splitter sampling machinery
     (:func:`sample_positions`, relational/sort._sample_fn) applied to
     the profiler's needs: values NAME the hot keys (single integer-ish
     keys), the hash covers multi-column/float/string tuples with exactly
-    the shuffle-routing predicate (ops/hashing.hash_rows).  Pure-local
-    per-shard program: no collective, no widening (jaxpr-gate
-    registered)."""
+    the shuffle-routing predicate (ops/hashing.hash_rows).
+    ``with_valids=True`` (the skew-split plan facade, relational/skew.py)
+    additionally samples each key column's VALIDITY bit so a sampled
+    tuple carries its full null structure — heavy NULL keys participate
+    in the split exactly like values.  Pure-local per-shard program: no
+    collective, no widening (jaxpr-gate registered)."""
     from ..ops import hashing
 
     def per_shard(vc, *args):
@@ -325,10 +328,13 @@ def _key_sample_fn(mesh, m: int, nkeys: int):
         h = hashing.hash_rows(datas, valids)
         idx = sample_positions(n, m, cap)
         live = jnp.full((m,), n > 0)
-        return tuple(d[idx] for d in datas) + (h[idx], live)
+        out = tuple(d[idx] for d in datas)
+        if with_valids:
+            out += tuple(v[idx] for v in valids)
+        return out + (h[idx], live)
 
     specs = (REP,) + (ROW,) * (2 * nkeys)
-    nouts = nkeys + 2
+    nouts = nkeys * (2 if with_valids else 1) + 2
     return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=specs,
                                  out_specs=(ROW,) * nouts))
 
@@ -346,15 +352,20 @@ def _key_value_repr(col: Column, vals: np.ndarray):
     return vals
 
 
-def sample_keys(table: Table, key_names: list, m: int | None = None):
+def sample_keys(table: Table, key_names: list, m: int | None = None,
+                with_hashes: bool = False):
     """Sample ``table``'s key columns for the heavy-hitter profiler:
     returns ``(values, weights, total_rows)`` — a flat host array of
     sampled key identities (values for a single key column, row hashes
     for composite keys), a parallel weight array normalizing each
     shard's samples by its true row share (the join skew detector's
     weighting, relational/join._heavy_keys), and the global live row
-    count.  None for empty tables.  Armed-profiler path only: one small
-    device program + one host pull."""
+    count.  ``with_hashes=True`` appends a fourth element: the routing
+    hash (ops/hashing.hash_rows) aligned with ``values``, so the
+    profiler can place each identity on its CURRENT partition
+    (obs/plan.key_profile ``est_rows_per_rank``).  None for empty
+    tables.  Armed-profiler path only: one small device program + one
+    host pull."""
     from .. import config
     from ..utils.host import host_array
 
@@ -381,28 +392,82 @@ def sample_keys(table: Table, key_names: list, m: int | None = None):
     else:
         raw = hashes
     vc = np.asarray(table.valid_counts, np.float64)
-    values, weights = [], []
+    values, weights, hlist = [], [], []
     for s in range(w):
         lv = raw[s][live[s]]
         if lv.size == 0:
             continue
         values.append(lv)
+        hlist.append(hashes[s][live[s]])
         # each shard contributes its true row share, split evenly over
         # its samples — unweighted pooling would let a tiny shard's
         # keys dominate the estimate
         weights.append(np.full(lv.size, vc[s] / total / lv.size))
     if not values:
         return None
-    return (np.concatenate(values), np.concatenate(weights) * total,
-            total)
+    out = (np.concatenate(values), np.concatenate(weights) * total, total)
+    if with_hashes:
+        out += (np.concatenate(hlist).astype(np.uint32),)
+    return out
+
+
+def sample_key_rows(table: Table, key_names: list, m: int | None = None):
+    """Shard-weighted sample of FULL key tuples for the skew-split plan
+    facade (relational/skew.py): returns ``(values, valids, hashes,
+    weights, total_rows)`` — ``values``/``valids`` are per-key-column
+    host arrays of the sampled raw data and validity bits (so a heavy
+    tuple can be re-uploaded as an operand-space constant, nulls
+    included), ``hashes`` the canonicalizing routing hash per sampled
+    row, ``weights`` the same per-shard row-share normalization as
+    :func:`sample_keys`.  None for empty tables.  One small pure-local
+    device program + one host pull — no collective (the plan decision
+    stays rank-uniform because the pull allgathers)."""
+    from .. import config
+    from ..utils.host import host_array
+
+    env = table.env
+    total = int(table.valid_counts.sum())
+    if total == 0:
+        return None
+    w = env.world_size
+    if m is None:
+        m = config.SKEW_SAMPLE
+    m = min(max(int(table.capacity), 1), int(m))
+    cols = [table.column(n) for n in key_names]
+    cap = cols[0].data.shape[0]
+    nk = len(cols)
+    datas = tuple(c.data for c in cols)
+    valids = tuple(c.validity if c.validity is not None
+                   else np.ones(cap, bool) for c in cols)
+    outs = _key_sample_fn(env.mesh, m, nk, True)(
+        np.asarray(table.valid_counts, np.int32), *datas, *valids)
+    vals = [host_array(o).reshape(w * m) for o in outs[:nk]]
+    vls = [host_array(o).reshape(w * m) for o in outs[nk:2 * nk]]
+    hashes = host_array(outs[-2]).reshape(w * m)
+    live = host_array(outs[-1]).reshape(w, m)
+    vc = np.asarray(table.valid_counts, np.float64)
+    keep = live.reshape(-1)
+    if not keep.any():
+        return None
+    # each shard contributes its true row share split evenly over its
+    # samples (the sample_keys weighting) — scaled to absolute rows
+    per_shard_w = np.repeat(
+        np.where(vc > 0, vc / np.maximum(m, 1), 0.0), m)
+    return ([v[keep] for v in vals], [v[keep] for v in vls],
+            hashes[keep], per_shard_w[keep], total)
 
 
 def _trace_key_sample(mesh):
     w = int(mesh.devices.size)
     cap, S = 1024, jax.ShapeDtypeStruct
     fn = _key_sample_unwrap(_key_sample_fn(mesh, 64, 1))
-    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64),
-                              S((w * cap,), np.bool_))
+    fnv = _key_sample_unwrap(_key_sample_fn(mesh, 64, 1, True))
+
+    def both(vc, d, v):
+        return fn(vc, d, v), fnv(vc, d, v)
+
+    return jax.make_jaxpr(both)(S((w,), np.int32), S((w * cap,), np.int64),
+                                S((w * cap,), np.bool_))
 
 
 from ..analysis.registry import declare_builder as _declare_builder, \

@@ -83,6 +83,18 @@ class JoinState(NamedTuple):
     cap_l: int
     cap_r: int
     all_live: bool
+    #: finalized skew-split plan (relational/skew.SkewPlan) when the join
+    #: ran the adaptive heavy-key route: each heavy key's rows span a
+    #: RANK GROUP, so the fused kernel's per-shard output rows are
+    #: PARTIALS for those keys and resolve() must run the tiny
+    #: heavy-partial combine (skew.combine_heavy_partials) before the
+    #: result is final.  None for plain colocated joins.
+    skew_plan: object = None
+    #: with ``skew_plan``: materializes the SPLIT-layout join output
+    #: WITHOUT the stitch — the pre-stitch table an order-insensitive
+    #: consumer takes when the fused pushdown itself declines
+    #: (relational/skew.consume_unstitched include_deferred leg)
+    pre_thunk: object = None
 
 
 def _col_entry(state: JoinState, name: str):
@@ -256,6 +268,16 @@ def try_begin_join_groupby(table: Table, by: list, specs: list,
         return None
     if tuple(by) != state.key_names:
         return None
+    #: under a skew plan the heavy keys' per-shard fused rows are
+    #: PARTIALS, combinable only for ops whose FINALIZED value is
+    #: additive in the probe chunks (S_chunk·R over the members sums to
+    #: S_g·R).  mean/var/std finalize to ratios of moments the members
+    #: no longer share — those take the materialize path, where
+    #: consume_unstitched still skips the stitch (docs/skew.md).
+    skew_ops = ("sum", "count", "sumsq")
+    if state.skew_plan is not None \
+            and any(op not in skew_ops for _, op, _q, _n in specs):
+        return None
     vspecs = []
     for col, op, _q, _name in specs:
         if op not in PUSHDOWN_OPS:
@@ -395,7 +417,15 @@ def try_begin_join_groupby(table: Table, by: list, specs: list,
         out = _result_table(env, by, by_cols, key_out, kval_out, res_names,
                             res_d, res_v, res_types, res_dicts, n_groups)
         out = _shrink(out, n_groups)
-        out.grouped_by = tuple(by)
+        if state.skew_plan is not None:
+            # heavy-key member rows are partials: sum them onto the home
+            # rank's row and drop the rest — the result equals the
+            # unsplit fused plan's table, layout and all (docs/skew.md)
+            from .skew import combine_heavy_partials
+            out = combine_heavy_partials(out, list(by), res_names,
+                                         state.skew_plan)
+        else:
+            out.grouped_by = tuple(by)
         return out
 
     return _PendingFused(_resolve)

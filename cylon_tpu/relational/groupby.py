@@ -685,6 +685,12 @@ def groupby_aggregate(table: Table, by, aggs, ddof: int = 1) -> Table:
                     aggs=tuple((a[0], a[1]) for a in aggs
                                if isinstance(a, (list, tuple))
                                and len(a) >= 2)) as pn:
+        # a stitch-deferred skew join feeds its PRE-stitch table here:
+        # aggregation cannot observe row order/placement, so the skew
+        # route's merge exchange is elided for join→groupby pipelines
+        # (relational/skew.consume_unstitched, docs/skew.md)
+        from .skew import consume_unstitched
+        table = consume_unstitched(table)
         if pn:
             from ..core.table import DeferredTable
             # a DeferredTable input (fused join→groupby pushdown) stays
@@ -720,6 +726,12 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     if pushed is not None:
         _plan.annotate(route="fused_pushdown")
         return pushed
+    # a skew-deferred join the pushdown could not serve still feeds its
+    # PRE-stitch (split-layout) table here — aggregation cannot observe
+    # row order/placement, so the stitch's merge exchange is skipped
+    # (relational/skew.consume_unstitched, docs/skew.md)
+    from .skew import consume_unstitched
+    table = consume_unstitched(table, include_deferred=True)
     by_cols = [table.column(n) for n in by]
     val_cols = [table.column(c) for c, _, _, _ in specs]
     from ..core.column import HashedStrings
@@ -855,6 +867,16 @@ def _groupby_aggregate_impl(table: Table, by, aggs, ddof: int = 1) -> Table:
     _plan.annotate(route="grouped_fastpath" if grouped else "raw")
     work = table.project(list(dict.fromkeys(by + [c for c, _, _, _ in specs])))
     if distributed and not grouped:
+        # the raw-row co-location shuffle is the one groupby route a heavy
+        # key CAN concentrate on a single rank: non-decomposable aggs
+        # (quantile/median/nunique) need every row of a group together, so
+        # the join tier's split/duplicate-broadcast remedy does not apply
+        # (associative aggs are skew-immune — per-group intermediates
+        # collapse a heavy key to one row per shard before their shuffle).
+        # Surface the hazard on the plan node so an EXPLAIN diff against
+        # key_profile's est_rows_per_rank names WHY this plan is exposed
+        # (docs/skew.md).
+        _plan.annotate(skew_vulnerable=True)
         work = shuffle_table(work, by)
     by_datas, by_valids = col_arrays([work.column(n) for n in by])
     uniq_names = list(dict.fromkeys(c for c, _, _, _ in specs))

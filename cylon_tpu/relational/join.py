@@ -153,21 +153,33 @@ def _heavy_flag_fn(mesh: Mesh, k: int, nkeys: int):
 
 def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
                       how: str, env):
-    """Distributed co-location with heavy-key skew splitting.
+    """Distributed co-location with adaptive heavy-key skew splitting.
 
-    Default: hash-shuffle both sides (reference table.cpp:219).  When the
-    probe side's sampled key-hash distribution has heavy hitters
-    (inner/left/right joins; single- AND multi-column keys, float keys
-    included — detection and flagging run on the canonicalizing row hash,
-    ops/hashing.hash_rows), the probe side's heavy rows are SPREAD
-    round-robin instead of hashed and the build side's heavy rows are
-    replicated to every shard (duplicate-broadcast, via AllGather(Table))
-    — peak per-shard memory stays ~input-sized instead of one shard
-    receiving the whole heavy key.  Thresholds: config.SKEW_*.
+    Default: hash-shuffle both sides (reference table.cpp:219).  For
+    inner/left/right/outer joins with ``CYLON_TPU_SKEW_SPLIT`` armed
+    (default), the probe side's sampled key distribution feeds the
+    weighted Misra-Gries detector and any finalized :class:`~.skew.
+    SkewPlan` (relational/skew.py — the plan facade, lint rule TS115)
+    routes the exchange: each heavy key's probe rows land as
+    fixed-stride global-order subsequences on the key's rank group
+    (order-preserving salted sub-partitioning) and its build rows
+    duplicate-broadcast to that group, so no shard ever receives a whole
+    heavy key while the caller can stitch the output bit- and
+    order-equal to the unsplit hash plan (docs/skew.md).  The plan is
+    VOTED over the consensus wire before any split collective runs
+    (``Code.SkewPlan``).
 
-    Returns (lwork, rwork, split_used)."""
+    semi/anti keep the legacy round-robin spread: their output is a
+    filter of probe rows (no output expansion to rebalance, no stitch),
+    and a fully replicated heavy build row lets ANY shard detect the
+    match.
+
+    Returns ``(lwork, rwork, split)`` — ``split`` is False (plain hash),
+    True (broadcast / legacy spread: co-location broken, no plan), or
+    the finalized :class:`~.skew.SkewPlan` (caller must stitch)."""
     from ..parallel import shuffle as shf
     from ..parallel.collectives import allgather_table
+    from . import skew as skewmod
     from .repart import concat_tables, exchange_by_targets, filter_table
 
     # ---- broadcast join: replicate a SMALL side, shuffle NOTHING --------
@@ -193,15 +205,38 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
         _plan.annotate(route="broadcast", broadcast_side="left")
         return allgather_table(lwork), rwork, True
 
-    if how in ("inner", "left", "right", "semi", "anti"):
-        # semi/anti behave like 'left' here: output ⊆ left rows, and a
-        # replicated heavy build row lets ANY shard detect the match
+    if how in ("inner", "left", "right", "outer") and config.SKEW_SPLIT:
+        # adaptive skew-split plan (relational/skew.py): detect heavy
+        # probe keys, vote the plan, split + duplicate-broadcast.  The
+        # escape hatch CYLON_TPU_SKEW_SPLIT=0 is the UNSPLIT baseline
+        # the route's bit/order-equality contract is stated against.
         if how == "right":
             probe, probe_on = rwork, right_on
             build, build_on = lwork, left_on
         else:
             probe, probe_on = lwork, left_on
             build, build_on = rwork, right_on
+        plan = skewmod.detect(probe, probe_on, env)
+        if plan is not None:
+            plan = skewmod.finalize_or_none(plan, probe, probe_on,
+                                            build, build_on)
+        if plan is not None:
+            # vote rides the consensus wire BEFORE the split's first
+            # collective; every rank adopts the identical plan hash
+            skewmod.adopt(plan, env)
+            _plan.annotate(route="skew_split", skew_plan=plan.summary())
+            probe_out, build_out = skewmod.split_exchange(
+                probe, probe_on, build, build_on, plan)
+            if how == "right":
+                return build_out, probe_out, plan
+            return probe_out, build_out, plan
+        _plan.annotate(skew_split_armed=True, skew_split_keys=0)
+
+    if how in ("semi", "anti"):
+        # legacy spread: output ⊆ left rows, and a replicated heavy
+        # build row lets ANY shard detect the match
+        probe, probe_on = lwork, left_on
+        build, build_on = rwork, right_on
         heavy = _heavy_keys(probe, probe_on, env)
         if heavy is not None:
             bcols = [build.column(n) for n in build_on]
@@ -229,47 +264,9 @@ def _shuffle_for_join(lwork: Table, rwork: Table, left_on, right_on,
                                    probe.valid_counts, heavy)
             counts = shf.count_targets(env.mesh, tgt)
             probe_out = exchange_by_targets(probe, tgt, counts)
-            if how == "right":
-                return build_out, probe_out, True
             return probe_out, build_out, True
     return (shuffle_table(lwork, left_on), shuffle_table(rwork, right_on),
             False)
-
-
-def _null_extend_right(runm: Table, lj: Table, left: Table, right: Table,
-                       left_on, right_on, suffixes, coalesce: bool) -> Table:
-    """Unmatched right rows reshaped into the left join's output schema:
-    key columns carry the right keys, right payload columns carry through,
-    left-only columns become all-null (the outer join's right-unmatched
-    emission, ops/join.py join_take ``how == 'outer'`` analog — built
-    table-level for the skew decomposition)."""
-    from ..core.dtypes import physical_np_dtype
-    from ..core.table import _put
-    env = runm.env
-    w, cap = env.world_size, runm.capacity
-    sharding = env.sharding()
-    overlap = (set(left.column_names) & set(right.column_names)) - (
-        set(left_on) if coalesce else set())
-    right_out = {(rn + suffixes[1] if rn in overlap else rn): rn
-                 for rn in right.column_names
-                 if not (coalesce and rn in right_on)}
-    all_false = _put(np.zeros(w * cap, bool), sharding)
-    cols = {}
-    for n in lj.column_names:
-        ljc = lj.columns[n]
-        if coalesce and n in left_on:
-            rn = right_on[left_on.index(n)]
-            _, src = promote_key_pair(ljc, runm.column(rn))
-            cols[n] = src
-        elif n in right_out:
-            cols[n] = runm.column(right_out[n])
-        else:
-            # left-only column: all null, lj's type/dictionary
-            phys = physical_np_dtype(ljc.type)
-            data = _put(np.zeros(w * cap, phys), sharding)
-            cols[n] = Column(data, ljc.type, all_false, ljc.dictionary,
-                             bounds=(0, 0))
-    return Table(cols, env, runm.valid_counts)
 
 
 def _live_cat(vcl, vcr, cap_l: int, cap_r: int):
@@ -417,6 +414,20 @@ def _carry_fn(mesh: Mesh, how: str, cap_l: int, cap_r: int,
     return jax.jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW),
                              out_specs=(ROW,) * 6))
+
+
+@program_cache()
+def _un_count_fn(mesh: Mesh):
+    """Per-shard count of an OUTER join's appended unmatched-right rows
+    (the carry's ``un`` flags) — the skew stitch needs the zone-B split
+    of every shard's output to reconstruct the unsplit plan's row order
+    (relational/skew.stitch_join_output).  One tiny pure-local sum."""
+
+    def per_shard(un):
+        return jnp.sum(un, dtype=jnp.int32).reshape(1)
+
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+                             out_specs=ROW))
 
 
 @program_cache()
@@ -1169,36 +1180,20 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     lwork = left.with_columns(dict(zip(left_on, lkey_cols)))
     rwork = right.with_columns(dict(zip(right_on, rkey_cols)))
 
-    if (how == "outer" and env.world_size > 1 and not assume_colocated
-            and _heavy_keys(lwork, left_on, env) is not None):
-        # Skew-safe FULL OUTER decomposition: outer = skew-split LEFT join
-        # ∪ unmatched-right.  The left join spreads the heavy probe rows
-        # and replicates heavy build rows (bounded per-shard memory); the
-        # unmatched-right complement is an ANTI join against the LEFT
-        # KEYS' DISTINCT rows — a heavy key collapses to one row there, so
-        # its exchange cannot blow a shard either.  Reference slot:
-        # table.cpp:861 DistributedJoin + SURVEY §7 hard-part 4.
-        from .repart import concat_tables
-        from .setops import unique_table
-        _plan.annotate(route="skew_outer_decomposition")
-        lj = join_tables(left, right, left_on, right_on, how="left",
-                         suffixes=suffixes, coalesce_keys=coalesce_keys)
-        lkeys = unique_table(
-            Table({n: left.column(n) for n in left_on}, env,
-                  left.valid_counts))
-        runm = join_tables(right, lkeys, right_on, left_on, how="anti")
-        ext = _null_extend_right(runm, lj, left, right, left_on, right_on,
-                                 suffixes,
-                                 coalesce_keys and left_on == right_on)
-        out = concat_tables([lj, ext])
-        out.grouped_by = None
-        return out
+    from . import skew as skewmod
 
     skew_split = False
+    skew_plan = None
     if env.world_size > 1 and not assume_colocated:
         with timing.region("join.shuffle"):
             lwork, rwork, skew_split = _shuffle_for_join(
                 lwork, rwork, left_on, right_on, how, env)
+        if isinstance(skew_split, skewmod.SkewPlan):
+            # the caller-side half of the adaptive route: the local join
+            # below runs unchanged over the split layout, then the
+            # output stitches back into the UNSPLIT plan's global row
+            # order (bit- and order-equal; docs/skew.md)
+            skew_plan = skew_split
 
     l_key_cols = [lwork.column(n) for n in left_on]
     r_key_cols = [rwork.column(n) for n in right_on]
@@ -1340,8 +1335,15 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
     # resident build side — the HBM headroom the pipeline exists to keep.
     if allow_defer is None:
         allow_defer = not assume_colocated
+    # the adaptive skew-split route (skew_plan) defers exactly like the
+    # plain co-located join — the fused consumer combines the heavy
+    # keys' per-shard partials (fused.py + skew.combine_heavy_partials),
+    # any other access materializes THROUGH the stitch.  The plan-less
+    # split=True legs (broadcast join / legacy semi-anti spread) have no
+    # plan to reconstruct co-location from and stay eager.
     defer = (config.DEFER_JOIN and how == "inner" and carry_emit
-             and carry_match and coalesce and not skew_split and allow_defer)
+             and carry_match and coalesce and allow_defer
+             and (skew_plan is not None or not skew_split))
     if defer:
         with timing.region("join.sort_count"):
             res = _count_fn(env.mesh, how, narrow, cl_spec, cr_spec,
@@ -1369,36 +1371,76 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
                     for nme, d, v, t, dc, b in
                     zip(names, out_d, out_v, types, dicts, bounds)}
 
+        def fb(nc):
+            from ..exec.pipeline import pipelined_join
+            return pipelined_join(left, right, left_on, right_on,
+                                  how=how, n_chunks=nc,
+                                  suffixes=suffixes)
+
+        def pre_table():
+            # SPLIT-layout materialization (no stitch): the pre-stitch
+            # table consume_unstitched hands an order-insensitive
+            # consumer when the fused pushdown declined
+            from .common import run_with_oom_fallback
+
+            def mat():
+                pre = Table(materialize_cols(), env, counts)
+                pre.grouped_by = None
+                return pre
+
+            return run_with_oom_fallback(mat, True, fb,
+                                         "deferred-join materialize",
+                                         env=env)
+
         def thunk():
             # deferred materialization OOMs outside join_tables' wrapper —
             # give it the same streaming fallback; a fallback returns a
             # whole Table, which DeferredTable adopts (layout may differ)
             from .common import run_with_oom_fallback
 
-            def fb(nc):
-                from ..exec.pipeline import pipelined_join
-                return pipelined_join(left, right, left_on, right_on,
-                                      how=how, n_chunks=nc,
-                                      suffixes=suffixes)
+            def mat():
+                cols = materialize_cols()
+                if skew_plan is None:
+                    return cols
+                # merge half of the adaptive route for a non-fused
+                # consumer: stitch the split-layout output back into the
+                # unsplit hash plan's global row order (docs/skew.md)
+                pre = Table(cols, env, counts)
+                pre.grouped_by = None
+                with timing.region("join.skew_stitch"):
+                    return skewmod.stitch_join_output(
+                        pre, list(left_on), skew_plan, how, None)
 
-            return run_with_oom_fallback(materialize_cols, True, fb,
+            return run_with_oom_fallback(mat, True, fb,
                                          "deferred-join materialize",
                                          env=env)
 
         from ..core.table import DeferredTable
         from .fused import JoinState
+        if skew_plan is not None:
+            from .repart import even_partition_counts
+            total = int(counts.sum())
+            d_counts = even_partition_counts(total, env.world_size)
+            d_cap = config.pow2ceil(int(d_counts.max()) if total else 1)
+        else:
+            d_counts, d_cap = counts, out_cap
         state = JoinState(
             vcl=vcl, vcr=vcr, idx_s=idx_s_s, bnd=bnd_s, pl_s=pl_s,
             lspec=lspec, rspec=rspec, plan=tuple(plan),
             names=tuple(names), types=tuple(types), dicts=tuple(dicts),
             key_names=tuple(left_on),
-            cap_l=lwork.capacity, cap_r=rwork.capacity, all_live=all_live)
+            cap_l=lwork.capacity, cap_r=rwork.capacity, all_live=all_live,
+            skew_plan=skew_plan,
+            pre_thunk=pre_table if skew_plan is not None else None)
         out = DeferredTable(
-            env, counts, out_cap, thunk,
+            env, d_counts, d_cap, thunk,
             (tuple(names), tuple(types), tuple(dicts),
              tuple(bool(e[-1]) for e in plan)),
             op_state=state)
-        out.grouped_by = tuple(left_on)
+        # a skew-split layout is not co-located (heavy keys span their
+        # rank groups), and the stitched materialization is in global
+        # row order on the even layout — neither satisfies grouped_by
+        out.grouped_by = None if skew_plan is not None else tuple(left_on)
         return out
 
     with timing.region("join.sort_count"):
@@ -1428,6 +1470,50 @@ def _join_tables_impl(left: Table, right: Table, left_on, right_on,
             out_d, out_v = fn(*mat_args)
     out = build_table(names, out_d, out_v, types, dicts, counts, env,
                       bounds=bounds)
+    if skew_plan is not None:
+        # merge half of the adaptive route: per-row positions in the
+        # UNSPLIT plan's global order + one order-preserving exchange
+        # (repart.place_by_global_pos) — the result is bit- and
+        # order-equal to the plain hash plan, on BALANCED shards.  The
+        # stitch is DEFERRED (DeferredTable + skew.StitchState): an
+        # order-insensitive consumer (groupby) takes the pre-stitch
+        # table and the merge exchange never runs; any other access
+        # stitches transparently.
+        un_counts = None
+        if how == "outer":
+            # per-shard appended unmatched-right counts (zone B) from
+            # the phase-1 carry's `un` flags — one tiny pull
+            un_counts = host_array(_un_count_fn(env.mesh)(carry[5])) \
+                .reshape(-1).astype(np.int64)
+        if coalesce:
+            key_out = list(left_on)
+        elif how == "right":
+            key_out = [n + suffixes[1] if n in overlap else n
+                       for n in right_on]
+        else:
+            key_out = [n + suffixes[0] if n in overlap else n
+                       for n in left_on]
+        from .repart import even_partition_counts
+        pre = out
+        pre.grouped_by = None
+        total = int(counts.sum())
+        dest = even_partition_counts(total, env.world_size)
+
+        def stitch_thunk():
+            with timing.region("join.skew_stitch"):
+                return skewmod.stitch_join_output(
+                    pre, key_out, skew_plan, how, un_counts)
+
+        from ..core.table import DeferredTable
+        dt = DeferredTable(
+            env, dest, config.pow2ceil(int(dest.max()) if total else 1),
+            stitch_thunk,
+            (tuple(names), tuple(types), tuple(dicts),
+             tuple(bool(e[-1]) for e in plan)),
+            op_state=skewmod.StitchState(pre, skew_plan, how, un_counts,
+                                         key_out))
+        dt.grouped_by = None
+        return dt
     if coalesce and not skew_split:
         # join output rows are key-grouped per shard (sorted merge order) and
         # keys are co-located across shards (hash shuffle) -> groupby on the

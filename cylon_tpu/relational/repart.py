@@ -219,6 +219,90 @@ def even_partition_counts(total: int, w: int) -> np.ndarray:
                       np.int64)
 
 
+@program_cache()
+def _pos_targets_fn(mesh: Mesh, cap: int):
+    """Destination ranks from CALLER-COMPUTED global row positions (the
+    skew stitch, relational/skew.py): destination d owns global positions
+    [dof[d], dof[d] + dest[d]) of the even order-preserving layout.
+    Padding rows (and the caller's ``total`` sentinel) route to the trash
+    destination W.  Same index math as :func:`_range_targets_fn`, with
+    ``pos`` replacing the contiguous ``offs[my] + iota`` range."""
+
+    def per_shard(vc, bounds, pos):
+        w = bounds.shape[0]
+        my = jax.lax.axis_index(shuffle.ROW_AXIS)
+        mask = jnp.arange(cap, dtype=jnp.int32) < vc[my]
+        t = jnp.searchsorted(bounds, pos, side="left").astype(jnp.int32)
+        t = jnp.clip(t, 0, w - 1)
+        return jnp.where(mask, t, jnp.int32(w))
+
+    return jax.jit(shard_map(per_shard, mesh=mesh,
+                             in_specs=(REP, REP, ROW), out_specs=ROW))
+
+
+@program_cache()
+def _sort_flat_by_pos_fn(mesh: Mesh, cap: int, n_arrs: int):
+    """Per-shard stable reorder of exchanged payload arrays by their
+    received global positions: the exchange delivers (source rank, source
+    position) order, but the stitch's positions interleave sources — one
+    local sort puts every destination shard into global-position order.
+    Padding slots (zeros from the exchange's receive buffers) sort last
+    via the int64-max sentinel.  Pure-local; no collective."""
+    big = jnp.int64(np.iinfo(np.int64).max)
+
+    def per_shard(vc, pos, *arrs):
+        my = jax.lax.axis_index(shuffle.ROW_AXIS)
+        live = jnp.arange(cap, dtype=jnp.int32) < vc[my]
+        key = jnp.where(live, pos, big)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        _, perm = jax.lax.sort((key, idx), num_keys=1, is_stable=True)
+        return tuple(a[perm] for a in arrs)
+
+    specs = (REP,) + (ROW,) * (1 + n_arrs)
+    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+                             out_specs=(ROW,) * n_arrs))
+
+
+def place_by_global_pos(table: Table, pos, total: int) -> Table:
+    """Redistribute ``table``'s rows onto the even order-preserving layout
+    (:func:`even_partition_counts`) by their caller-computed GLOBAL row
+    positions ``pos`` (device int64, the table's row layout; padding rows
+    must carry the ``total`` sentinel).  Positions must be a permutation
+    of [0, total).  The receiving shard locally sorts its rows by position
+    (the exchange's (src, pos) receive order interleaves sources), so the
+    result reads back in exactly position order — the merge half of the
+    skew-split stitch's bit/order-equality contract
+    (relational/skew.stitch_join_output, docs/skew.md)."""
+    env = table.env
+    w = env.world_size
+    total = int(total)
+    if total == 0 or not table.column_count:
+        return table
+    from ..utils import timing
+    dest = even_partition_counts(total, w)
+    bounds = np.cumsum(dest).astype(np.int64) - 1
+    vc32 = np.asarray(table.valid_counts, np.int32)
+    cap = max(table.capacity, 1)
+    with timing.region("place.targets"):
+        tgt = _pos_targets_fn(env.mesh, cap)(vc32, bounds, pos)
+        counts = shuffle.count_targets(env.mesh, tgt)
+    with timing.region("place.exchange"):
+        flat, recipe = _flatten_for_exchange(table)
+        new_flat, new_valid = shuffle.exchange(env.mesh, tgt, counts,
+                                               flat + (pos,))
+    if not np.array_equal(np.asarray(new_valid, np.int64), dest):
+        raise InvalidError(
+            f"place_by_global_pos: received counts {list(new_valid)} do "
+            f"not match the even layout {list(dest)} — positions are not "
+            "a permutation of the claimed total")
+    with timing.region("place.sort"):
+        out_cap = new_flat[0].shape[0] // w
+        fn = _sort_flat_by_pos_fn(env.mesh, out_cap, len(new_flat) - 1)
+        sorted_flat = fn(np.asarray(new_valid, np.int32), new_flat[-1],
+                         *new_flat[:-1])
+    return _rebuild(recipe, sorted_flat, new_valid, env)
+
+
 def repartition(table: Table, rows_per_partition=None) -> Table:
     """Redistribute preserving global row order; default = even split."""
     from ..obs import plan as _plan
@@ -531,7 +615,30 @@ def _trace_range_targets(mesh):
                               S((w,), np.int64), S((w * cap,), np.int64))
 
 
+def _trace_pos_targets(mesh):
+    w = int(mesh.devices.size)
+    cap = 1024
+    S = jax.ShapeDtypeStruct
+    fn = _unwrap(_pos_targets_fn(mesh, cap))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w,), np.int64),
+                              S((w * cap,), np.int64))
+
+
+def _trace_sort_flat_by_pos(mesh):
+    w = int(mesh.devices.size)
+    cap = 1024
+    S = jax.ShapeDtypeStruct
+    fn = _unwrap(_sort_flat_by_pos_fn(mesh, cap, 2))
+    return jax.make_jaxpr(fn)(S((w,), np.int32), S((w * cap,), np.int64),
+                              S((w * cap, 3), np.uint32),
+                              S((w * cap,), np.float64))
+
+
 from ..analysis.registry import declare_builder, unwrap as _unwrap  # noqa: E402
 
 declare_builder(f"{__name__}._range_targets_fn", _trace_range_targets,
                 tags=("repart", "shuffle"))
+declare_builder(f"{__name__}._pos_targets_fn", _trace_pos_targets,
+                tags=("repart", "skew"))
+declare_builder(f"{__name__}._sort_flat_by_pos_fn", _trace_sort_flat_by_pos,
+                tags=("repart", "skew"))

@@ -45,6 +45,15 @@ class Code(enum.IntEnum):
     #: instead of one rank draining while its peers enter the next
     #: collective alone.  Not an error class — never raised.
     PreemptDrain = 48
+    #: skew-plan adoption vote (exec/recovery.skew_plan_consensus +
+    #: relational/skew.py): every rank has computed the adaptive
+    #: skew-split plan (heavy-key set, rank groups, salted fan-out) from
+    #: the allgathered sample and votes this code with two 20-bit slices
+    #: of the plan hash riding the pmax wire, so the recovery ladder,
+    #: checkpoints and elastic resume all see ONE plan — a rank whose
+    #: hash diverges raises typed instead of entering the split
+    #: exchange's collectives alone.  Not an error class — never raised.
+    SkewPlan = 49
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42

@@ -1,5 +1,6 @@
-"""TPC-H subset: data generator + a 19-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q9 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21 Q22).
+"""TPC-H subset: data generator + a 20-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q7 Q9 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21
+Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -26,7 +27,12 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   ANALYZE plan recorded in the bench detail), and — round 13, alongside
   the out-of-core disk tier — Q9's product-type profit: six tables,
   five joins (one two-key), the suite's widest join working set and the
-  disk tier's natural TPC-H exerciser;
+  disk tier's natural TPC-H exerciser, and — round 14, alongside the
+  adaptive skew-split join route — Q7's volume shipping: lineitem ⋈
+  supplier/customer ⋈ nation×2 on a 25-value nation key, where EVERY
+  key is a heavy hitter and the naturally skew-shaped Q18 (lineitem
+  groupby-HAVING + 3-way join) gets its EXPLAIN ANALYZE plan recorded
+  in the bench detail beside Q13's;
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -230,6 +236,12 @@ def generate_pandas(scale: float = 0.01, seed: int = 0) -> dict:
     # engine has no device-side date-part extraction; the same documented
     # simplification as Q22's phone-prefix column)
     orders["o_orderyear"] = orders["o_orderdate"].dt.year.astype(np.int64)
+    # Q7 addition (round 14, the adaptive skew-split route's nation-key
+    # exerciser): extract(year FROM l_shipdate) rides a DERIVED int
+    # column — no new RNG draws, every earlier table/column stays
+    # byte-identical (the same regression-baseline rule and the same
+    # documented date-part simplification as Q9's o_orderyear)
+    lineitem["l_shipyear"] = lineitem["l_shipdate"].dt.year.astype(np.int64)
     return {"customer": customer, "orders": orders, "lineitem": lineitem,
             "supplier": supplier, "nation": nation, "region": region,
             "part": part, "partsupp": partsupp}
@@ -941,6 +953,89 @@ def q9_pandas(pdfs: dict, name_part: str = "misty") -> pd.DataFrame:
 
 
 # ---------------------------------------------------------------------------
+# Q7 — volume shipping (nation-key joins: every key is a heavy hitter)
+# ---------------------------------------------------------------------------
+
+def q7(dfs: dict, env=None, nation1: str = "FRANCE",
+       nation2: str = "GERMANY"):
+    """SELECT supp_nation, cust_nation, l_year, sum(volume) AS revenue
+    FROM (SELECT n1.n_name AS supp_nation, n2.n_name AS cust_nation,
+    extract(year FROM l_shipdate) AS l_year, l_extendedprice *
+    (1 - l_discount) AS volume FROM supplier, lineitem, orders, customer,
+    nation n1, nation n2 WHERE s_suppkey = l_suppkey AND o_orderkey =
+    l_orderkey AND c_custkey = o_custkey AND s_nationkey = n1.n_nationkey
+    AND c_nationkey = n2.n_nationkey AND ((n1.n_name = :n1 AND n2.n_name
+    = :n2) OR (n1.n_name = :n2 AND n2.n_name = :n1)) AND l_shipdate
+    BETWEEN date '1995-01-01' AND date '1996-12-31') shipping GROUP BY
+    supp_nation, cust_nation, l_year ORDER BY supp_nation, cust_nation,
+    l_year.
+
+    Round 14, the adaptive skew-split route's TPC-H exerciser
+    (docs/skew.md): the supplier→nation and customer→nation joins run on
+    a 25-value key — EVERY key is a heavy hitter under plain hash
+    partitioning, the distribution shape the split + duplicate-broadcast
+    route exists for.  The symmetric nation-pair disjunction collapses
+    to ``s_nationkey != c_nationkey`` once both ends are restricted to
+    the two nations; extract(year) rides the generator's derived
+    ``l_shipyear`` int column (documented simplification; the pandas
+    oracle uses real ``dt.year``)."""
+    n = dfs["nation"][["n_nationkey", "n_name"]]
+    n = n[_isin(n["n_name"], [nation1, nation2])]
+    s = dfs["supplier"][["s_suppkey", "s_nationkey"]].merge(
+        n, left_on="s_nationkey", right_on="n_nationkey", env=env)
+    s = s.rename({"n_name": "supp_nation"})[
+        ["s_suppkey", "s_nationkey", "supp_nation"]]
+    c = dfs["customer"][["c_custkey", "c_nationkey"]].merge(
+        n, left_on="c_nationkey", right_on="n_nationkey", env=env)
+    c = c.rename({"n_name": "cust_nation"})[
+        ["c_custkey", "c_nationkey", "cust_nation"]]
+    l = dfs["lineitem"]
+    l = l[(l["l_shipdate"] >= _ts("1995-01-01"))
+          & (l["l_shipdate"] <= _ts("1996-12-31"))]
+    l["volume"] = l["l_extendedprice"] * (1.0 - l["l_discount"])
+    l = l[["l_orderkey", "l_suppkey", "l_shipyear", "volume"]]
+    j = l.merge(s, left_on="l_suppkey", right_on="s_suppkey", env=env)
+    j = j.merge(dfs["orders"][["o_orderkey", "o_custkey"]],
+                left_on="l_orderkey", right_on="o_orderkey", env=env)
+    j = j.merge(c, left_on="o_custkey", right_on="c_custkey", env=env)
+    j = j[j["s_nationkey"] != j["c_nationkey"]]
+    g = (j.groupby(["supp_nation", "cust_nation", "l_shipyear"], env=env)
+         [["volume"]].sum().rename({"volume": "revenue"}))
+    out = g.sort_values(["supp_nation", "cust_nation", "l_shipyear"],
+                        env=env)
+    return out[["supp_nation", "cust_nation", "l_shipyear", "revenue"]]
+
+
+def q7_pandas(pdfs: dict, nation1: str = "FRANCE",
+              nation2: str = "GERMANY") -> pd.DataFrame:
+    n = pdfs["nation"][["n_nationkey", "n_name"]]
+    n = n[n.n_name.isin([nation1, nation2])]
+    s = pdfs["supplier"].merge(n, left_on="s_nationkey",
+                               right_on="n_nationkey")
+    s = s.rename(columns={"n_name": "supp_nation"})[
+        ["s_suppkey", "s_nationkey", "supp_nation"]]
+    c = pdfs["customer"].merge(n, left_on="c_nationkey",
+                               right_on="n_nationkey")
+    c = c.rename(columns={"n_name": "cust_nation"})[
+        ["c_custkey", "c_nationkey", "cust_nation"]]
+    l = pdfs["lineitem"]
+    l = l[(l.l_shipdate >= pd.Timestamp("1995-01-01"))
+          & (l.l_shipdate <= pd.Timestamp("1996-12-31"))].copy()
+    l["volume"] = l.l_extendedprice * (1.0 - l.l_discount)
+    l["l_shipyear"] = l.l_shipdate.dt.year.astype(np.int64)
+    j = l.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    j = j.merge(pdfs["orders"][["o_orderkey", "o_custkey"]],
+                left_on="l_orderkey", right_on="o_orderkey")
+    j = j.merge(c, left_on="o_custkey", right_on="c_custkey")
+    j = j[j.s_nationkey != j.c_nationkey]
+    g = (j.groupby(["supp_nation", "cust_nation", "l_shipyear"],
+                   as_index=False).agg(revenue=("volume", "sum")))
+    g = g.sort_values(["supp_nation", "cust_nation",
+                       "l_shipyear"]).reset_index(drop=True)
+    return g[["supp_nation", "cust_nation", "l_shipyear", "revenue"]]
+
+
+# ---------------------------------------------------------------------------
 # Q22 — global sales opportunity (ANTI join vs orders)
 # ---------------------------------------------------------------------------
 
@@ -1280,16 +1375,22 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q9": q9, "q10": q10, "q11": q11, "q12": q12, "q13": q13,
-               "q14": q14, "q15": q15, "q16": q16, "q17": q17, "q18": q18,
-               "q19": q19, "q20": q20, "q21": q21, "q22": q22}
+               "q7": q7, "q9": q9, "q10": q10, "q11": q11, "q12": q12,
+               "q13": q13, "q14": q14, "q15": q15, "q16": q16,
+               "q17": q17, "q18": q18, "q19": q19, "q20": q20,
+               "q21": q21, "q22": q22}
     times = {name: run_query(fn) for name, fn in queries.items()}
     # the profiler's acceptance workload (docs/observability.md): one
     # extra ANALYZE-profiled Q13 run whose plan tree — per-node
     # rows/bytes/seconds with the phase-table reconciliation block —
-    # rides the bench JSON detail
+    # rides the bench JSON detail; round 14 adds the naturally
+    # skew-shaped Q18 beside it, so the skew route's decision (or its
+    # absence) on a real query is auditable from the same JSON
+    # (docs/skew.md)
     from cylon_tpu import obs
     q13_plan = obs.explain_analyze(lambda: q13(dfs, env=env).to_pandas())
+    q18_plan = obs.explain_analyze(
+        lambda: q18(dfs, env=env, quantity=150).to_pandas())
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"
                   " wall time",
@@ -1319,8 +1420,11 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                        "resume_fast_forwarded_pieces",
                        "resume_resharded_pieces", "resume_world_mismatch")},
                    # EXPLAIN ANALYZE of Q13 (obs/plan): the plan tree
-                   # with per-node seconds + the reconcile block
+                   # with per-node seconds + the reconcile block — and
+                   # of the skew-shaped Q18, whose join nodes carry the
+                   # skew route decision when a plan armed (docs/skew.md)
                    "q13_plan": q13_plan.to_dict(),
+                   "q18_plan": q18_plan.to_dict(),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 

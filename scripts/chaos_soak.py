@@ -71,6 +71,18 @@ via typed ResumableAbort — exit 17, not a signal death — within the
 grace budget).  Every schedule must end bit-equal to the uninterrupted
 world=2 baseline.
 
+``--skew`` switches to the ADAPTIVE-SKEW-SPLIT acceptance flow
+(docs/skew.md): a monolithic skewed-key join+groupby (one hot key on
+~80% of probe rows) whose unsplit run (``CYLON_TPU_SKEW_SPLIT=0``) is
+the bit-equality oracle.  Pinned schedules: the armed happy path (a
+non-empty voted plan, bit-equal), an exchange capacity fault INSIDE the
+split (the ladder's retry must re-detect and re-vote the IDENTICAL plan
+hash — determinism of the detection inputs), a spill fault under an
+HBM budget cap (same contract), SIGKILL mid-workload then a fresh rerun
+(same plan hash, bit-equal), and the unarmed-at-skew-0 contract leg: at
+skew 0 the ARMED run must vote nothing, split nothing and move exactly
+the exchange rows the unsplit run moves — zero extra collectives.
+
 Usage::
 
     python scripts/chaos_soak.py --seed 7                 # 20 schedules
@@ -78,6 +90,7 @@ Usage::
     python scripts/chaos_soak.py --concurrent 3 --rows 2000
     python scripts/chaos_soak.py --elastic --rows 1500 --chunks 3
     python scripts/chaos_soak.py --oocore --rows 2000 --chunks 3
+    python scripts/chaos_soak.py --skew --rows 4000
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
 runs in CI as a slow-marked test (tests/test_checkpoint.py); the
@@ -176,6 +189,9 @@ def worker(args) -> int:
 
     if args.elastic:
         return _worker_elastic(args, env)
+
+    if args.skew:
+        return _worker_skew(args, env)
 
     if args.concurrent > 1:
         return _worker_concurrent(args, env, make_workload)
@@ -312,6 +328,167 @@ def run_stream(args) -> int:
     if own_workdir:
         shutil.rmtree(args.workdir, ignore_errors=True)
     print(json.dumps({"stream": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
+def _worker_skew(args, env) -> int:
+    """The adaptive-skew-split acceptance workload (docs/skew.md): a
+    monolithic skewed-key inner join + groupby-sum on the DataFrame
+    engine's default route.  ``--skew-frac`` shapes the probe key
+    column (0.0 = the unarmed contract leg); CYLON_TPU_SKEW_SPLIT in
+    the environment arms/disarms the route.  The JSON line reports the
+    result sha, the voted plan hash (None when the join ran unsplit)
+    and the always-on exchange row counter — the flow's zero-extra-
+    collectives evidence."""
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.exec import recovery
+    from cylon_tpu.obs import metrics
+    from cylon_tpu.relational import groupby_aggregate, join_tables
+    from cylon_tpu.relational import skew as skew_facade
+
+    rng = np.random.default_rng(20260805)
+    n = max(args.rows, 2048)
+    mv = max(int(n * 0.9), 8)
+    hot = np.int64(mv // 2)
+    lk = rng.integers(0, mv, n).astype(np.int64)
+    if args.skew_frac > 0.0:
+        lk = np.where(rng.random(n) < args.skew_frac, hot, lk)
+    rk = rng.integers(0, mv, n).astype(np.int64)
+    rk[rk == hot] = hot + 1
+    rk[0] = hot
+    lt = ct.Table.from_pydict(
+        {"k": lk, "a": rng.integers(0, mv, n).astype(np.int64)}, env)
+    rt = ct.Table.from_pydict(
+        {"k": rk, "b": rng.integers(0, mv, n).astype(np.int64)}, env)
+
+    # injected recoverable faults (capacity, spill, device_oom shapes)
+    # are handled by the operators' own ladders inside these calls; a
+    # `kill` kind SIGKILLs mid-flight and the parent reruns fresh
+    j = join_tables(lt, rt, "k", "k", how="inner")
+    out = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+    plan = skew_facade.last_plan()
+    df = out.to_pandas().sort_values("k").reset_index(drop=True)
+    print(json.dumps({
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
+        "events": len(recovery.recovery_events()),
+        "event_list": recovery.recovery_events(),
+        "plan_hash": (format(plan.plan_hash(), "016x")
+                      if plan is not None else None),
+        "skew_split_joins": int(metrics.counter("skew_split_joins").value),
+        "exchange_rows": int(metrics.counter("exchange_rows_total").value),
+    }), flush=True)
+    return 0
+
+
+def run_skew(args) -> int:
+    """The ``--skew`` acceptance flow (pinned, not drawn) — see the
+    module docstring.  Every schedule must end bit-equal to the UNSPLIT
+    baseline, and every recovered schedule must land on the IDENTICAL
+    voted plan hash."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_skew_")
+    failures: list = []
+
+    def spawn(tag, faults, armed=True, frac=0.8, extra=None):
+        workdir = os.path.join(args.workdir, tag)
+        env_extra = {"CYLON_TPU_SKEW_SPLIT": "1" if armed else "0"}
+        env_extra.update(extra or {})
+        return _spawn(args, workdir, faults, resume=False,
+                      extra_env=env_extra, skew=True, skew_frac=frac)
+
+    # unsplit baseline: the bit-equality oracle
+    p, base = spawn("base", "", armed=False)
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: skew baseline failed", file=sys.stderr)
+        return 1
+    print(f"# skew unsplit baseline sha={base['sha'][:16]}", flush=True)
+
+    # armed happy path: a non-empty voted plan, bit-equal
+    p, info = spawn("split", "")
+    plan0 = (info or {}).get("plan_hash")
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"armed split diverged (rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not plan0 or not info.get("skew_split_joins"):
+        failures.append(f"armed split never voted a plan: {info}")
+    else:
+        print(f"# skew split -> ok (plan={plan0})", flush=True)
+
+    # exchange capacity fault INSIDE the split (the build-side hash
+    # shuffle's receive guard): the ladder retries the join, which must
+    # re-detect and re-vote the IDENTICAL plan before going bit-equal
+    p, info = spawn("capacity", "shuffle.recv_guard::1=capacity",
+                    extra={"CYLON_TPU_EXCHANGE_GUARD_CPU": "1"})
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"capacity-fault leg diverged (rc={p.returncode}): "
+                        f"{info}\n{(p.stdout + p.stderr)[-2000:]}")
+    elif info.get("plan_hash") != plan0:
+        failures.append(f"capacity-fault recovery re-voted a DIFFERENT "
+                        f"plan: {info.get('plan_hash')} != {plan0}")
+    elif not info.get("events") or info["events"] > MAX_RECOVERY_EVENTS:
+        failures.append(f"capacity-fault leg events out of range: {info}")
+    else:
+        print("# skew capacity fault -> ok (same plan, bit-equal)",
+              flush=True)
+
+    # spill fault under an HBM budget cap: same contract
+    p, info = spawn("spill", "spill.evict::1=predicted",
+                    extra={"CYLON_TPU_HBM_BUDGET": "4096"})
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"spill-fault leg diverged (rc={p.returncode}): "
+                        f"{info}\n{(p.stdout + p.stderr)[-2000:]}")
+    elif info.get("plan_hash") != plan0:
+        failures.append(f"spill-fault recovery re-voted a DIFFERENT "
+                        f"plan: {info.get('plan_hash')} != {plan0}")
+    else:
+        print("# skew spill fault -> ok (same plan, bit-equal)", flush=True)
+
+    # SIGKILL mid-workload (at the groupby site, after the voted split
+    # exchange ran), then a fresh rerun: same plan, bit-equal
+    p, _ = spawn("kill", "groupby.device_oom::1=kill")
+    if p.returncode != -9:
+        failures.append(f"kill leg did not crash (rc={p.returncode})")
+    else:
+        p2, info2 = spawn("kill_rerun", "")
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"rerun after kill diverged "
+                            f"(rc={p2.returncode}): {info2}\n"
+                            f"{(p2.stdout + p2.stderr)[-2000:]}")
+        elif info2.get("plan_hash") != plan0:
+            failures.append(f"rerun after kill voted a DIFFERENT plan: "
+                            f"{info2.get('plan_hash')} != {plan0}")
+        else:
+            print("# skew kill + rerun -> ok (same plan, bit-equal)",
+                  flush=True)
+
+    # unarmed-at-skew-0 contract: the ARMED run at skew 0 votes nothing,
+    # splits nothing, and moves exactly the unsplit run's exchange rows
+    p, flat0 = spawn("flat_unsplit", "", armed=False, frac=0.0)
+    p2, flat1 = spawn("flat_armed", "", armed=True, frac=0.0)
+    if p.returncode != 0 or p2.returncode != 0 or not flat0 or not flat1:
+        failures.append(f"flat legs failed (rc={p.returncode}/"
+                        f"{p2.returncode}): {flat0} {flat1}")
+    elif flat1.get("sha") != flat0.get("sha"):
+        failures.append(f"armed-at-skew-0 diverged: {flat1}")
+    elif flat1.get("plan_hash") is not None \
+            or flat1.get("skew_split_joins"):
+        failures.append(f"armed-at-skew-0 voted a plan: {flat1}")
+    elif flat1.get("exchange_rows") != flat0.get("exchange_rows"):
+        failures.append(
+            f"armed-at-skew-0 moved extra exchange rows: "
+            f"{flat1.get('exchange_rows')} != {flat0.get('exchange_rows')}")
+    else:
+        print("# skew unarmed-at-0 -> ok (no vote, no extra exchange "
+              "rows)", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"skew": True, "failures": len(failures),
                       "detail": failures[:10]}))
     return 1 if failures else 0
 
@@ -743,7 +920,8 @@ def _pinned_schedules() -> list[dict]:
 def _spawn(args, workdir: str, faults: str, resume: bool,
            extra_env: dict | None = None, concurrent: int = 1,
            only: int | None = None, stream: bool = False,
-           elastic: bool = False, world: int | None = None) -> tuple:
+           elastic: bool = False, world: int | None = None,
+           skew: bool = False, skew_frac: float = 0.8) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
@@ -774,6 +952,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
         cmd.append("--stream")
     if elastic:
         cmd.append("--elastic")
+    if skew:
+        cmd += ["--skew", f"--skew-frac={skew_frac}"]
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -927,6 +1107,14 @@ def main() -> int:
                          "mid-run, resume at world=1 and at world=2-"
                          "after-reshard; every schedule must end "
                          "bit-equal to the uninterrupted baseline)")
+    ap.add_argument("--skew", action="store_true",
+                    help="run the adaptive-skew-split acceptance flow "
+                         "(faults inside a skew-split join must recover "
+                         "onto the SAME voted plan, bit-equal to the "
+                         "unsplit baseline; the armed-at-skew-0 leg "
+                         "must add zero collectives)")
+    ap.add_argument("--skew-frac", type=float, default=0.8,
+                    help="(worker) fraction of probe rows on the hot key")
     ap.add_argument("--world", type=int, default=4,
                     help="(worker) mesh world size for this process")
     args = ap.parse_args()
@@ -937,6 +1125,9 @@ def main() -> int:
 
     if args.oocore:
         return run_oocore(args)
+
+    if args.skew:
+        return run_skew(args)
 
     if args.stream:
         return run_stream(args)

@@ -60,6 +60,30 @@ def _flatten(d: dict, path: str = "") -> list[tuple[str, dict]]:
     return out
 
 
+def _why_skew(path: str, hh: dict | None, plan: dict | None) -> str:
+    """The "why this plan" line for a hash↔skew_split route flip
+    (docs/skew.md): the heavy-hitter profile's ``est_rows_per_rank``
+    names the concentration the CURRENT partitioner would produce —
+    the number a split plan's balanced layout is judged against — and
+    the voted plan's key count + fan-out says what the split bought."""
+    bits = [f"? why: {path}"]
+    if hh and hh.get("est_rows_per_rank"):
+        per = hh["est_rows_per_rank"]
+        tot = sum(per) or 1
+        hot_r = max(range(len(per)), key=per.__getitem__)
+        even = tot / max(len(per), 1)
+        bits.append(f"hash plan would land ≈{per[hot_r]:,} rows "
+                    f"({per[hot_r] / tot:.1%}) on rank {hot_r} "
+                    f"(even share ≈{even:,.0f})")
+    if hh and hh.get("est_max_rank_share") is not None:
+        bits.append(f"est_max_rank_share={hh['est_max_rank_share']:.3f}")
+    if plan:
+        bits.append(f"split plan: {plan.get('keys')} key(s), "
+                    f"fanout={plan.get('fanout')}, "
+                    f"hash={plan.get('plan_hash')}")
+    return "\n    ".join(bits)
+
+
 def diff_plans(a: dict, b: dict) -> str:
     """Human-readable diff of two plan payloads (see module docstring)."""
     fa = [p for r in a.get("roots", ()) for p in _flatten(r)]
@@ -83,6 +107,14 @@ def diff_plans(a: dict, b: dict) -> str:
             if attrs_a.get(k) != attrs_b.get(k):
                 lines.append(f"! {pa} attr {k}: "
                              f"{attrs_a.get(k)!r} -> {attrs_b.get(k)!r}")
+        route_a, route_b = attrs_a.get("route"), attrs_b.get("route")
+        if route_a != route_b and "skew_split" in (route_a, route_b):
+            # hash ↔ skew_split flip: explain WHY from the profile of
+            # whichever run carries one (analyze-mode key profiles) and
+            # from the split side's voted plan summary
+            hh = da.get("heavy_hitters") or db.get("heavy_hitters")
+            split_attrs = attrs_a if route_a == "skew_split" else attrs_b
+            lines.append(_why_skew(pa, hh, split_attrs.get("skew_plan")))
         deltas = []
         for k, fmt in (("self_s", "{:+.4f}s"), ("rows_out", "{:+d}"),
                        ("bytes_exchanged", "{:+d}B")):

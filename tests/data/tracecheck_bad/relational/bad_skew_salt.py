@@ -1,0 +1,45 @@
+"""TS115 fixture: skew-plan decisions outside the relational/skew.py
+plan facade — split-set construction, salt assignment and the
+``Code.SkewPlan`` vote must run through detect/finalize_or_none/adopt/
+split_exchange so every rank enters ONE voted exchange plan."""
+
+import numpy as np
+
+
+def my_split(mesh, datas, valids, vc, plan, shf, SkewPlan,
+             skew_plan_consensus):
+    # flagged: the split-targets primitive called directly — skips the
+    # facade's finalize guard and the pre-exchange vote
+    tgt = shf.skew_split_targets(mesh, datas, valids, vc, 1, (True,),
+                                 (False,), (), plan.src_off, plan.fanout,
+                                 plan.start)
+    # flagged: ad-hoc plan construction outside the facade
+    p = SkewPlan(8, ("k",), [], [], np.zeros(1, np.uint32),
+                 np.zeros(1), np.zeros(1, np.int32), np.ones(1, np.int32))
+    # flagged: a direct vote out of sequence
+    skew_plan_consensus(mesh, 42)
+    return tgt, p
+
+
+def my_rebalance(plan):
+    # flagged: post-vote salt mutation — desyncs the voted plan hash
+    plan.fanout = plan.fanout * 2
+    # flagged: split-set anchor mutation, same hazard
+    plan.start = (plan.start + 1) % 8
+    return plan
+
+
+def fine_route(probe, build, env, skewmod):
+    # NOT flagged: the sanctioned facade sequence
+    plan = skewmod.detect(probe, ["k"], env)
+    if plan is not None:
+        plan = skewmod.finalize_or_none(plan, probe, ["k"], build, ["k"])
+    if plan is not None:
+        skewmod.adopt(plan, env)
+        return skewmod.split_exchange(probe, ["k"], build, ["k"], plan)
+    return None
+
+
+def fine_reader(plan):
+    # NOT flagged: reading plan fields is how the stitch works
+    return int(plan.fanout.sum()) + int(plan.start[0])

@@ -340,6 +340,52 @@ for r in range(1, nproc):
 print(f"STREAM_OK pid={pid} agreed={agreed} closed={len(closed_sig)}",
       flush=True)
 
+# Adaptive skew-split plan coherence (relational/skew.py, docs/skew.md):
+# a Zipf-ish hot key on ~70% of probe rows arms the split route; the
+# Code.SkewPlan vote rides the REAL cross-process pmax wire here, and
+# every rank must adopt the IDENTICAL plan hash (allgathered crc).  The
+# split join's stitched output and its fused groupby must both be
+# bit- and order-equal to the unsplit hash plan's (the route's
+# equivalence contract, exercised across processes).
+from cylon_tpu import config as _cfg
+from cylon_tpu.relational import skew as _skew
+
+env.barrier()
+skrng = np.random.default_rng(31)   # same seed per process: SPMD ingest
+ns = 6000
+hot = np.int64(77)
+sk = skrng.integers(0, 600, ns).astype(np.int64)
+sk = np.where(skrng.random(ns) < 0.7, hot, sk)
+sl = ct.Table.from_pydict(
+    {"k": sk, "a": skrng.integers(0, 100, ns).astype(np.int64)}, env)
+bk = skrng.integers(0, 600, ns).astype(np.int64)
+bk[bk == hot] = hot + 1   # hot key exactly once on the build side
+bk[0] = hot
+sr = ct.Table.from_pydict(
+    {"k": bk, "b": skrng.integers(0, 100, ns).astype(np.int64)}, env)
+js = join_tables(sl, sr, "k", "k", how="inner")
+gs = groupby_aggregate(js, "k", [("a", "sum"), ("b", "sum")])
+plan = _skew.last_plan()
+assert plan is not None, "skew-split plan did not arm"
+plan_sig = np.int64(zlib.crc32(format(plan.plan_hash(), "016x").encode()))
+plan_sigs = np.atleast_1d(multihost_utils.process_allgather(plan_sig))
+assert len({int(s) for s in plan_sigs}) == 1, (plan.summary(), plan_sigs)
+gdf = gs.to_pandas()
+jdf = js.to_pandas()    # materializes through the stitch
+_cfg.SKEW_SPLIT = False
+try:
+    ju = join_tables(sl, sr, "k", "k", how="inner")
+    judf = ju.to_pandas()
+    gudf = groupby_aggregate(ju, "k", [("a", "sum"), ("b", "sum")]) \
+        .to_pandas()
+finally:
+    _cfg.SKEW_SPLIT = True
+pd.testing.assert_frame_equal(jdf, judf)
+pd.testing.assert_frame_equal(gdf, gudf)
+print(f"SKEWPLAN_OK pid={pid} keys={len(plan)} "
+      f"fanout={[int(f) for f in plan.fanout]} "
+      f"hash={format(plan.plan_hash(), '016x')}", flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

@@ -173,3 +173,8 @@ def test_multi_process_join_groupby_sort(nproc):
         # sequence (the driver cross-checks the sequence hash via
         # allgather and prints it per rank)
         assert f"SPILL_OK pid={i} evictions=" in out, out[-2000:]
+        # rank-coherent skew plan: the Code.SkewPlan vote rode the real
+        # cross-process wire and every rank adopted the IDENTICAL plan
+        # hash (the driver allgathers the hash crc and bit-checks the
+        # stitched + fused outputs against the unsplit plan)
+        assert f"SKEWPLAN_OK pid={i} keys=" in out, out[-2000:]

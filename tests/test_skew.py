@@ -211,6 +211,276 @@ def test_sort_balance_under_skew(env8, rng):
     assert int(out.valid_counts.max()) <= max(2 * even, top_run + even)
 
 
+class TestAdaptiveSkewSplit:
+    """ISSUE 14: the adaptive skew-split route (relational/skew.py) —
+    heavy-hitter split + duplicate-broadcast behind a voted plan, with
+    output BIT- and ORDER-equal to the unsplit hash plan for every join
+    type, and the fused join→groupby pushdown combining the heavy keys'
+    per-member partials (docs/skew.md)."""
+
+    def _skewed_pair(self, env, rng, n=24_000, frac=0.6, build_hot=1):
+        # build side big enough that the broadcast-join route (the right
+        # plan for a SMALL build side) does not preempt the skew split
+        mv = 2000
+        hot = np.int64(700)
+        lk = rng.integers(0, mv, n).astype(np.int64)
+        lk = np.where(rng.random(n) < frac, hot, lk)
+        nb = n // 2
+        rk = rng.integers(0, mv, nb).astype(np.int64)
+        rk[rk == hot] = hot + 1
+        rk[:build_hot] = hot
+        lt = ct.Table.from_pydict(
+            {"k": lk, "a": rng.integers(0, 1000, n).astype(np.int64)}, env)
+        rt = ct.Table.from_pydict(
+            {"k": rk, "b": rng.integers(0, 1000, nb).astype(np.int64)},
+            env)
+        return lt, rt
+
+    def _split_vs_unsplit(self, env, fn, monkeypatch):
+        out_split = fn().to_pandas()
+        monkeypatch.setattr(config, "SKEW_SPLIT", False)
+        out_plain = fn().to_pandas()
+        monkeypatch.setattr(config, "SKEW_SPLIT", True)
+        # bit- AND order-equal: no sorting before the compare
+        pd.testing.assert_frame_equal(out_split, out_plain)
+        return out_split
+
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_all_hows_bit_and_order_equal(self, env8, rng, monkeypatch,
+                                          how):
+        from cylon_tpu.relational import skew as skew_facade
+        lt, rt = self._skewed_pair(env8, rng, build_hot=3)
+        skew_facade.record_plan(None)
+        if how == "right":
+            # the probe side of a right join is the RIGHT table — put
+            # the skewed column there
+            fn = lambda: join_tables(rt, lt, "k", "k", how="right")
+        else:
+            fn = lambda: join_tables(lt, rt, "k", "k", how=how)
+        out = self._split_vs_unsplit(env8, fn, monkeypatch)
+        plan = skew_facade.last_plan()
+        assert plan is not None and len(plan) >= 1, \
+            f"{how}: the split route never armed"
+        assert int(plan.fanout.max()) >= 2
+        assert len(out) > 0
+
+    def test_probe_side_balanced_and_plan_typed(self, env8, rng):
+        from cylon_tpu.relational import join as rjoin
+        from cylon_tpu.relational.skew import SkewPlan
+        n = 24_000
+        lt, rt = self._skewed_pair(env8, rng, n=n, frac=0.9)
+        lsh, _rsh, split = rjoin._shuffle_for_join(
+            lt, rt, ["k"], ["k"], "inner", env8)
+        assert isinstance(split, SkewPlan)
+        # heavy key spread: no shard holds more than ~2x the even share
+        assert int(lsh.valid_counts.max()) <= 2 * (n // 8) + 1024
+
+    def test_fused_groupby_combines_heavy_partials(self, env8, rng,
+                                                   monkeypatch):
+        """join→groupby-sum on the join keys rides the fused pushdown
+        (no join materialization) and the heavy keys' per-member partial
+        rows combine onto the home rank — result AND layout equal to the
+        unsplit fused plan's."""
+        from cylon_tpu import obs
+        lt, rt = self._skewed_pair(env8, rng)
+
+        def q():
+            j = join_tables(lt, rt, "k", "k", how="inner")
+            return groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+
+        routes = {}
+
+        def walk(node):
+            routes[node["op"]] = node.get("attrs", {})
+            for c in node.get("children", ()):
+                walk(c)
+        qp = obs.explain(q)
+        for r in qp.static_dict()["roots"]:
+            walk(r)
+        assert routes["groupby"].get("route") == "fused_pushdown"
+        assert routes["groupby"].get("skew_partials_combined", 0) >= 1
+        join_attrs = routes["join"]
+        assert join_attrs.get("route") == "skew_split"
+        assert join_attrs["skew_plan"]["plan_hash"]
+        self._split_vs_unsplit(env8, q, monkeypatch)
+
+    def test_non_additive_aggs_skip_pushdown_and_stitch(self, env8, rng,
+                                                        monkeypatch):
+        """min/max cannot combine across the split members inside the
+        fused kernel — the groupby takes the materialize path, but the
+        PRE-stitch table feeds it (stitch elided: aggregation cannot
+        observe row order), and the answer still matches the unsplit
+        plan's."""
+        from cylon_tpu.utils import timing
+        lt, rt = self._skewed_pair(env8, rng)
+        monkeypatch.setattr(config, "BENCH_TIMINGS", True)
+        timing.reset()
+
+        def q():
+            j = join_tables(lt, rt, "k", "k", how="inner")
+            return groupby_aggregate(j, "k", [("a", "min"), ("a", "max"),
+                                              ("b", "sum")])
+
+        got = q().to_pandas().sort_values("k").reset_index(drop=True)
+        snap = timing.snapshot()
+        assert "skew.stitch_elided" in snap, sorted(snap)
+        monkeypatch.setattr(config, "SKEW_SPLIT", False)
+        exp = q().to_pandas().sort_values("k").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp)
+
+    def test_plan_vote_is_deterministic(self, env8, rng):
+        """The recovery ladder's retry re-detects and re-votes: the
+        canonical plan hash must be identical across runs over the same
+        inputs (the chaos --skew same-plan contract)."""
+        from cylon_tpu.relational import skew as skew_facade
+        lt, rt = self._skewed_pair(env8, rng)
+        join_tables(lt, rt, "k", "k", how="inner").to_pandas()
+        h1 = skew_facade.last_plan().plan_hash()
+        join_tables(lt, rt, "k", "k", how="inner").to_pandas()
+        h2 = skew_facade.last_plan().plan_hash()
+        assert h1 == h2
+
+    def test_null_heavy_key_splits(self, env8, rng, monkeypatch):
+        """A heavy NULL key participates in the split exactly like a
+        value (the sampled tuple carries validity bits)."""
+        n = 24_000
+        lk = rng.integers(0, 2000, n).astype(np.float64)
+        lk[rng.random(n) < 0.6] = np.nan
+        rk = rng.integers(0, 2000, n // 2).astype(np.float64)
+        rk[:2] = np.nan
+        ldf = pd.DataFrame({"k": lk, "a": rng.random(n)})
+        rdf = pd.DataFrame({"k": rk, "b": rng.random(n // 2)})
+        lt = ct.Table.from_pandas(ldf, env8)
+        rt = ct.Table.from_pandas(rdf, env8)
+        from cylon_tpu.relational import skew as skew_facade
+        skew_facade.record_plan(None)
+        self._split_vs_unsplit(
+            env8, lambda: join_tables(lt, rt, "k", "k", how="inner"),
+            monkeypatch)
+        assert skew_facade.last_plan() is not None
+
+    def test_multicol_and_string_keys_split(self, env8, rng, monkeypatch):
+        n = 24_000
+        hot = rng.random(n) < 0.7
+        ldf = pd.DataFrame({
+            "k1": np.where(hot, 3, rng.integers(100, 900, n)
+                           ).astype(np.int64),
+            "k2": np.where(hot, "x", "y"),
+            "a": rng.integers(0, 100, n).astype(np.int64)})
+        rk = rng.integers(0, 900, n // 2)
+        rdf = pd.DataFrame({"k1": rk.astype(np.int64),
+                            "k2": np.where(rk % 2 == 0, "x", "y"),
+                            "b": rng.integers(0, 100, n // 2)
+                            .astype(np.int64)})
+        rdf.loc[0, ["k1", "k2"]] = [3, "x"]
+        lt = ct.Table.from_pandas(ldf, env8)
+        rt = ct.Table.from_pandas(rdf, env8)
+        from cylon_tpu.relational import skew as skew_facade
+        skew_facade.record_plan(None)
+        self._split_vs_unsplit(
+            env8,
+            lambda: join_tables(lt, rt, ["k1", "k2"], ["k1", "k2"],
+                                how="inner"), monkeypatch)
+        assert skew_facade.last_plan() is not None
+
+    def test_wide_heavy_tuple_vs_narrow_build(self, env8, rng,
+                                              monkeypatch):
+        """A heavy probe key ABOVE int32 against a build side whose
+        bounds fit int32: the build-side tuple comparisons must stay on
+        the (hi, lo) operand pair — narrowing would truncate the wide
+        tuple onto an unrelated narrow build key (phantom build rows in
+        the plan, mis-routed duplicate-broadcast).  Regression for
+        SkewPlan.operand_statics' per-tuple narrow guard."""
+        from cylon_tpu.relational import skew as skew_facade
+        n = 24_000
+        wide = np.int64((1 << 32) + 5)
+        lk = rng.integers(0, 1000, n).astype(np.int64)
+        lk = np.where(rng.random(n) < 0.6, wide, lk)
+        lt = ct.Table.from_pydict(
+            {"k": lk, "a": rng.integers(0, 100, n).astype(np.int64)},
+            env8)
+        rt = ct.Table.from_pydict(
+            {"k": rng.integers(0, 1000, n).astype(np.int64),
+             "b": rng.integers(0, 100, n).astype(np.int64)}, env8)
+        skew_facade.record_plan(None)
+        self._split_vs_unsplit(
+            env8, lambda: join_tables(lt, rt, "k", "k", how="left"),
+            monkeypatch)
+        plan = skew_facade.last_plan()
+        assert plan is not None, "wide heavy key never armed the split"
+        # the wide key truly has zero build rows — an aliased plan
+        # would report the narrow victim key's count here
+        assert int(plan.n_build[0]) == 0, plan.summary()
+
+    def test_replication_guard_rejects_heavy_build(self, env8, rng,
+                                                   monkeypatch):
+        """A key heavy on BOTH sides must NOT split: duplicate-
+        broadcasting a huge build group recreates the blow-up.  The
+        finalize guard drops it and the join runs the plain hash plan,
+        still correct."""
+        from cylon_tpu.obs import metrics
+        monkeypatch.setattr(config, "SKEW_GUARD_ROWS", 128)
+        monkeypatch.setattr(config, "SKEW_GUARD_RATIO", 2.0)
+        n = 8000
+        lt, rt = self._skewed_pair(env8, rng, n=n, frac=0.7)
+        # make the BUILD side heavy on the same key too
+        rk = np.asarray(rt.to_pandas()["k"], np.int64)
+        rk[: len(rk) // 2] = 700
+        rt2 = ct.Table.from_pydict(
+            {"k": rk,
+             "b": rng.integers(0, 1000, len(rk)).astype(np.int64)}, env8)
+        before = metrics.counter("skew_split_joins").value
+        out = join_tables(lt, rt2, "k", "k", how="inner").to_pandas()
+        assert metrics.counter("skew_split_joins").value == before
+        ldf, rdf = lt.to_pandas(), rt2.to_pandas()
+        assert len(out) == len(ldf.merge(rdf, on="k"))
+
+    def test_unarmed_at_zero_skew_votes_nothing(self, env8, rng):
+        """The zero-extra-collectives contract leg: a uniform key column
+        with the route ARMED must not vote, split or touch the consensus
+        wire (detection is one pure-local sample + one host pull)."""
+        from cylon_tpu.exec import recovery
+        from cylon_tpu.obs import metrics
+        n = 24_000
+        lt = ct.Table.from_pydict(
+            {"k": rng.integers(0, n, n).astype(np.int64),
+             "a": rng.integers(0, 100, n).astype(np.int64)}, env8)
+        rt = ct.Table.from_pydict(
+            {"k": rng.integers(0, n, n).astype(np.int64),
+             "b": rng.integers(0, 100, n).astype(np.int64)}, env8)
+        before = metrics.counter("skew_split_joins").value
+        votes = []
+        orig = recovery.skew_plan_consensus
+        recovery.skew_plan_consensus = \
+            lambda mesh, h: votes.append(h) or orig(mesh, h)
+        try:
+            join_tables(lt, rt, "k", "k", how="inner").to_pandas()
+        finally:
+            recovery.skew_plan_consensus = orig
+        assert metrics.counter("skew_split_joins").value == before
+        assert votes == []
+
+    def test_escape_hatch_disables_route(self, env8, rng, monkeypatch):
+        from cylon_tpu.obs import metrics
+        monkeypatch.setattr(config, "SKEW_SPLIT", False)
+        lt, rt = self._skewed_pair(env8, rng, n=8000)
+        before = metrics.counter("skew_split_joins").value
+        join_tables(lt, rt, "k", "k", how="inner").to_pandas()
+        assert metrics.counter("skew_split_joins").value == before
+
+    def test_stitched_layout_is_balanced(self, env8, rng):
+        """The stitch lands on the even order-preserving layout: the
+        materialized split join's shards are balanced even though the
+        unsplit plan would have concentrated the hot key's output."""
+        from cylon_tpu.relational.repart import even_partition_counts
+        lt, rt = self._skewed_pair(env8, rng, frac=0.9)
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        j.to_pandas()   # force the stitch
+        total = int(j.valid_counts.sum())
+        assert np.array_equal(np.asarray(j.valid_counts, np.int64),
+                              even_partition_counts(total, 8))
+
+
 class TestReceiveBudgetGuard:
     """Round-5: the exchange's count sidecar predicts the receive-side
     allocation; past the budget an OOM-shaped error fires BEFORE any

@@ -227,6 +227,61 @@ def test_q13_matches_pandas(env):
     pd.testing.assert_frame_equal(got, exp, check_dtype=False)
 
 
+def test_q7_matches_pandas(env):
+    """Q7 (round 14, the adaptive skew-split route's TPC-H exerciser):
+    lineitem ⋈ supplier/customer ⋈ nation×2 on a 25-value nation key —
+    every key a heavy hitter — bit-checked against the pandas oracle at
+    env1/env4 with the skew route armed (its default)."""
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.004, seed=7)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q7(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q7_pandas(pdfs)
+    assert len(got) == len(exp) > 0
+    pd.testing.assert_frame_equal(got, exp[got.columns], check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_q7_generator_year_column_is_derived():
+    """l_shipyear consumes no RNG draws: every pre-round-14 column
+    stays byte-identical (the regression-baseline rule)."""
+    pdfs = tpch.generate_pandas(scale=0.002, seed=7)
+    li = pdfs["lineitem"]
+    assert (li.l_shipyear.to_numpy()
+            == li.l_shipdate.dt.year.to_numpy()).all()
+
+
+def test_q18_explain_analyze_records_plan(env):
+    """Round 14: the naturally skew-shaped Q18's ANALYZE tree (recorded
+    as q18_plan in the tpch bench detail) carries its join route
+    decisions — with the skew route armed, every distributed join node
+    names a route and any skew_split node carries the voted plan
+    summary."""
+    import cylon_tpu as ct
+    from cylon_tpu import obs
+    pdfs = tpch.generate_pandas(scale=0.004, seed=18)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    qp = obs.explain_analyze(
+        lambda: tpch.q18(dfs, env=env, quantity=150).to_pandas())
+    d = qp.to_dict()
+    assert d["roots"], "no plan nodes recorded"
+    joins = []
+
+    def walk(n):
+        if n["op"] == "join":
+            joins.append(n)
+        for c in n.get("children", ()):
+            walk(c)
+    for r in d["roots"]:
+        walk(r)
+    assert joins, "Q18 recorded no join nodes"
+    for n in joins:
+        attrs = n.get("attrs", {})
+        if attrs.get("route") == "skew_split":
+            plan = attrs.get("skew_plan")
+            assert plan and plan.get("plan_hash") and plan.get("fanout")
+
+
 def test_q13_explain_analyze_records_plan(env):
     """The profiler's acceptance workload: EXPLAIN ANALYZE of Q13 at
     SF0.01 produces a plan tree whose per-node seconds reconcile with

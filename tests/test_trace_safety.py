@@ -429,12 +429,45 @@ def test_ts114_scoping_and_negatives():
         "cylon_tpu/exec/pipeline.py", io_clean))
 
 
+def test_ts115_skew_plan_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_skew_salt.py"))
+        if f.rule == "TS115"]
+    # split targets, SkewPlan ctor, direct vote, fanout + start salt
+    # mutations — the facade sequence and plain field reads stay clean
+    assert len(found) == 5, found
+    assert all("relational/skew.py" in f.message for f in found)
+
+
+def test_ts115_scoping():
+    call = ("def f(mesh, shf):\n"
+            "    return shf.skew_split_targets(mesh)\n")
+    salt = "def f(plan):\n    plan.chunk = plan.chunk * 2\n"
+    # fires anywhere outside the facade — operator AND transport dirs
+    for src in (call, salt):
+        assert any(f.rule == "TS115" for f in ast_lint.lint_source(
+            "cylon_tpu/relational/join.py", src))
+        assert any(f.rule == "TS115" for f in ast_lint.lint_source(
+            "cylon_tpu/exec/pipeline.py", src))
+    # the defining facade is exempt by construction
+    for src in (call, salt):
+        assert not any(f.rule == "TS115" for f in ast_lint.lint_source(
+            "cylon_tpu/relational/skew.py", src))
+    # reads of plan fields and non-plan attribute assigns stay clean
+    clean = ("def f(plan, span):\n"
+             "    n = plan.fanout.sum()\n"
+             "    span.start = 3\n"
+             "    return n\n")
+    assert not any(f.rule == "TS115" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/join.py", clean))
+
+
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
                                        "TS109", "TS110", "TS111", "TS112",
-                                       "TS113", "TS114"}
+                                       "TS113", "TS114", "TS115"}
 
 
 # ---------------------------------------------------------------------------
