@@ -20,7 +20,10 @@ denominator for the join+groupby pipeline).
 Flags: --rows=N (per chip; default 125M on TPU — the BASELINE.json
 north-star per-chip share, auto-routed through the range-partitioned
 pipeline — 1M on CPU), --unique=F, --iters=K, --cpu-mesh, --tpch (TPC-H
-instead, see cylon_tpu.tpch).
+instead, see cylon_tpu.tpch), --slices=S (declare an S-slice two-tier
+fabric — exchanges route through the hierarchical two-hop engine and
+the detail records per-tier rows/bytes/messages; cylon_tpu/topo,
+docs/topology.md).
 """
 
 from __future__ import annotations
@@ -189,6 +192,30 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
     # which is how piece r+1's overlap with piece r's consume shows up.
     snap = timing.snapshot()
     dispatch_s, block_s = timing.split_snapshot(snap)
+    # --slices: the multi-slice topology decision + per-tier traffic
+    # (cylon_tpu/topo, docs/topology.md).  The registry counters are
+    # process-cumulative; this process ran only this workload, so the
+    # snapshot IS the run's traffic.  dcn_rows/bytes are route-invariant
+    # payload (each remote row crosses DCN once either way); the
+    # two-hop win reads off dcn_messages (~1/R of the flat route's) and,
+    # on concentrated count matrices, dcn_wire_bytes.
+    topo_detail = {}
+    topo_t = env.topology
+    if topo_t.n_slices > 1:
+        from cylon_tpu.topo import model as topo_model
+        tplan = topo_model.last_plan()
+        topo_detail = {
+            "topology": {"n_slices": topo_t.n_slices,
+                         "ranks_per_slice": topo_t.ranks_per_slice,
+                         "source": topo_t.source},
+            "topo_plan": tplan.summary() if tplan is not None else None,
+            "tier_traffic": {
+                name: int(obs.counter(f"exchange_{name}_total").value)
+                for name in ("ici_rows", "dcn_rows", "ici_bytes",
+                             "dcn_bytes", "ici_wire_bytes",
+                             "dcn_wire_bytes", "ici_messages",
+                             "dcn_messages")},
+        }
     # capture the ARMED per-rank report of the (split-armed) profiled
     # iteration BEFORE the unsplit baseline leg below resets the timing
     # accumulators for its own "before" snapshot
@@ -291,6 +318,8 @@ def run(rows_per_chip: int, unique: float = 0.9, iters: int = 4,
                if rank_rep is not None else {}),
             # --skew: plan decision + unsplit-baseline audit leg
             **skew_detail,
+            # --slices: topology decision + per-tier traffic
+            **topo_detail,
             # heavy-hitter profile of the skewed key column (obs/plan
             # key_profile — Misra-Gries over shard-weighted samples):
             # names the hot keys and their estimated share, the ROADMAP
@@ -337,6 +366,13 @@ def main() -> dict:
             iters = int(a.split("=", 1)[1])
         elif a.startswith("--skew="):
             skew = float(a.split("=", 1)[1])
+        elif a.startswith("--slices="):
+            # declare an n-slice two-tier fabric BEFORE the env (and
+            # therefore the topology cache) exists — the hierarchical
+            # two-hop route then carries every exchange and the bench
+            # detail records per-tier bytes/messages (cylon_tpu/topo,
+            # docs/topology.md)
+            os.environ["CYLON_TPU_SLICES"] = a.split("=", 1)[1]
 
     if "--tpch" in sys.argv:
         from cylon_tpu.tpch import bench_tpch

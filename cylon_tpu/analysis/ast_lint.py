@@ -96,6 +96,17 @@ function within the same module) — and flags:
   post-vote salt mutation can put ranks into different exchange plans
   and silently void the stitched output's bit/order-equality contract;
 
+* **TS116** topology decisions outside the ``cylon_tpu/topo`` plan
+  facade — a call to the plan vote (``topo_plan_consensus``), the
+  ``TopologyPlan`` constructor, or the tier/gateway primitives
+  (``hop_counts``, ``gateway_of``), or an assignment to a plan's tier
+  fields (``n_slices``/``ranks_per_slice``/``route``/``gateway``)
+  anywhere else: the facade is what guarantees the slice map, gateway
+  scheme and route choice feed ONE canonical plan hash voted
+  (``Code.TopoPlan``) before the first hierarchical collective — an
+  ad-hoc tier map or a post-vote mutation can put ranks into grouped
+  collectives with different memberships, which deadlocks both tiers;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -225,6 +236,22 @@ _SKEW_PLAN_FUNCS = {"skew_split_targets", "skew_plan_consensus",
 #: salted split-set fields of a SkewPlan no non-facade module may
 #: assign (a post-vote mutation desyncs the voted plan hash)
 _SKEW_PLAN_FIELDS = {"fanout", "chunk", "start", "home", "src_off"}
+
+#: topology primitives callable ONLY from the cylon_tpu/topo plan
+#: facade (TS116, mirroring TS115's shape): the facade owns slice-map
+#: construction, the tier/gateway assignment (hop-count derivation is
+#: where the gateway scheme is encoded) and the Code.TopoPlan vote —
+#: a direct call elsewhere skips the canonical plan hash and the
+#: pre-collective adoption vote.  Matched as a QUALIFIED path pair
+#: like the obs package (a workspace directory that merely happens to
+#: be called "topo" must not disable the rule).
+_TOPO_PKG_PAIR = "/cylon_tpu/topo/"
+_TOPO_PLAN_FUNCS = {"topo_plan_consensus", "TopologyPlan", "hop_counts",
+                    "gateway_of"}
+#: tier-map fields of a TopologyPlan no non-facade module may assign
+#: (a post-vote mutation desyncs the voted plan hash and the grouped
+#: collectives' membership)
+_TOPO_PLAN_FIELDS = {"n_slices", "ranks_per_slice", "route", "gateway"}
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -493,6 +520,7 @@ class _ModuleLint:
         self._check_plan_stack()
         self._check_spill_file_io()
         self._check_skew_plan()
+        self._check_topo_plan()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -902,6 +930,49 @@ class _ModuleLint:
                             "post-vote mutation desyncs the canonical "
                             "plan hash the ranks agreed on "
                             "(docs/trace_safety.md, docs/skew.md)")
+
+    def _check_topo_plan(self) -> None:
+        """TS116: a topology decision outside the cylon_tpu/topo plan
+        facade — the plan vote, the ``TopologyPlan`` constructor, the
+        hop-count/gateway primitives called directly, or a plan's tier
+        fields assigned.  The facade is the one place where slice
+        discovery feeds one canonical plan hash and the
+        ``Code.TopoPlan`` vote runs before the first hierarchical
+        collective (docs/topology.md); the defining package is exempt
+        by construction, matched as a qualified path pair like
+        obs/ (TS113)."""
+        if _TOPO_PKG_PAIR in "/" + self.path.replace(os.sep, "/"):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                fname = _func_name(node.func)
+                if fname.split(".")[-1] in _TOPO_PLAN_FUNCS:
+                    self._emit(
+                        "TS116", node,
+                        f"`{fname}` makes a topology decision outside "
+                        "the cylon_tpu/topo plan facade — slice-map "
+                        "construction, gateway assignment and the "
+                        "Code.TopoPlan vote must go through "
+                        "topology/hier_plan/ensure_adopted/two_hop so "
+                        "every rank routes ONE voted hop plan "
+                        "(docs/trace_safety.md, docs/topology.md)")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr in _TOPO_PLAN_FIELDS
+                            and isinstance(t.value, ast.Name)
+                            and ("topo" in t.value.id.lower()
+                                 or "plan" in t.value.id.lower())):
+                        self._emit(
+                            "TS116", node,
+                            f"assignment to `{t.value.id}.{t.attr}` "
+                            "mutates a TopologyPlan's tier map outside "
+                            "the cylon_tpu/topo facade — a post-vote "
+                            "mutation desyncs the canonical plan hash "
+                            "and the grouped collectives' membership "
+                            "(docs/trace_safety.md, docs/topology.md)")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

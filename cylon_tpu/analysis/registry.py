@@ -24,6 +24,7 @@ from typing import Callable
 BUILDER_MODULES = (
     "cylon_tpu.parallel.collectives",
     "cylon_tpu.parallel.shuffle",
+    "cylon_tpu.topo.exchange",
     "cylon_tpu.relational.join",
     "cylon_tpu.relational.piece",
     "cylon_tpu.relational.sort",

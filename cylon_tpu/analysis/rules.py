@@ -76,6 +76,13 @@ RULES = {
              "rank-coherent Code.SkewPlan vote, so ranks can enter "
              "different exchange plans and the stitched output loses "
              "its bit/order-equality contract",
+    "TS116": "topology decision (TopologyPlan construction, "
+             "tier/gateway assignment, hop-count derivation, plan "
+             "vote) outside the cylon_tpu/topo facade — an ad-hoc "
+             "tier map skips the canonical plan hash and the "
+             "rank-coherent Code.TopoPlan vote, so ranks can route "
+             "the same exchange over different hop plans and deadlock "
+             "the grouped collectives",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

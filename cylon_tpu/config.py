@@ -272,6 +272,25 @@ SKEW_SPLIT_SHARE = float(os.environ.get("CYLON_TPU_SKEW_SPLIT_SHARE",
 SKEW_FANOUT_FACTOR = float(os.environ.get("CYLON_TPU_SKEW_FANOUT_FACTOR",
                                           "1.25"))
 
+# Multi-slice topology tier (cylon_tpu/topo — the plan facade, lint rule
+# TS116; docs/topology.md).  SURVEY §5.8: "DCN between pods via jax's
+# multi-slice runtime" — inter-slice ≠ intra-slice, so the exchange goes
+# hierarchical on a multi-slice fabric: slice-local all-to-all over ICI
+# (align rows on the destination's gateway-local rank), then ONE
+# aggregated cross-slice exchange over DCN, bit- and order-equal to the
+# flat plan by the slice-major layout.
+#: Master switch for the hierarchical (two-hop) shuffle route on
+#: multi-slice topologies.  "0" keeps the flat one-hop exchange on any
+#: topology (the comparison baseline chaos/bench legs run).  Single-slice
+#: topologies always take the flat route regardless — zero extra
+#: collectives, zero host syncs.
+TOPO_SHUFFLE = _env_flag("CYLON_TPU_TOPO_SHUFFLE", True)
+#: ``CYLON_TPU_SLICES=<n>`` declares an n-slice two-tier fabric over the
+#: visible devices (contiguous slice-major blocks) — the CPU-grid
+#: simulation knob tests and chaos schedules use; parsed by
+#: cylon_tpu/topo/model.py (real multi-slice TPU fleets are discovered
+#: from device attributes instead).
+
 #: Distributed-sort splitter samples per shard: grows with the world size
 #: (more shards need finer splitters for the same balance; the reference's
 #: SortOptions.num_samples is likewise caller-tunable, table.hpp:358).

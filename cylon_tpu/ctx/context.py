@@ -91,7 +91,14 @@ class TPUConfig(CommConfig):
                 raise InvalidError(
                     f"world_size {self.world_size} > visible devices {len(devs)}")
             devs = devs[: self.world_size]
-        return devs
+        # slice-major rank numbering (cylon_tpu/topo, docs/topology.md):
+        # on a multi-slice fleet the mesh axis orders devices by
+        # (slice_index, position) so rank // ranks_per_slice == slice —
+        # the layout premise of the two-hop exchange's order-preservation
+        # proof and of repart's global index math.  Single-slice fleets
+        # and CPU grids come back untouched.
+        from ..topo.model import slice_major_order
+        return slice_major_order(devs)
 
 
 class CPUMeshConfig(TPUConfig):
@@ -175,6 +182,15 @@ class CylonEnv:
     @property
     def is_distributed(self) -> bool:
         return self.world_size > 1
+
+    @property
+    def topology(self):
+        """The mesh's tier model (cylon_tpu/topo — slice count, ranks
+        per slice, discovery source; docs/topology.md).  Single-slice
+        on fleets without slice attributes and without a
+        ``CYLON_TPU_SLICES`` declaration."""
+        from ..topo import model as _topo_model
+        return _topo_model.topology(self._mesh)
 
     def sharding(self, spec: P | None = None) -> NamedSharding:
         """NamedSharding over this env's mesh; default = row-sharded."""

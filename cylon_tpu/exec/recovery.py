@@ -824,51 +824,76 @@ def watermark_consensus(mesh: Mesh | None, n: int) -> int:
         % _CKPT_EPOCH_BASE)
 
 
+def _plan_hash_consensus(mesh: Mesh | None, code: Code, plan_hash: int,
+                         site: str, what: str) -> None:
+    """Adopt-one-plan agreement shared by the skew-split and topology
+    routes: every rank votes ``code`` with two 20-bit slices of the
+    canonical plan hash riding the pmax wire — EACH slice in both
+    polarities (plain, then complemented), four rounds total, so a rank
+    passes a slice's pair only when its value equals both the max AND
+    the min across the mesh: any divergence in either slice raises on
+    EVERY rank, exactly like the checkpoint-commit vote.  A diverging
+    hash is a structural desync — ranks about to enter DIFFERENT
+    exchange plans (different collective sequences) — and raises typed
+    BEFORE the plan's first collective is dispatched, the
+    rank-coherence invariant this module exists for."""
+    lo20 = int(plan_hash) & ((1 << 20) - 1)
+    hi20 = (int(plan_hash) >> 20) & ((1 << 20) - 1)
+    if mesh is None or jax.process_count() == 1:
+        return
+    base = int(code) * _CKPT_EPOCH_BASE
+    for label, slice20 in (("lo", lo20), ("hi", hi20)):
+        for complemented in (False, True):
+            v = (_CKPT_EPOCH_BASE - 1 - slice20) if complemented \
+                else slice20
+            wire = base + v
+            agreed = _ns_consensus(mesh, wire, _CKPT_NS_BASE, site)
+            if agreed != wire:
+                peer = agreed % _CKPT_EPOCH_BASE
+                if complemented:
+                    peer = _CKPT_EPOCH_BASE - 1 - peer
+                raise RankDesyncError(
+                    f"{what} vote diverged: this rank computed plan "
+                    f"hash slice {label}={slice20:#x}, consensus saw "
+                    f"{peer:#x} — ranks are about to enter different "
+                    f"exchange plans", site=site, phase=_last_phase())
+
+
 def skew_plan_consensus(mesh: Mesh | None, plan_hash: int) -> None:
     """Adopt-one-plan agreement for the adaptive skew-split route
     (relational/skew.py, docs/skew.md): every rank computes the plan —
     heavy-key set, contiguous rank groups, salted fan-out chunk bounds —
     from the SAME allgathered sample + count sidecars, then votes
-    :class:`Code.SkewPlan` with two 20-bit slices of the canonical plan
-    hash riding the pmax wire — EACH slice in both polarities (plain,
-    then complemented), four rounds total, so a rank passes a slice's
-    pair only when its value equals both the max AND the min across the
-    mesh: any divergence in either slice raises on EVERY rank, exactly
-    like the checkpoint-commit vote.  A diverging hash is a structural
-    desync —
-    ranks about to enter DIFFERENT exchange plans (different collective
-    sequences) — and raises typed BEFORE the split exchange's first
-    collective is dispatched, the rank-coherence invariant this module
-    exists for.  The recovery ladder's retries re-detect and re-vote:
-    determinism of the detection inputs makes the re-voted hash
-    identical, which chaos_soak's ``--skew`` schedules assert.
+    :class:`Code.SkewPlan` over the four-round double-polarity hash
+    wire (:func:`_plan_hash_consensus`).  The recovery ladder's retries
+    re-detect and re-vote: determinism of the detection inputs makes
+    the re-voted hash identical, which chaos_soak's ``--skew``
+    schedules assert.
 
     Polled ONLY when a non-empty plan was decided (plan-armed joins) —
     the plan decision itself is rank-uniform by construction
     (``host_array`` allgathers the sample), so the unarmed / no-heavy-key
     path stays collective-free (the bench's zero-extra-collectives
     contract at skew 0)."""
-    lo20 = int(plan_hash) & ((1 << 20) - 1)
-    hi20 = (int(plan_hash) >> 20) & ((1 << 20) - 1)
-    if mesh is None or jax.process_count() == 1:
-        return
-    code = int(Code.SkewPlan) * _CKPT_EPOCH_BASE
-    for label, slice20 in (("lo", lo20), ("hi", hi20)):
-        for complemented in (False, True):
-            v = (_CKPT_EPOCH_BASE - 1 - slice20) if complemented \
-                else slice20
-            wire = code + v
-            agreed = _ns_consensus(mesh, wire, _CKPT_NS_BASE, "skew.plan")
-            if agreed != wire:
-                peer = agreed % _CKPT_EPOCH_BASE
-                if complemented:
-                    peer = _CKPT_EPOCH_BASE - 1 - peer
-                raise RankDesyncError(
-                    f"skew-plan vote diverged: this rank computed plan "
-                    f"hash slice {label}={slice20:#x}, consensus saw "
-                    f"{peer:#x} — ranks are about to enter different "
-                    "split exchange plans", site="skew.plan",
-                    phase=_last_phase())
+    _plan_hash_consensus(mesh, Code.SkewPlan, plan_hash, "skew.plan",
+                         "skew-plan")
+
+
+def topo_plan_consensus(mesh: Mesh | None, plan_hash: int) -> None:
+    """Adopt-one-plan agreement for the multi-slice topology route
+    (cylon_tpu/topo — the TS116 facade is the only sanctioned caller;
+    docs/topology.md): every rank derives the topology plan — slice
+    map, flat/hierarchical route, gateway scheme — from the SAME device
+    attributes / ``CYLON_TPU_SLICES`` declaration, then votes
+    :class:`Code.TopoPlan` over the four-round double-polarity hash
+    wire (:func:`_plan_hash_consensus`) BEFORE the first hierarchical
+    collective, so recovery ladders, checkpoints and elastic resume
+    (slice loss → re-shard onto the surviving world, which re-votes the
+    NEW topology) all adopt one plan.  Voted once per (mesh, plan) —
+    single-slice sessions never reach it (zero collectives on the flat
+    route, the chaos ``--multislice`` unarmed-leg contract)."""
+    _plan_hash_consensus(mesh, Code.TopoPlan, plan_hash, "topo.plan",
+                         "topology-plan")
 
 
 def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:

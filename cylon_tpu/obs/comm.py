@@ -14,6 +14,11 @@ per-destination received totals, and whose grand totals must equal the
 always-on registry counters ``exchange_rows_total`` /
 ``exchange_bytes_total`` (asserted in tests/test_explain.py and
 cross-checked byte-identical across ranks in tests/multihost_driver.py).
+On a multi-slice topology (cylon_tpu/topo, docs/topology.md) the
+cumulative matrices additionally split by TIER — same-slice cells are
+ICI, cross-slice cells DCN, ici+dcn grand totals still equal the
+registry counters — alongside each tier's padded wire volume, the
+two-hop route's acceptance instrument.
 
 Unarmed and with no plan profile active, :func:`record` is never called
 — the exchange guards on ``armed()`` (one env-cached list load): zero
@@ -40,7 +45,12 @@ _ARMED: list = [False]
 _ENV_ARMED: list = [None]
 
 #: cumulative state: [world, rows (W,W) int64, bytes (W,W) int64,
-#: n_exchanges] — None until the first record
+#: n_exchanges, slice_ids (W,) int32 or None, tier_traffic dict
+#: (wire_ici/wire_dcn/msgs_ici/msgs_dcn), route_counts dict] — None
+#: until the first record.  The tier fields (cylon_tpu/topo,
+#: docs/topology.md) stay None/zero on single-slice topologies, and
+#: :func:`report` splits the cumulative matrices by the slice map when
+#: one was recorded.
 _STATE: list = [None]
 
 #: per-exchange log (site, rows_total, bytes_total), newest last, bounded
@@ -73,27 +83,55 @@ def reset() -> None:
     del _LOG[:]
 
 
-def record(counts, row_bytes: int, site: str = "exchange") -> None:
+def record(counts, row_bytes: int, site: str = "exchange",
+           tiers: dict | None = None) -> None:
     """Accumulate one exchange's (W, W) count sidecar into the
     cumulative matrices + the bounded per-exchange log.  Called (via
     ``obs.plan.record_exchange``) only when :func:`armed`; pure host
     work on the replicated sidecar — the plan profiler computes its
     node totals from the same sidecar independently, so an unarmed
-    profile never touches this module's state."""
+    profile never touches this module's state.
+
+    ``tiers`` (multi-slice topologies, cylon_tpu/topo): the engine's
+    tier attribution — ``slice_ids`` (the per-rank slice map the report
+    splits the matrices on), ``route`` ("flat"/"two_hop"), the PADDED
+    per-tier wire volumes ``wire_ici``/``wire_dcn`` and the per-tier
+    message counts ``msgs_ici``/``msgs_dcn`` this exchange put on each
+    interconnect (the count matrix records payload rows; padding and
+    per-message overhead are where the flat plan's small-message cost
+    lives — docs/topology.md)."""
     counts = np.asarray(counts, np.int64)
     w = counts.shape[0]
     bmat = counts * int(row_bytes)
+    sids = None if tiers is None \
+        else np.asarray(tiers["slice_ids"], np.int32)
     st = _STATE[0]
-    if st is None or st[0] != w:
-        # world change (new mesh mid-process): restart the accumulation
-        # — matrices of different shapes cannot legally sum
+    topo_changed = st is not None and (
+        (sids is None) != (st[4] is None)
+        or (sids is not None and not np.array_equal(st[4], sids)))
+    if st is None or st[0] != w or topo_changed:
+        # world OR topology change (new mesh / re-sliced fabric
+        # mid-process, in EITHER direction — tiered↔tier-less included):
+        # restart the accumulation — matrices of different shapes or
+        # tier maps cannot legally sum, and a tier split computed over
+        # traffic recorded under another (or no) slice map would
+        # misattribute every pre-change exchange
         st = _STATE[0] = [w, np.zeros((w, w), np.int64),
-                          np.zeros((w, w), np.int64), 0]
+                          np.zeros((w, w), np.int64), 0, sids,
+                          {"wire_ici": 0, "wire_dcn": 0,
+                           "msgs_ici": 0, "msgs_dcn": 0}, {}]
     st[1] += counts
     st[2] += bmat
     st[3] += 1
-    _LOG.append({"site": site, "rows": int(counts.sum()),
-                 "bytes": int(bmat.sum()), "row_bytes": int(row_bytes)})
+    ent = {"site": site, "rows": int(counts.sum()),
+           "bytes": int(bmat.sum()), "row_bytes": int(row_bytes)}
+    if tiers is not None:
+        for k in st[5]:
+            st[5][k] += int(tiers[k])
+        route = tiers["route"]
+        st[6][route] = st[6].get(route, 0) + 1
+        ent["route"] = route
+    _LOG.append(ent)
     if len(_LOG) > _LOG_CAP:
         del _LOG[:len(_LOG) - _LOG_CAP]
 
@@ -120,13 +158,18 @@ def report(verify_across_ranks: bool = True) -> dict | None:
     if st is None:
         return None
     w, rows, bts, n = st[0], st[1], st[2], st[3]
+    sids, traffic, routes = st[4], st[5], st[6]
 
     import jax
     nproc = jax.process_count()
     if verify_across_ranks and nproc > 1:
         from jax.experimental import multihost_utils
         from ..status import RankDesyncError
-        wire = np.concatenate([[np.int64(n)], rows.ravel(), bts.ravel()])
+        tier_wire = ([np.int64(traffic[k]) for k in sorted(traffic)]
+                     + (sids.astype(np.int64).tolist()
+                        if sids is not None else []))
+        wire = np.concatenate([[np.int64(n)], rows.ravel(), bts.ravel(),
+                               np.asarray(tier_wire, np.int64)])
         gathered = np.asarray(
             multihost_utils.process_allgather(wire)).reshape(nproc, -1)
         for r in range(1, nproc):
@@ -136,7 +179,7 @@ def report(verify_across_ranks: bool = True) -> dict | None:
                     "sidecars — the ranks ran different shuffles",
                     site="obs.comm")
 
-    return {
+    out = {
         "world": w,
         "exchanges": n,
         "rows": rows.tolist(),
@@ -147,3 +190,29 @@ def report(verify_across_ranks: bool = True) -> dict | None:
         "total_bytes": int(bts.sum()),
         "recent": list(_LOG[-16:]),
     }
+    if sids is not None:
+        # tier split (cylon_tpu/topo, docs/topology.md): the cumulative
+        # matrices masked by the slice map.  ICI + DCN grand totals
+        # equal the matrix totals above — which reconcile with the
+        # always-on registry counters — while the wire/message fields
+        # carry each tier's PADDED link volume and (src, dst, round)
+        # transfer count: the DCN message count is the two-hop route's
+        # exactly-1/R acceptance instrument (cross-slice payload itself
+        # is route-invariant — each remote row crosses DCN once either
+        # way).
+        cross = sids[:, None] != sids[None, :]
+        out["tiers"] = {
+            "n_slices": int(len(np.unique(sids))),
+            "ici_rows_matrix": np.where(cross, 0, rows).tolist(),
+            "dcn_rows_matrix": np.where(cross, rows, 0).tolist(),
+            "ici_rows": int(rows[~cross].sum()),
+            "dcn_rows": int(rows[cross].sum()),
+            "ici_bytes": int(bts[~cross].sum()),
+            "dcn_bytes": int(bts[cross].sum()),
+            "ici_wire_bytes": int(traffic["wire_ici"]),
+            "dcn_wire_bytes": int(traffic["wire_dcn"]),
+            "ici_messages": int(traffic["msgs_ici"]),
+            "dcn_messages": int(traffic["msgs_dcn"]),
+            "routes": dict(routes),
+        }
+    return out

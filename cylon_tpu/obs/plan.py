@@ -343,7 +343,8 @@ def node(op: str, **attrs) -> _NodeCtx:
 # exchange + key-profile recording (called from the engine)
 # ---------------------------------------------------------------------------
 
-def record_exchange(counts, row_bytes: int, site: str = "exchange") -> None:
+def record_exchange(counts, row_bytes: int, site: str = "exchange",
+                    tiers: dict | None = None) -> None:
     """Attach one exchange's totals to the innermost plan node, and —
     ONLY with the comm matrix explicitly armed — accumulate its
     per-(src,dst) matrix.  Called by ``parallel/shuffle.exchange`` only
@@ -354,18 +355,26 @@ def record_exchange(counts, row_bytes: int, site: str = "exchange") -> None:
     a later ARMED session's report() serves, breaking its
     totals-equal-the-exchange-counters invariant (and, cross-rank, its
     byte-identity check when ranks profiled different queries before
-    arming — regression test in tests/test_explain.py)."""
+    arming — regression test in tests/test_explain.py).
+
+    ``tiers`` (multi-slice topologies only, cylon_tpu/topo): the
+    engine-computed tier attribution — per-rank slice ids, the route
+    that carried the exchange, and each tier's padded wire volume — fed
+    through to :func:`cylon_tpu.obs.comm.record`'s ICI/DCN split."""
     import numpy as np
     from . import comm
     rows = int(np.asarray(counts).sum())
     nbytes = rows * int(row_bytes)
     if comm.armed():
-        comm.record(counts, row_bytes, site=site)
+        comm.record(counts, row_bytes, site=site, tiers=tiers)
     n = current()
     if n is not None:
         n.rows_exchanged += rows
         n.bytes_exchanged += nbytes
-        n.exchanges.append({"site": site, "rows": rows, "bytes": nbytes})
+        ent = {"site": site, "rows": rows, "bytes": nbytes}
+        if tiers is not None:
+            ent["route"] = tiers["route"]
+        n.exchanges.append(ent)
 
 
 def profile_keys(pn, table, key_names, k: int = SKETCH_K) -> None:

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
 from ..obs import comm as _comm, metrics as _metrics, plan as _plan
+from ..topo import model as _topo
 from ..utils.cache import program_cache
 from ..ctx.context import ROW_AXIS
 from ..ops import hashing
@@ -361,6 +362,25 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     per_dest = counts.sum(axis=0)
     out_cap = config.pow2ceil(int(per_dest.max()) if per_dest.size else 1)
 
+    # topology route (cylon_tpu/topo, docs/topology.md): on a
+    # multi-slice fabric phase B goes hierarchical — a slice-local ICI
+    # alignment hop, then ONE aggregated cross-slice DCN hop — bit- and
+    # order-equal to the flat plan by the slice-major layout.  The
+    # route choice is deterministic from the cached topology plan
+    # (rank-uniform by construction), and on a single-slice topology
+    # ``hier_plan`` is one cached lookup returning None: the flat path
+    # below is byte-identical to the pre-topology engine — zero extra
+    # collectives, zero host syncs (the chaos --multislice unarmed-leg
+    # contract).
+    hplan = _topo.hier_plan(mesh)
+    hprep = None
+    if hplan is not None:
+        # derive the two-hop schedule (hop count matrices, blocks,
+        # gateway capacity) ONCE per exchange — the guard sizing, tier
+        # accounting and dispatch below all read this object
+        from ..topo import exchange as _topo_exchange
+        hprep = _topo_exchange.prepare(hplan, counts)
+
     # Receive-side memory guard (accelerators only; ``guard=True`` from
     # hash-shuffle callers): the multi-round protocol bounds SEND
     # buffers, but the receiving shard still materializes every row
@@ -395,7 +415,16 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
         # construction (recovery.probe) — so the un-injected happy path
         # adds no collective and no host sync to the exchange.
         from ..exec import recovery, scheduler
-        need = out_cap * row_bytes
+        if hplan is not None:
+            # two-hop peak receive: the hop-1 gateway buffers (payload
+            # + the int32 final-target sidecar lane) are still alive —
+            # as hop 2's inputs — while the final buffers fill, so the
+            # guard sizes against the SUM of the tiers (deterministic
+            # host math on the replicated sidecar)
+            need = _topo_exchange.recv_guard_bytes(hplan, hprep, out_cap,
+                                                   row_bytes)
+        else:
+            need = out_cap * row_bytes
         # HBM-ledger consult (exec/memory): the predicted receive is an
         # allocation ON TOP of the resident balance the ledger tracks —
         # and unlike the static receive budget, ledger pressure is
@@ -425,9 +454,13 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
                 # ranks raise the predicted shape below and the ladder's
                 # code consensus re-aligns the branches
                 raise recovery.make_fault(kind, "shuffle.recv_guard")
+            hop1 = ("" if hplan is None else
+                    f" (two-hop route: {out_cap} final rows + "
+                    f"{hprep.cap1} gateway rows incl. the target "
+                    "sidecar — both tiers live at once)")
             raise PredictedResourceExhausted(
                 f"RESOURCE_EXHAUSTED (predicted): exchange receive "
-                f"allocation {out_cap} rows x {row_bytes} B/row exceeds "
+                f"allocation {need} B at {row_bytes} B/row{hop1} exceeds "
                 f"CYLON_TPU_EXCHANGE_RECV_BUDGET "
                 f"({config.EXCHANGE_RECV_BUDGET_BYTES} B); one destination "
                 "shard would materialize the bulk of the table",
@@ -436,27 +469,75 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
     # always-on exchange totals (host arithmetic on the already-pulled
     # count sidecar — no device work, no sync): the registry counters
     # the armed comm matrix's row/column sums must reconcile against
-    # (obs/comm, docs/observability.md)
+    # (obs/comm, docs/observability.md).  The counters record the
+    # LOGICAL exchange — each row delivered once — whichever route
+    # carried it, so flat and hierarchical runs of the same workload
+    # stay comparable; the tier counters below say which interconnect
+    # the journey used.
     _metrics.counter("exchange_rows_total").inc(total)
     _metrics.counter("exchange_bytes_total").inc(total * row_bytes)
     _metrics.counter("exchange_count").inc()
+    route = "two_hop" if hplan is not None else "flat"
+    topo_t = _topo.topology(mesh)
+    tiers = None
+    if topo_t.n_slices > 1:
+        # always-on per-tier counters on MULTI-SLICE topologies only
+        # (host numpy on the replicated sidecar; single-slice rigs skip
+        # on one cached field load): payload rows/bytes split by which
+        # tier the row's journey crosses, plus the PADDED wire volume
+        # and (src, dst, round) message count each tier's links carry —
+        # the DCN message count is the two-hop route's exactly-1/R
+        # acceptance instrument (docs/topology.md, bench --slices).
+        from ..topo import exchange as _topo_exchange
+        ici_rows, dcn_rows = _topo.tier_split(counts, topo_t)
+        traffic = _topo_exchange.tier_traffic(
+            topo_t, counts, row_bytes, route, prep=hprep,
+            flat_block_rounds=(block, rounds) if hplan is None else None)
+        _metrics.counter("exchange_ici_rows_total").inc(ici_rows)
+        _metrics.counter("exchange_dcn_rows_total").inc(dcn_rows)
+        _metrics.counter("exchange_ici_bytes_total").inc(
+            ici_rows * row_bytes)
+        _metrics.counter("exchange_dcn_bytes_total").inc(
+            dcn_rows * row_bytes)
+        _metrics.counter("exchange_ici_wire_bytes_total").inc(
+            traffic["wire_ici"])
+        _metrics.counter("exchange_dcn_wire_bytes_total").inc(
+            traffic["wire_dcn"])
+        _metrics.counter("exchange_ici_messages_total").inc(
+            traffic["msgs_ici"])
+        _metrics.counter("exchange_dcn_messages_total").inc(
+            traffic["msgs_dcn"])
+        tiers = {"slice_ids": topo_t.slice_ids(), "route": route,
+                 **traffic}
     if _comm.armed() or _plan.active():
         # per-(src,dst) matrix + plan-node attribution (armed runs /
         # active EXPLAIN ANALYZE only — the happy path skips on two
         # cached loads)
-        _plan.record_exchange(counts, row_bytes, site=owner)
-    if rounds > 1:
-        # countable path marker (tests/test_fuzz.py regime tier): the
-        # multi-round protocol actually engaged for this exchange
-        from ..utils import timing
-        timing.bump("exchange.multiround")
-    counts_i = np.asarray(counts, np.int32)
-    tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)
-    outs = tuple(_alloc_fn(mesh, out_cap, str(c.dtype), c.shape[1:])()
-                 for c in cols)
-    # all rounds run in ONE compiled program (fori_loop when rounds > 1)
-    fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
-    outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
+        _plan.record_exchange(counts, row_bytes, site=owner, tiers=tiers)
+    if hplan is not None:
+        # the voted hierarchical route (cylon_tpu/topo/exchange): the
+        # plan hash is consensus-adopted BEFORE the first hierarchical
+        # collective (one set lookup after the first exchange), then
+        # phase B runs as slice-local ICI alignment + one aggregated
+        # cross-slice DCN hop — bit- and order-equal to the flat branch
+        # below (docs/topology.md)
+        _topo.ensure_adopted(mesh, hplan)
+        outs, _pd = _topo_exchange.two_hop(mesh, hplan, tgt, counts,
+                                           tuple(cols), out_cap,
+                                           prep=hprep)
+    else:
+        if rounds > 1:
+            # countable path marker (tests/test_fuzz.py regime tier):
+            # the multi-round protocol actually engaged
+            from ..utils import timing
+            timing.bump("exchange.multiround")
+        counts_i = np.asarray(counts, np.int32)
+        tgt_s, perm, pos = _prep_fn(mesh, w)(tgt, counts_i)
+        outs = tuple(_alloc_fn(mesh, out_cap, str(c.dtype), c.shape[1:])()
+                     for c in cols)
+        # all rounds run in ONE compiled program (fori_loop if rounds>1)
+        fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
+        outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
     if guard:
         # HBM-ledger accounting of the receive allocation (exec/memory):
         # one registration PER buffer, each anchored to its own array, so

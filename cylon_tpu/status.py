@@ -54,6 +54,17 @@ class Code(enum.IntEnum):
     #: hash diverges raises typed instead of entering the split
     #: exchange's collectives alone.  Not an error class — never raised.
     SkewPlan = 49
+    #: topology-plan adoption vote (exec/recovery.topo_plan_consensus +
+    #: cylon_tpu/topo): every rank has derived the multi-slice topology
+    #: plan (slice map, route choice, gateway scheme) from the same
+    #: device attributes / CYLON_TPU_SLICES declaration and votes this
+    #: code with two 20-bit slices of the canonical plan hash riding the
+    #: pmax wire BEFORE the first hierarchical collective, so recovery
+    #: ladders, checkpoints and elastic resume all adopt ONE topology —
+    #: a rank whose slice map diverges raises typed instead of entering
+    #: a two-hop exchange its peers route differently.  Not an error
+    #: class — never raised.
+    TopoPlan = 50
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42

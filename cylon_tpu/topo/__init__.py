@@ -1,0 +1,20 @@
+"""Multi-slice topology tier: the hierarchical two-hop shuffle over
+ICI + DCN (ROADMAP item 5, docs/topology.md).
+
+* :mod:`cylon_tpu.topo.model` — the plan facade (lint rule TS116):
+  slice discovery (jax device attributes, ``CYLON_TPU_SLICES``
+  simulation knob), the slice-major tier model, gateway assignment,
+  and the consensus-voted :class:`~cylon_tpu.topo.model.TopologyPlan`.
+* :mod:`cylon_tpu.topo.exchange` — the two-hop exchange engine
+  (slice-local ICI alignment, one aggregated cross-slice DCN hop),
+  bit- and order-equal to the flat plan by construction.
+
+Import-light by design: :mod:`ctx.context` imports the model for
+slice-major device ordering, and the exchange engine (which imports
+the parallel transport) loads lazily from
+``parallel/shuffle.exchange``'s hierarchical route.
+"""
+
+from .model import (Topology, TopologyPlan, declared_slices,  # noqa: F401
+                    ensure_adopted, gateway_of, hier_plan, last_plan,
+                    slice_major_order, tier_split, topology)

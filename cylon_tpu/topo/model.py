@@ -1,0 +1,311 @@
+"""Multi-slice topology model — THE one place slice maps, gateway
+assignment and topology-plan construction happen (lint rule TS116,
+docs/topology.md).
+
+Everything below ROADMAP item 5 assumed one slice where all-to-all is
+uniform.  A real TPU fleet is a two-tier fabric: chips within a slice
+talk over ICI, slices talk over DCN ("DCN between pods via jax's
+multi-slice runtime", SURVEY §5.8) — inter-slice ≠ intra-slice, the
+same asymmetry the reference built its entire net layer around.  This
+module is the plan facade for that fabric:
+
+1. **Discovery** — slices come from jax device attributes
+   (``slice_index`` on a real multi-slice fleet) or from the
+   ``CYLON_TPU_SLICES=<n>`` declaration (contiguous slice-major blocks
+   over the visible devices — the CPU-grid simulation knob the tests
+   and chaos schedules use today).  Non-uniform or non-dividing slice
+   shapes degrade to a single-slice topology (flat route), never an
+   error: topology is an optimization, not a correctness input.
+
+2. **Slice-major layout** — rank ``r`` lives in slice ``r // R`` at
+   local index ``r % R`` (``R`` ranks per slice).  Slice-major is what
+   keeps ``repart``'s order-preserving index math valid under the
+   two-hop exchange: both hops' receive orders compose to exactly the
+   flat exchange's (source rank, source position) order
+   (docs/topology.md, "Order preservation").
+
+3. **Gateway scheme** — the two-hop route's hop 1 sends a row destined
+   for global rank ``d`` to the slice-LOCAL rank ``d % R`` (the
+   destination's *gateway-local bucket*): after hop 1, every row of
+   slice ``s`` bound for any ``(D, j)`` sits on ``(s, j)``, so hop 2 is
+   one aggregated cross-slice exchange per (src-slice, dst-slice) pair
+   — O(rows) over DCN once, instead of O(rows × peers) small padded
+   messages (:func:`gateway_of`).
+
+4. **Plan + vote** — the route choice (flat vs hierarchical, slice map,
+   gateway scheme) is a canonical :class:`TopologyPlan` whose sha256
+   hash is voted over the PR 3 consensus wire
+   (:func:`cylon_tpu.exec.recovery.topo_plan_consensus`,
+   ``Code.TopoPlan``) BEFORE the first hierarchical collective — so
+   recovery ladders, checkpoints and elastic resume (slice loss →
+   PR 9 re-shard onto the surviving world) all adopt ONE topology.
+
+The single-slice / unarmed path is one cached lookup per exchange:
+zero collectives, zero votes, zero host syncs (asserted in
+tests/test_topo.py and the chaos ``--multislice`` unarmed leg).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+from .. import config
+
+__all__ = ["Topology", "TopologyPlan", "topology", "hier_plan",
+           "ensure_adopted", "last_plan", "tier_split", "gateway_of",
+           "slice_major_order", "declared_slices"]
+
+#: env declaration, read ONCE at first topology() (None = unread): the
+#: lookup sits on the per-exchange hot path, so it must stay a list
+#: load, not an environ lookup — tests re-slicing mid-process call
+#: :func:`_reslice` (the obs/comm._rearm pattern)
+_DECLARED: list = [None]
+
+#: plan identities already voted this process: (mesh ident, plan hash).
+#: Advances identically on every rank of an SPMD session (the first
+#: hierarchical exchange is reached at the same program point), so the
+#: vote-once gate is rank-uniform by construction.
+_ADOPTED: set = set()
+
+#: the most recently voted plan (bench --slices detail and the chaos
+#: --multislice same-plan-after-recovery assertions read it)
+_LAST: list = [None]
+
+
+def declared_slices() -> int | None:
+    """The ``CYLON_TPU_SLICES`` declaration (cached), or None."""
+    d = _DECLARED[0]
+    if d is None:
+        raw = os.environ.get("CYLON_TPU_SLICES", "")
+        try:
+            d = int(raw) if raw else 0
+        except ValueError:
+            d = 0
+        _DECLARED[0] = d
+    return d if d > 0 else None
+
+
+def _reslice() -> None:
+    """Re-read ``CYLON_TPU_SLICES`` on the next topology() (tests; env
+    changed mid-process).  Also forgets voted plans — a re-sliced mesh
+    is a NEW topology and must re-vote."""
+    _DECLARED[0] = None
+    _ADOPTED.clear()
+    _LAST[0] = None
+    _CACHE.clear()
+
+
+class Topology:
+    """The tier model of one mesh: ``world`` ranks in ``n_slices``
+    uniform slices of ``ranks_per_slice``, slice-major."""
+
+    __slots__ = ("world", "n_slices", "ranks_per_slice", "source")
+
+    def __init__(self, world: int, n_slices: int, source: str):
+        self.world = int(world)
+        self.n_slices = int(n_slices)
+        self.ranks_per_slice = self.world // max(self.n_slices, 1)
+        self.source = source      # "env" | "device" | "single"
+
+    def slice_of(self, rank: int) -> int:
+        return int(rank) // self.ranks_per_slice
+
+    def slice_ids(self) -> np.ndarray:
+        """(W,) int32 per-rank slice ids — the tier key obs/comm splits
+        the cumulative matrices on."""
+        return (np.arange(self.world, dtype=np.int32)
+                // self.ranks_per_slice)
+
+    def cross_mask(self) -> np.ndarray:
+        """(W, W) bool: cell (s, d) crosses slices — the DCN tier."""
+        sid = self.slice_ids()
+        return sid[:, None] != sid[None, :]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"Topology(world={self.world}, slices={self.n_slices}x"
+                f"{self.ranks_per_slice}, source={self.source})")
+
+
+def _device_slices(devices) -> list | None:
+    """Per-device slice ids from jax device attributes (real multi-slice
+    fleets carry ``slice_index``), or None when absent/uniform."""
+    ids = []
+    for d in devices:
+        s = getattr(d, "slice_index", None)
+        if s is None:
+            return None
+        ids.append(int(s))
+    return ids if len(set(ids)) > 1 else None
+
+
+def slice_major_order(devices) -> list:
+    """Reorder a device list slice-major (stable within a slice) so the
+    mesh's rank numbering satisfies ``rank // R == slice`` — the layout
+    premise of the two-hop exchange's order-preservation proof and of
+    ``repart``'s global index math (docs/topology.md).  Devices without
+    slice attributes (CPU grids, single-slice fleets) come back
+    untouched: the ``CYLON_TPU_SLICES`` declaration partitions the
+    existing order contiguously, which is already slice-major."""
+    ids = _device_slices(devices)
+    if ids is None:
+        return list(devices)
+    order = sorted(range(len(devices)), key=lambda i: (ids[i], i))
+    return [devices[i] for i in order]
+
+
+#: (mesh device ids, declared, armed?) -> Topology/TopologyPlan: tiny
+#: host objects (a few ints each) keyed on stable hashables — the
+#: per-exchange hot-path lookup.  Bounded in practice by the handful of
+#: meshes a process ever builds (utils/cache's MESH_TABLE_LIMIT rationale
+#: does not apply: nothing here pins executables or device memory).
+_CACHE: dict = {}
+
+
+def _mesh_ident(mesh) -> tuple:
+    return tuple(d.id for d in mesh.devices.flat)
+
+
+def _topology_for(mesh, declared: int | None) -> Topology:
+    key = ("topo", _mesh_ident(mesh), declared)
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
+    t = _CACHE[key] = _build_topology(mesh, declared)
+    return t
+
+
+def _build_topology(mesh, declared: int | None) -> Topology:
+    w = int(mesh.devices.size)
+    if declared is not None:
+        if 2 <= declared <= w and w % declared == 0:
+            return Topology(w, declared, "env")
+        return Topology(w, 1, "single")
+    ids = _device_slices(list(mesh.devices.flat))
+    if ids is None:
+        return Topology(w, 1, "single")
+    n = len(set(ids))
+    per = [ids.count(s) for s in sorted(set(ids))]
+    # uniform slice-major only: anything else degrades to single-slice
+    # (flat route) — topology is an optimization, never a correctness
+    # input, and a ragged fleet's exchange must still be exact
+    if len(set(per)) != 1 or ids != sorted(ids):
+        return Topology(w, 1, "single")
+    return Topology(w, n, "device")
+
+
+def topology(mesh) -> Topology:
+    """The (cached) tier model of ``mesh`` — one dict lookup on the
+    per-exchange hot path after the first call."""
+    return _topology_for(mesh, declared_slices())
+
+
+def gateway_of(dest: int, src_slice: int, ranks_per_slice: int) -> int:
+    """Hop-1 gateway: the slice-LOCAL rank of ``src_slice`` that buckets
+    rows destined for global rank ``dest`` — the destination's local
+    index, so hop 2 is a pure cross-slice exchange between same-local
+    ranks (the "gateway-local bucket" of docs/topology.md)."""
+    return src_slice * ranks_per_slice + (dest % ranks_per_slice)
+
+
+class TopologyPlan:
+    """The voted route choice for one mesh: tier map + gateway scheme +
+    flat/hierarchical decision, with a canonical hash covering every
+    field that shapes the collective sequence."""
+
+    __slots__ = ("world", "n_slices", "ranks_per_slice", "route",
+                 "gateway", "source", "_hash")
+
+    def __init__(self, topo: Topology, route: str):
+        self.world = topo.world
+        self.n_slices = topo.n_slices
+        self.ranks_per_slice = topo.ranks_per_slice
+        self.route = route                 # "hierarchical" | "flat"
+        self.gateway = "local-index"       # the one implemented scheme
+        self.source = topo.source
+        self._hash = None
+
+    def plan_hash(self) -> int:
+        """Canonical 64-bit plan identity: every collective-shaping
+        field feeds a sha256.  Deterministic given the device attributes
+        / env declaration, so a recovery-ladder retry (or a crashed
+        rerun) re-votes the identical hash — the chaos ``--multislice``
+        contract."""
+        if self._hash is None:
+            h = hashlib.sha256()
+            h.update(repr((self.world, self.n_slices,
+                           self.ranks_per_slice, self.route,
+                           self.gateway)).encode())
+            self._hash = int.from_bytes(h.digest()[:8], "big")
+        return self._hash
+
+    def summary(self) -> dict:
+        """The JSON-friendly decision record (bench detail, EXPLAIN)."""
+        return {"route": self.route,
+                "n_slices": int(self.n_slices),
+                "ranks_per_slice": int(self.ranks_per_slice),
+                "gateway": self.gateway,
+                "source": self.source,
+                "plan_hash": format(self.plan_hash(), "016x")}
+
+
+def _plan_for(mesh, declared: int | None, armed: bool) -> TopologyPlan:
+    key = ("plan", _mesh_ident(mesh), declared, armed)
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return hit
+    topo = _topology_for(mesh, declared)
+    hier = (armed and topo.n_slices >= 2 and topo.ranks_per_slice >= 2)
+    plan = _CACHE[key] = TopologyPlan(topo,
+                                      "hierarchical" if hier else "flat")
+    return plan
+
+
+def hier_plan(mesh) -> TopologyPlan | None:
+    """The mesh's voted-route plan when it is hierarchical, else None —
+    the per-exchange route switch (parallel/shuffle.exchange).  Cached:
+    one dict lookup on the hot path.  ``ranks_per_slice == 1`` (every
+    rank its own slice) routes flat: hop 2 would be the full-axis
+    exchange and hop 1 pure overhead."""
+    plan = _plan_for(mesh, declared_slices(), config.TOPO_SHUFFLE)
+    return plan if plan.route == "hierarchical" else None
+
+
+def ensure_adopted(mesh, plan: TopologyPlan) -> None:
+    """Vote the plan's canonical hash over the consensus wire
+    (``Code.TopoPlan``) exactly once per (mesh, plan) — called by the
+    exchange engine BEFORE its first hierarchical collective.  A rank
+    whose slice map diverged raises typed here instead of entering a
+    two-hop exchange its peers route differently.  After the first
+    adoption this is one set lookup."""
+    ident = (_mesh_ident(mesh), plan.plan_hash())
+    if ident in _ADOPTED:
+        return
+    from ..exec.recovery import topo_plan_consensus
+    from ..obs import metrics as _metrics
+    topo_plan_consensus(mesh, plan.plan_hash())
+    _ADOPTED.add(ident)
+    _LAST[0] = plan
+    _metrics.counter("topo_plans_voted").inc()
+
+
+def last_plan() -> TopologyPlan | None:
+    """The most recently voted :class:`TopologyPlan` (None while every
+    exchange has routed flat)."""
+    return _LAST[0]
+
+
+def tier_split(counts: np.ndarray, topo: Topology) -> tuple[int, int]:
+    """(ici_rows, dcn_rows) of one exchange's logical count matrix under
+    ``topo`` — pure host numpy on the replicated sidecar.  Same-slice
+    cells are ICI; cross-slice cells cross DCN exactly once whichever
+    route carried them (the two-hop route changes the WIRE volume and
+    message count, never which rows must cross — docs/topology.md)."""
+    c = np.asarray(counts, np.int64)
+    if topo.n_slices <= 1:
+        return int(c.sum()), 0
+    cross = topo.cross_mask()
+    dcn = int(c[cross].sum())
+    return int(c.sum()) - dcn, dcn

@@ -1,6 +1,6 @@
-"""TPC-H subset: data generator + a 20-query suite on the DataFrame API
-(Q1 Q3 Q4 Q5 Q6 Q7 Q9 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20 Q21
-Q22).
+"""TPC-H subset: data generator + a 21-query suite on the DataFrame API
+(Q1 Q3 Q4 Q5 Q6 Q7 Q8 Q9 Q10 Q11 Q12 Q13 Q14 Q15 Q16 Q17 Q18 Q19 Q20
+Q21 Q22).
 
 The reference validated its relational engine on TPC-xBB / TPC-H-style
 workloads (docs/docs/release/cylon_release_0.4.0.md; BASELINE.md config 4:
@@ -32,7 +32,12 @@ SF10 Q3/Q5 on 8 ranks).  This module provides:
   supplier/customer ⋈ nation×2 on a 25-value nation key, where EVERY
   key is a heavy hitter and the naturally skew-shaped Q18 (lineitem
   groupby-HAVING + 3-way join) gets its EXPLAIN ANALYZE plan recorded
-  in the bench detail beside Q13's;
+  in the bench detail beside Q13's, and — round 15, alongside the
+  multi-slice topology tier — Q8's national market share: seven tables
+  chained through six shuffle-backed joins, the suite's widest
+  cross-slice working set, its EXPLAIN ANALYZE plan recorded in the
+  bench detail as the two-hop route's query-level audit
+  (docs/topology.md);
 * ``q*_pandas`` — the pandas oracles;
 * :func:`bench_tpch` — the ``bench.py --tpch`` entry.
 
@@ -1036,6 +1041,99 @@ def q7_pandas(pdfs: dict, nation1: str = "FRANCE",
 
 
 # ---------------------------------------------------------------------------
+# Q8 — national market share (the suite's widest join: 7 tables + region)
+# ---------------------------------------------------------------------------
+
+def q8(dfs: dict, env=None, nation: str = "BRAZIL",
+       region: str = "AMERICA", ptype: str = "STANDARD PLATED"):
+    """SELECT o_year, sum(case when nation = :nation then volume else 0
+    end) / sum(volume) AS mkt_share FROM (SELECT extract(year FROM
+    o_orderdate) AS o_year, l_extendedprice * (1 - l_discount) AS
+    volume, n2.n_name AS nation FROM part, supplier, lineitem, orders,
+    customer, nation n1, nation n2, region WHERE p_partkey = l_partkey
+    AND s_suppkey = l_suppkey AND l_orderkey = o_orderkey AND o_custkey
+    = c_custkey AND c_nationkey = n1.n_nationkey AND n1.n_regionkey =
+    r_regionkey AND r_name = :region AND s_nationkey = n2.n_nationkey
+    AND o_orderdate BETWEEN date '1995-01-01' AND date '1996-12-31' AND
+    p_type = :ptype) all_nations GROUP BY o_year ORDER BY o_year.
+
+    Round 15, the multi-slice topology tier's TPC-H exerciser
+    (docs/topology.md): seven tables (part, supplier, lineitem, orders,
+    customer, nation ×2, region) chained through SIX shuffle-backed
+    joins — the widest cross-slice working set in the suite, every hop
+    of which must stay bit-equal whichever route (flat vs two-hop)
+    carries its exchanges.  ``extract(year)`` rides the generator's
+    derived ``o_orderyear`` int column and ``p_type = :ptype`` the
+    closed vocabulary (the same documented simplifications as Q9/Q7);
+    the conditional numerator is the Q14 flag-times-value pattern."""
+    p = dfs["part"][["p_partkey", "p_type"]]
+    p = p[p["p_type"] == ptype]
+    o = dfs["orders"][["o_orderkey", "o_custkey", "o_orderdate",
+                       "o_orderyear"]]
+    o = o[(o["o_orderdate"] >= _ts("1995-01-01"))
+          & (o["o_orderdate"] <= _ts("1996-12-31"))]
+    reg = dfs["region"]
+    reg = reg[reg["r_name"] == region]
+    n1 = dfs["nation"][["n_nationkey", "n_regionkey"]].merge(
+        reg, left_on="n_regionkey", right_on="r_regionkey", env=env)
+    c = dfs["customer"][["c_custkey", "c_nationkey"]].merge(
+        n1, left_on="c_nationkey", right_on="n_nationkey", env=env)
+    n2 = dfs["nation"][["n_nationkey", "n_name"]]
+    s = dfs["supplier"][["s_suppkey", "s_nationkey"]].merge(
+        n2, left_on="s_nationkey", right_on="n_nationkey", env=env)
+    l = dfs["lineitem"][["l_orderkey", "l_partkey", "l_suppkey",
+                         "l_extendedprice", "l_discount"]]
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey", env=env)
+    j = j.merge(o, left_on="l_orderkey", right_on="o_orderkey", env=env)
+    j = j.merge(c, left_on="o_custkey", right_on="c_custkey", env=env)
+    j = j.merge(s, left_on="l_suppkey", right_on="s_suppkey", env=env)
+    j["volume"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    is_nation = j["n_name"] == nation
+    j["nation_volume"] = is_nation.astype("float64") * j["volume"]
+    g = (j.groupby(["o_orderyear"], env=env)
+         [["volume", "nation_volume"]].sum())
+    g["mkt_share"] = g["nation_volume"] / g["volume"]
+    out = g.sort_values("o_orderyear", env=env)
+    return out[["o_orderyear", "mkt_share"]]
+
+
+def q8_pandas(pdfs: dict, nation: str = "BRAZIL",
+              region: str = "AMERICA",
+              ptype: str = "STANDARD PLATED") -> pd.DataFrame:
+    p = pdfs["part"][["p_partkey", "p_type"]]
+    p = p[p.p_type == ptype]
+    o = pdfs["orders"][["o_orderkey", "o_custkey", "o_orderdate",
+                        "o_orderyear"]]
+    o = o[(o.o_orderdate >= pd.Timestamp("1995-01-01"))
+          & (o.o_orderdate <= pd.Timestamp("1996-12-31"))]
+    reg = pdfs["region"]
+    reg = reg[reg.r_name == region]
+    n1 = pdfs["nation"][["n_nationkey", "n_regionkey"]].merge(
+        reg, left_on="n_regionkey", right_on="r_regionkey")
+    c = pdfs["customer"][["c_custkey", "c_nationkey"]].merge(
+        n1, left_on="c_nationkey", right_on="n_nationkey")
+    s = pdfs["supplier"][["s_suppkey", "s_nationkey"]].merge(
+        pdfs["nation"][["n_nationkey", "n_name"]],
+        left_on="s_nationkey", right_on="n_nationkey")
+    l = pdfs["lineitem"][["l_orderkey", "l_partkey", "l_suppkey",
+                          "l_extendedprice", "l_discount"]]
+    j = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey")
+         .merge(s, left_on="l_suppkey", right_on="s_suppkey"))
+    j = j.copy()
+    j["volume"] = j.l_extendedprice * (1.0 - j.l_discount)
+    j["nation_volume"] = (j.n_name == nation).astype(np.float64) \
+        * j["volume"]
+    g = (j.groupby("o_orderyear", as_index=False)
+         .agg(volume=("volume", "sum"),
+              nation_volume=("nation_volume", "sum")))
+    g["mkt_share"] = g.nation_volume / g.volume
+    return (g.sort_values("o_orderyear").reset_index(drop=True)
+            [["o_orderyear", "mkt_share"]])
+
+
+# ---------------------------------------------------------------------------
 # Q22 — global sales opportunity (ANTI join vs orders)
 # ---------------------------------------------------------------------------
 
@@ -1375,7 +1473,8 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
         return min(ts)
 
     queries = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6,
-               "q7": q7, "q9": q9, "q10": q10, "q11": q11, "q12": q12,
+               "q7": q7, "q8": q8, "q9": q9, "q10": q10, "q11": q11,
+               "q12": q12,
                "q13": q13, "q14": q14, "q15": q15, "q16": q16,
                "q17": q17, "q18": q18, "q19": q19, "q20": q20,
                "q21": q21, "q22": q22}
@@ -1391,6 +1490,13 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
     q13_plan = obs.explain_analyze(lambda: q13(dfs, env=env).to_pandas())
     q18_plan = obs.explain_analyze(
         lambda: q18(dfs, env=env, quantity=150).to_pandas())
+    # round 15 adds Q8 beside them — the seven-table national market
+    # share, the suite's widest cross-slice working set: its plan tree
+    # carries every join's exchange totals (and, with the comm matrix
+    # armed on a multi-slice topology, the ICI/DCN tier split) so the
+    # two-hop route's effect on a real query is auditable from the
+    # same JSON (docs/topology.md)
+    q8_plan = obs.explain_analyze(lambda: q8(dfs, env=env).to_pandas())
     return {
         "metric": f"TPC-H SF{scale:g} {'+'.join(q.upper() for q in queries)}"
                   " wall time",
@@ -1425,6 +1531,7 @@ def _bench_tpch_once(scale: float, iters: int) -> dict:
                    # skew route decision when a plan armed (docs/skew.md)
                    "q13_plan": q13_plan.to_dict(),
                    "q18_plan": q18_plan.to_dict(),
+                   "q8_plan": q8_plan.to_dict(),
                    **{f"{n}_s": round(t, 4) for n, t in times.items()}},
     }
 

@@ -71,6 +71,23 @@ via typed ResumableAbort — exit 17, not a signal death — within the
 grace budget).  Every schedule must end bit-equal to the uninterrupted
 world=2 baseline.
 
+``--multislice`` switches to the MULTI-SLICE TOPOLOGY acceptance flow
+(cylon_tpu/topo, docs/topology.md): a join+groupby workload on a
+simulated two-tier grid (``CYLON_TPU_SLICES=2`` over a world-4 CPU
+mesh) whose FLAT-routed run (``CYLON_TPU_TOPO_SHUFFLE=0``) is the
+bit-equality oracle.  Pinned schedules: the armed happy path (a voted
+topology plan, bit-equal, cross-slice DCN messages at ~1/R of the flat
+plan's); a capacity fault inside the hierarchical exchange (the ladder
+retries and must re-adopt the IDENTICAL voted plan hash — topology
+derivation is deterministic); SIGKILL of one WHOLE SLICE mid-run
+(simulated as a hard kill of the checkpointed two-stage elastic
+workload at world=4/slices=2, resumed on the surviving world=2 single
+slice — the PR 9 elastic re-shard must fast-forward stage 1 bit-equal
+and the resumed topology re-votes); and the unarmed single-slice
+contract leg: with no slice declaration the ARMED route must vote
+nothing and move exactly the flat run's exchange rows and exchange
+count — zero extra collectives, zero host syncs.
+
 ``--skew`` switches to the ADAPTIVE-SKEW-SPLIT acceptance flow
 (docs/skew.md): a monolithic skewed-key join+groupby (one hot key on
 ~80% of probe rows) whose unsplit run (``CYLON_TPU_SKEW_SPLIT=0``) is
@@ -91,6 +108,7 @@ Usage::
     python scripts/chaos_soak.py --elastic --rows 1500 --chunks 3
     python scripts/chaos_soak.py --oocore --rows 2000 --chunks 3
     python scripts/chaos_soak.py --skew --rows 4000
+    python scripts/chaos_soak.py --multislice --rows 3000
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
 runs in CI as a slow-marked test (tests/test_checkpoint.py); the
@@ -192,6 +210,9 @@ def worker(args) -> int:
 
     if args.skew:
         return _worker_skew(args, env)
+
+    if args.multislice:
+        return _worker_topo(args, env)
 
     if args.concurrent > 1:
         return _worker_concurrent(args, env, make_workload)
@@ -381,6 +402,193 @@ def _worker_skew(args, env) -> int:
         "exchange_rows": int(metrics.counter("exchange_rows_total").value),
     }), flush=True)
     return 0
+
+
+def _worker_topo(args, env) -> int:
+    """The multi-slice topology acceptance workload (docs/topology.md):
+    a monolithic join + groupby-sum whose route — flat vs hierarchical
+    two-hop — is controlled by CYLON_TPU_SLICES / CYLON_TPU_TOPO_SHUFFLE
+    in the environment.  The JSON line reports the result sha, the
+    voted topology plan hash (None when every exchange routed flat),
+    the always-on exchange counters (the zero-extra-collectives
+    evidence) and the per-tier DCN message/wire counters (the ~1/R
+    cross-slice instrument)."""
+    import numpy as np
+
+    import cylon_tpu as ct
+    from cylon_tpu.exec import recovery
+    from cylon_tpu.obs import metrics
+    from cylon_tpu.relational import groupby_aggregate, join_tables
+    from cylon_tpu.topo import model as topo_model
+
+    rng = np.random.default_rng(20260806)
+    n = max(args.rows, 2048)
+    mv = max(int(n * 0.9), 8)
+    lt = ct.Table.from_pydict(
+        {"k": rng.integers(0, mv, n).astype(np.int64),
+         "a": rng.integers(0, mv, n).astype(np.int64)}, env)
+    rt = ct.Table.from_pydict(
+        {"k": rng.integers(0, mv, n).astype(np.int64),
+         "b": rng.integers(0, mv, n).astype(np.int64)}, env)
+    j = join_tables(lt, rt, "k", "k", how="inner")
+    out = groupby_aggregate(j, "k", [("a", "sum"), ("b", "sum")])
+    plan = topo_model.last_plan()
+    df = out.to_pandas().sort_values("k").reset_index(drop=True)
+    print(json.dumps({
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
+        "events": len(recovery.recovery_events()),
+        "event_list": recovery.recovery_events(),
+        "topo_plan_hash": (format(plan.plan_hash(), "016x")
+                           if plan is not None else None),
+        "topo_plans_voted": int(
+            metrics.counter("topo_plans_voted").value),
+        "exchange_rows": int(metrics.counter("exchange_rows_total").value),
+        "exchange_count": int(metrics.counter("exchange_count").value),
+        "dcn_rows": int(metrics.counter("exchange_dcn_rows_total").value),
+        "dcn_messages": int(
+            metrics.counter("exchange_dcn_messages_total").value),
+        "dcn_wire_bytes": int(
+            metrics.counter("exchange_dcn_wire_bytes_total").value),
+    }), flush=True)
+    return 0
+
+
+def run_multislice(args) -> int:
+    """The ``--multislice`` acceptance flow (pinned, not drawn) — see
+    the module docstring.  Simulated two-tier grid: world 4, 2 slices
+    of 2 (``CYLON_TPU_SLICES=2``); R = ranks per slice = 2."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_topo_")
+    failures: list = []
+    r_per_slice = 2
+
+    def spawn(tag, faults, slices=2, armed=True, extra=None):
+        workdir = os.path.join(args.workdir, tag)
+        env_extra = {"CYLON_TPU_TOPO_SHUFFLE": "1" if armed else "0"}
+        if slices:
+            env_extra["CYLON_TPU_SLICES"] = str(slices)
+        env_extra.update(extra or {})
+        return _spawn(args, workdir, faults, resume=False,
+                      extra_env=env_extra, multislice=True, world=4)
+
+    # flat-routed baseline on the two-tier grid: the bit-equality
+    # oracle AND the cross-slice traffic yardstick
+    p, base = spawn("base", "", armed=False)
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: multislice baseline failed", file=sys.stderr)
+        return 1
+    print(f"# topo flat baseline sha={base['sha'][:16]} "
+          f"dcn_messages={base['dcn_messages']}", flush=True)
+    if base.get("topo_plans_voted"):
+        failures.append(f"flat-routed run voted a topology plan: {base}")
+
+    # armed happy path: voted plan, bit-equal, DCN messages ~1/R
+    p, info = spawn("hier", "")
+    plan0 = (info or {}).get("topo_plan_hash")
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"hierarchical run diverged (rc={p.returncode}): "
+                        f"{info}\n{(p.stdout + p.stderr)[-2000:]}")
+    elif not plan0 or not info.get("topo_plans_voted"):
+        failures.append(f"hierarchical run never voted a plan: {info}")
+    elif info.get("dcn_rows") != base.get("dcn_rows"):
+        failures.append(
+            f"cross-slice PAYLOAD changed (must be route-invariant): "
+            f"{info.get('dcn_rows')} != {base.get('dcn_rows')}")
+    elif info["dcn_messages"] * r_per_slice > base["dcn_messages"] * 1.2:
+        failures.append(
+            f"DCN message count not reduced ~1/R: hier="
+            f"{info['dcn_messages']} flat={base['dcn_messages']} R=2")
+    else:
+        print(f"# topo hier -> ok (plan={plan0} dcn_messages="
+              f"{info['dcn_messages']} vs flat {base['dcn_messages']})",
+              flush=True)
+
+    # capacity fault INSIDE the hierarchical exchange (the receive
+    # guard probes before phase B dispatch): the ladder's retry must
+    # re-adopt the IDENTICAL voted topology plan before going bit-equal
+    p, info = spawn("capacity", "shuffle.recv_guard::1=capacity",
+                    extra={"CYLON_TPU_EXCHANGE_GUARD_CPU": "1"})
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"capacity-fault leg diverged (rc={p.returncode}):"
+                        f" {info}\n{(p.stdout + p.stderr)[-2000:]}")
+    elif info.get("topo_plan_hash") != plan0:
+        failures.append(f"capacity-fault recovery adopted a DIFFERENT "
+                        f"topology plan: {info.get('topo_plan_hash')} != "
+                        f"{plan0}")
+    elif not info.get("events") or info["events"] > MAX_RECOVERY_EVENTS:
+        failures.append(f"capacity-fault leg events out of range: {info}")
+    else:
+        print("# topo capacity fault -> ok (same voted plan, bit-equal)",
+              flush=True)
+
+    # whole-slice loss → elastic resume: the checkpointed two-stage
+    # elastic workload runs at world=4/slices=2, a SIGKILL mid-stage-2
+    # takes the process (and with it both slices) down, and the resume
+    # runs on the SURVIVING world=2 single slice — the PR 9 re-shard
+    # must fast-forward stage 1 bit-equal while stage 2 recomputes
+    k1 = args.chunks + 1
+    two_tier = {"CYLON_TPU_SLICES": "2"}
+    one_tier = {"CYLON_TPU_SLICES": "1"}
+    p, ebase = _spawn(args, os.path.join(args.workdir, "ebase"), "",
+                      resume=False, elastic=True, world=4,
+                      extra_env=two_tier)
+    if p.returncode != 0 or not ebase or not ebase.get("sha"):
+        failures.append(f"elastic two-tier baseline failed "
+                        f"(rc={p.returncode}): "
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    else:
+        dK = os.path.join(args.workdir, "slicekill")
+        p1, _ = _spawn(args, dK, f"ckpt.write::{k1}=kill", resume=False,
+                       elastic=True, world=4, extra_env=two_tier)
+        if p1.returncode != -9:
+            failures.append(f"whole-slice kill did not crash "
+                            f"(rc={p1.returncode})")
+        else:
+            p2, info2 = _spawn(args, dK, "", resume=True, elastic=True,
+                               world=2, extra_env=one_tier)
+            if p2.returncode != 0 or not info2 \
+                    or info2.get("sha") != ebase["sha"]:
+                failures.append(
+                    f"slice-loss resume diverged (rc={p2.returncode}): "
+                    f"{info2}\n{(p2.stdout + p2.stderr)[-2000:]}")
+            elif not info2.get("resume_resharded_pieces") \
+                    or not info2.get("resume_world_mismatch"):
+                failures.append(f"slice loss did not re-shard: {info2}")
+            else:
+                print(f"# topo slice-kill + elastic resume -> ok "
+                      f"(resharded={info2['resume_resharded_pieces']} "
+                      f"ffwd={info2['resume_fast_forwarded_pieces']})",
+                      flush=True)
+
+    # unarmed single-slice contract: with NO slice declaration the
+    # ARMED route must vote nothing and run the byte-identical flat
+    # engine — same sha, same exchange rows, same exchange count (zero
+    # extra collectives, zero host syncs)
+    p, flat0 = spawn("single_unarmed", "", slices=0, armed=False)
+    p2, flat1 = spawn("single_armed", "", slices=0, armed=True)
+    if p.returncode != 0 or p2.returncode != 0 or not flat0 or not flat1:
+        failures.append(f"single-slice legs failed (rc={p.returncode}/"
+                        f"{p2.returncode}): {flat0} {flat1}")
+    elif flat1.get("sha") != flat0.get("sha"):
+        failures.append(f"armed-on-single-slice diverged: {flat1}")
+    elif flat1.get("topo_plan_hash") is not None \
+            or flat1.get("topo_plans_voted"):
+        failures.append(f"armed-on-single-slice voted a plan: {flat1}")
+    elif (flat1.get("exchange_rows") != flat0.get("exchange_rows")
+          or flat1.get("exchange_count") != flat0.get("exchange_count")):
+        failures.append(
+            f"armed-on-single-slice moved different exchange traffic: "
+            f"{flat1} != {flat0}")
+    else:
+        print("# topo unarmed single-slice -> ok (no vote, identical "
+              "exchange counters)", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"multislice": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
 
 
 def run_skew(args) -> int:
@@ -921,14 +1129,17 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
            extra_env: dict | None = None, concurrent: int = 1,
            only: int | None = None, stream: bool = False,
            elastic: bool = False, world: int | None = None,
-           skew: bool = False, skew_frac: float = 0.8) -> tuple:
+           skew: bool = False, skew_frac: float = 0.8,
+           multislice: bool = False) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
-    # the out-of-core caps are armed per-leg too (extra_env) — an
-    # inherited budget would cap the baseline legs
+    # the out-of-core caps and the topology declaration are armed
+    # per-leg too (extra_env) — an inherited budget/slice map would
+    # cap or re-route the baseline legs
     for k in ("CYLON_TPU_HBM_BUDGET", "CYLON_TPU_HOST_BUDGET",
-              "CYLON_TPU_SPILL_DIR"):
+              "CYLON_TPU_SPILL_DIR", "CYLON_TPU_SLICES",
+              "CYLON_TPU_TOPO_SHUFFLE"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -954,6 +1165,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
         cmd.append("--elastic")
     if skew:
         cmd += ["--skew", f"--skew-frac={skew_frac}"]
+    if multislice:
+        cmd.append("--multislice")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -1115,6 +1328,13 @@ def main() -> int:
                          "must add zero collectives)")
     ap.add_argument("--skew-frac", type=float, default=0.8,
                     help="(worker) fraction of probe rows on the hot key")
+    ap.add_argument("--multislice", action="store_true",
+                    help="run the multi-slice topology acceptance flow "
+                         "(simulated two-tier grid: hierarchical route "
+                         "bit-equal to flat with a voted plan and ~1/R "
+                         "DCN messages; whole-slice kill resumes via "
+                         "elastic reshard; unarmed single-slice leg "
+                         "adds zero collectives)")
     ap.add_argument("--world", type=int, default=4,
                     help="(worker) mesh world size for this process")
     args = ap.parse_args()
@@ -1128,6 +1348,9 @@ def main() -> int:
 
     if args.skew:
         return run_skew(args)
+
+    if args.multislice:
+        return run_multislice(args)
 
     if args.stream:
         return run_stream(args)

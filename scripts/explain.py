@@ -15,6 +15,10 @@ The diff aligns the two trees positionally, flags structural divergence
 — route flips, chunk-count changes), and reports per-node deltas of
 self seconds, rows and exchanged bytes for structurally matching nodes
 — how "the same query got slower" decomposes into "which operator".
+Runs whose comm matrix carries the multi-slice TIER split
+(cylon_tpu/topo, docs/topology.md) additionally render/diff the
+ICI/DCN payload, padded wire and message totals — the flat ↔ two-hop
+route comparison instrument.
 """
 
 from __future__ import annotations
@@ -84,6 +88,51 @@ def _why_skew(path: str, hh: dict | None, plan: dict | None) -> str:
     return "\n    ".join(bits)
 
 
+def _tier_lines(plan: dict, prefix: str = "") -> list[str]:
+    """The comm matrix's ICI/DCN tier split (cylon_tpu/topo — armed
+    multi-slice runs embed it at comm_matrix.tiers), rendered as the
+    per-tier payload/wire/message summary docs/topology.md reads."""
+    t = (plan.get("comm_matrix") or {}).get("tiers")
+    if not t:
+        return []
+    return [f"{prefix}tiers ({t['n_slices']} slices, routes "
+            f"{t.get('routes')}):",
+            f"{prefix}  ici: rows={t['ici_rows']:,} "
+            f"bytes={t['ici_bytes']:,} wire={t['ici_wire_bytes']:,} "
+            f"messages={t['ici_messages']:,}",
+            f"{prefix}  dcn: rows={t['dcn_rows']:,} "
+            f"bytes={t['dcn_bytes']:,} wire={t['dcn_wire_bytes']:,} "
+            f"messages={t['dcn_messages']:,}"]
+
+
+def _diff_tiers(a: dict, b: dict) -> list[str]:
+    """Tier-split delta between two runs — how a route change (flat ↔
+    two-hop) moved the cross-slice traffic: payload rows are
+    route-invariant, so the load-bearing deltas are the DCN message
+    count (~1/R under the two-hop route) and the padded wire bytes."""
+    ta = (a.get("comm_matrix") or {}).get("tiers")
+    tb = (b.get("comm_matrix") or {}).get("tiers")
+    if not ta and not tb:
+        return []
+    if not ta or not tb:
+        have = "B" if tb else "A"
+        return [f"! comm tier split present only in {have} "
+                "(single-slice vs multi-slice topology)"]
+    lines = []
+    for k, label in (("dcn_messages", "DCN messages"),
+                     ("dcn_wire_bytes", "DCN wire bytes"),
+                     ("dcn_rows", "DCN payload rows"),
+                     ("ici_wire_bytes", "ICI wire bytes")):
+        va, vb = ta.get(k, 0), tb.get(k, 0)
+        if va != vb:
+            ratio = f" ({vb / va:.3f}x)" if va else ""
+            lines.append(f"! tier {label}: {va:,} -> {vb:,}{ratio}")
+    if ta.get("routes") != tb.get("routes"):
+        lines.append(f"! tier routes: {ta.get('routes')} -> "
+                     f"{tb.get('routes')}")
+    return lines
+
+
 def diff_plans(a: dict, b: dict) -> str:
     """Human-readable diff of two plan payloads (see module docstring)."""
     fa = [p for r in a.get("roots", ()) for p in _flatten(r)]
@@ -124,6 +173,7 @@ def diff_plans(a: dict, b: dict) -> str:
                     (vb - va) if isinstance(va, (int, float)) else 0))
         if deltas:
             lines.append(f"  {pa}: " + ", ".join(deltas))
+    lines.extend(_diff_tiers(a, b))
     ra, rb = a.get("reconcile"), b.get("reconcile")
     if ra and rb:
         lines.append(f"total: {ra['phase_s']}s -> {rb['phase_s']}s "
@@ -138,6 +188,8 @@ def main(argv: list[str]) -> int:
     a = load_plan(argv[1])
     if len(argv) == 2:
         print(render_tree(a))
+        for line in _tier_lines(a):
+            print(line)
         return 0
     b = load_plan(argv[2])
     print(diff_plans(a, b))

@@ -1,0 +1,44 @@
+"""TS116 fixture: topology decisions outside the cylon_tpu/topo plan
+facade — slice-map construction, tier/gateway assignment and the
+``Code.TopoPlan`` vote must run through topology/hier_plan/
+ensure_adopted/two_hop so every rank routes ONE voted hop plan."""
+
+import numpy as np
+
+
+def my_tier_map(mesh, counts, TopologyPlan, topo_plan_consensus,
+                hop_counts, topo):
+    # flagged: ad-hoc plan construction outside the facade — skips the
+    # canonical hash and the pre-collective vote
+    plan = TopologyPlan(topo, "hierarchical")
+    # flagged: the gateway-scheme primitive called directly
+    c1, c2 = hop_counts(counts, 2)
+    # flagged: a direct vote out of sequence
+    topo_plan_consensus(mesh, 42)
+    return plan, c1, c2
+
+
+def my_gateway(dest, topomod):
+    # flagged: tier/gateway assignment outside the facade
+    return topomod.gateway_of(dest, 0, 4)
+
+
+def my_rebalance(plan):
+    # flagged: post-vote tier-map mutation — desyncs the voted hash and
+    # the grouped collectives' membership
+    plan.n_slices = 4
+    # flagged: route flip after adoption, same hazard
+    plan.route = "flat"
+    return plan
+
+
+def fine_route(mesh, env, topomod, exchange_mod, tgt, counts, cols):
+    # NOT flagged: the sanctioned facade sequence
+    hplan = topomod.hier_plan(mesh)
+    if hplan is not None:
+        topomod.ensure_adopted(mesh, hplan)
+        return exchange_mod.two_hop(mesh, hplan, tgt, counts, cols, 8)
+    t = topomod.topology(mesh)
+    # NOT flagged: plain field reads and non-plan attribute assigns
+    n = t.n_slices + np.int64(0)
+    return n

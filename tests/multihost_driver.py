@@ -386,6 +386,46 @@ print(f"SKEWPLAN_OK pid={pid} keys={len(plan)} "
       f"fanout={[int(f) for f in plan.fanout]} "
       f"hash={format(plan.plan_hash(), '016x')}", flush=True)
 
+# Multi-slice topology plan coherence (cylon_tpu/topo, docs/
+# topology.md): declare a two-slice fabric over the 8-rank world — the
+# process boundary IS the simulated DCN tier (4 local devices per
+# process, slice-major) — and re-run join + groupby + sort through the
+# hierarchical two-hop route.  The Code.TopoPlan vote rides the REAL
+# cross-process pmax wire here; every rank must adopt the IDENTICAL
+# plan hash (allgathered crc), the two-hop results must be bit- and
+# order-equal to the flat route's, and the armed comm report's tier
+# split must reconcile (ici + dcn == totals) byte-identically across
+# ranks (the report's own allgather covers the tier fields).
+from cylon_tpu.topo import model as _topo_model
+
+env.barrier()
+os.environ["CYLON_TPU_SLICES"] = "2"
+_topo_model._reslice()
+tj = join_tables(lt, rt, "k", "k", how="inner")
+tg = groupby_aggregate(tj, "k", [("a", "sum"), ("b", "mean")])
+ts_ = sort_table(tg, "k")
+topo_got = ts_.to_pandas().reset_index(drop=True)
+tplan = _topo_model.last_plan()
+assert tplan is not None, "two-hop route never voted a topology plan"
+assert tplan.route == "hierarchical", tplan.summary()
+tp_sig = np.int64(zlib.crc32(format(tplan.plan_hash(), "016x").encode()))
+tp_sigs = np.atleast_1d(multihost_utils.process_allgather(tp_sig))
+assert len({int(s) for s in tp_sigs}) == 1, (tplan.summary(), tp_sigs)
+pd.testing.assert_frame_equal(topo_got, got, check_dtype=False)
+_comm.arm()
+_comm.reset()
+join_tables(lt, rt, "k", "k", how="inner")
+trep = _comm.report()   # allgathers + verifies (tier fields included)
+_comm.arm(False)
+tt = trep["tiers"]
+assert tt["ici_rows"] + tt["dcn_rows"] == trep["total_rows"], tt
+assert tt["routes"].get("two_hop"), tt
+_comm.reset()
+del os.environ["CYLON_TPU_SLICES"]
+_topo_model._reslice()
+print(f"TOPO_OK pid={pid} plan={format(tplan.plan_hash(), '016x')} "
+      f"dcn_messages={tt['dcn_messages']}", flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

@@ -178,3 +178,6 @@ def test_multi_process_join_groupby_sort(nproc):
         # hash (the driver allgathers the hash crc and bit-checks the
         # stitched + fused outputs against the unsplit plan)
         assert f"SKEWPLAN_OK pid={i} keys=" in out, out[-2000:]
+        # the two-hop topology leg: identical voted plan hash on every
+        # rank + bit/order-equal to the flat route (asserted in-driver)
+        assert f"TOPO_OK pid={i} plan=" in out, out[-2000:]

@@ -242,6 +242,23 @@ def test_q7_matches_pandas(env):
                                   check_exact=False, rtol=1e-9)
 
 
+def test_q8_matches_pandas(env):
+    """Q8 (round 15, the multi-slice topology tier's TPC-H exerciser):
+    national market share — seven tables chained through six
+    shuffle-backed joins, the suite's widest cross-slice working set —
+    bit-checked against the pandas oracle at env1/env4 (docs/
+    topology.md; the two-tier-route equality legs live in
+    tests/test_topo.py)."""
+    import cylon_tpu as ct
+    pdfs = tpch.generate_pandas(scale=0.004, seed=8)
+    dfs = {k: ct.DataFrame(v, env=env) for k, v in pdfs.items()}
+    got = tpch.q8(dfs, env=env).to_pandas().reset_index(drop=True)
+    exp = tpch.q8_pandas(pdfs)
+    assert len(got) == len(exp) > 0
+    pd.testing.assert_frame_equal(got, exp[got.columns], check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
 def test_q7_generator_year_column_is_derived():
     """l_shipyear consumes no RNG draws: every pre-round-14 column
     stays byte-identical (the regression-baseline rule)."""

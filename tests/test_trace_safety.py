@@ -462,12 +462,50 @@ def test_ts115_scoping():
         "cylon_tpu/relational/join.py", clean))
 
 
+def test_ts116_topo_plan_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "bad_topo_plan.py")) if f.rule == "TS116"]
+    # TopologyPlan ctor, hop_counts, direct vote, gateway_of, n_slices +
+    # route mutations — the facade sequence and plain reads stay clean
+    assert len(found) == 6, found
+    assert all("cylon_tpu/topo" in f.message for f in found)
+
+
+def test_ts116_scoping():
+    call = ("def f(mesh, topomod):\n"
+            "    return topomod.topo_plan_consensus(mesh, 42)\n")
+    tier = "def f(plan):\n    plan.route = 'flat'\n"
+    # fires anywhere outside the facade — operator AND transport dirs
+    for src in (call, tier):
+        assert any(f.rule == "TS116" for f in ast_lint.lint_source(
+            "cylon_tpu/parallel/shuffle.py", src))
+        assert any(f.rule == "TS116" for f in ast_lint.lint_source(
+            "cylon_tpu/exec/pipeline.py", src))
+    # the defining package is exempt by construction (qualified pair:
+    # a workspace dir merely named "topo" is NOT exempt)
+    for src in (call, tier):
+        assert not any(f.rule == "TS116" for f in ast_lint.lint_source(
+            "cylon_tpu/topo/model.py", src))
+        assert any(f.rule == "TS116" for f in ast_lint.lint_source(
+            "topo/something.py", src))
+    # facade-entry calls, plain field reads and non-plan attribute
+    # assigns stay clean
+    clean = ("def f(mesh, topomod, span):\n"
+             "    hp = topomod.hier_plan(mesh)\n"
+             "    topomod.ensure_adopted(mesh, hp)\n"
+             "    n = hp.n_slices\n"
+             "    span.route = 'x'\n"
+             "    return n\n")
+    assert not any(f.rule == "TS116" for f in ast_lint.lint_source(
+        "cylon_tpu/parallel/shuffle.py", clean))
+
+
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
                                        "TS109", "TS110", "TS111", "TS112",
-                                       "TS113", "TS114", "TS115"}
+                                       "TS113", "TS114", "TS115", "TS116"}
 
 
 # ---------------------------------------------------------------------------
