@@ -2,19 +2,24 @@
 
 The correctness-tooling layer for the shard_map/XLA-collective operator
 stack — the role sanitizers and MPI race detectors play in the C++
-reference.  Three cooperating passes:
+reference.  Four cooperating passes:
 
 1. **AST lint** (:mod:`.ast_lint`, rules TS1xx) — source-level hazards
    over the whole package: host syncs and tracer control flow inside
    traced bodies, jit wrappers missing static_argnums, Mesh-pinning
    lru_cache builders;
-2. **jaxpr verification** (:mod:`.jaxpr_check`, rules JX2xx) — each
+2. **collective coherence** (:mod:`.coherence`, rules CX4xx) —
+   interprocedural call-graph + taint/dominance pass: rank-local
+   control flow between collectives, path-dependent collective
+   sequences, plan-vote dominance (skew/topo/ckpt/drain), untyped
+   post-collective raises;
+3. **jaxpr verification** (:mod:`.jaxpr_check`, rules JX2xx) — each
    registered program builder (:mod:`.registry`) is traced abstractly
    and its jaxpr checked for SPMD invariants: collectives appear
    unconditionally (never under cond / data-dependent while), the
    collective set matches the declaration, no row-scale int32→int64
    widening, host callbacks within budget;
-3. **runtime sentinel** (:mod:`.runtime`, rules RT3xx) — compile and
+4. **runtime sentinel** (:mod:`.runtime`, rules RT3xx) — compile and
    host-transfer counters wired into test sessions
    (``CYLON_TPU_TRACECHECK=1``) that fail on budget overruns.
 
@@ -24,5 +29,6 @@ Docs: ``docs/trace_safety.md`` (rule catalog + suppression syntax).
 
 from .rules import RULES, Finding  # noqa: F401
 from .ast_lint import lint_file, lint_paths, lint_source  # noqa: F401
+from .coherence import analyze_files, analyze_paths, analyze_source  # noqa: F401
 from .registry import BuilderDecl, all_declarations, declare_builder  # noqa: F401
 from . import runtime  # noqa: F401

@@ -1191,34 +1191,38 @@ def _mentions_ckpt_path(node: ast.Call) -> bool:
     return False
 
 
-def lint_source(path: str, source: str) -> list[Finding]:
-    if file_suppressed(source):
-        return []
+def lint_source_raw(path: str, source: str):
+    """``(findings, def_spans)`` BEFORE suppression filtering — neither
+    line/def comments nor the file-level ``tracecheck: off`` are
+    applied.  ``def_spans`` is ``[(lineno, end_lineno), ...]`` for every
+    function def, the map needed to apply (or audit) suppressions
+    downstream: the gate's ``--audit-suppressions`` and ``--json``
+    output both need the pre-suppression view."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding("TS101", path, e.lineno or 0,
-                        f"syntax error prevents linting: {e.msg}")]
+                        f"syntax error prevents linting: {e.msg}")], []
     lint = _ModuleLint(path, source, tree)
     raw = lint.run()
+    spans = [(fn.lineno, getattr(fn, "end_lineno", fn.lineno))
+             for fn, _parents in lint.funcs]
+    return raw, spans
+
+
+def enclosing_def_lines(spans, line: int) -> list[int]:
+    """Def-statement lines of every span containing ``line`` (innermost
+    first) — a suppression on a def covers its body."""
+    return sorted((s for s, e in spans if s <= line <= e), reverse=True)
+
+
+def lint_source(path: str, source: str) -> list[Finding]:
+    if file_suppressed(source):
+        return []
+    raw, spans = lint_source_raw(path, source)
     sup = suppressions(source)
-    out = []
-    for f in raw:
-        def_lines = _enclosing_def_lines(lint, f.line)
-        if not is_suppressed(f, sup, def_lines):
-            out.append(f)
-    return out
-
-
-def _enclosing_def_lines(lint: _ModuleLint, line: int) -> list[int]:
-    """Def-statement lines of every function whose span contains ``line``
-    (innermost first) — a suppression on a def covers its body."""
-    spans = []
-    for fn, _parents in lint.funcs:
-        end = getattr(fn, "end_lineno", fn.lineno)
-        if fn.lineno <= line <= end:
-            spans.append(fn.lineno)
-    return sorted(spans, reverse=True)
+    return [f for f in raw
+            if not is_suppressed(f, sup, enclosing_def_lines(spans, f.line))]
 
 
 def lint_file(path: str) -> list[Finding]:

@@ -1,0 +1,1023 @@
+"""Interprocedural collective-coherence analyzer (rule family ``CX4xx``).
+
+Every consensus wire in the runtime — the fault-ladder vote, spill and
+ckpt-commit epochs, the ``Code.SkewPlan``/``Code.TopoPlan`` plan hashes,
+the drain and watermark votes — exists to enforce one discipline:
+
+    *no rank-local control flow decides anything after a collective has
+    been entered, and every plan vote dominates its first dependent
+    collective.*
+
+The TS1xx lint is intra-file and the JX2xx pass is per-builder; the
+hazards that actually bite (a tainted branch between two collectives
+three calls apart) are interprocedural.  This pass closes the gap with a
+deliberately *static, jax-free* approximation:
+
+1. **Call graph.**  Every top-level function and method across the
+   analyzed tree is indexed by leaf name; call edges resolve by leaf.
+   Each function is marked with whether it can *enter a data collective*
+   (all_to_all / all_gather / psum wires that move table bytes) and
+   whether it can *enter a consensus vote* (the ``pmax`` code wires in
+   ``exec/recovery.py``).  Seeds come from three ground truths:
+
+   * the jaxpr registry's builder declarations — ``declare_builder``
+     call sites are harvested **statically** (no jax import) and a
+     builder with a non-empty ``collectives`` set is a data-collective
+     leaf (the pmax consensus wire builders are classed as consensus);
+   * the known collective facades (``parallel/shuffle.exchange``,
+     ``topo/exchange.two_hop``, the ``parallel/collectives`` table ops,
+     ``process_allgather``) so single-file fixtures resolve without the
+     full tree;
+   * direct ``lax.<collective>`` primitive calls in a function body.
+
+   The ``utils/host.py`` pull funnel is excluded from propagation: host
+   pulls are collectives, but marking every operator that reads a count
+   sidecar as "between collectives" would drown the signal (pull
+   traffic is budgeted by JX204/RT303 instead).
+
+2. **Taint.**  Values derived from rank-local sources are tracked
+   through assignments and returns: ``process_index`` /
+   ``jax.process_index``, injector state (``recovery.probe`` /
+   ``maybe_inject`` / ``injected``), caught exceptions (``except X as
+   e``), file IO (``open``, ``os.path`` probes, ``os.listdir`` /
+   ``os.stat``), wall clock (``time.time`` / ``perf_counter`` /
+   ``monotonic``), the SIGTERM latch (``preempt.requested``), and
+   per-rank shapes off host pulls (``len(host_array(...))`` /
+   ``host_array(...).shape`` — the pulled *values* are replicated by
+   construction and stay clean).  A consensus call is a **sanitizer**:
+   its result is rank-coherent by definition, an ``if`` whose test
+   contains one is consensus-guarded, and a consensus vote *inside* a
+   tainted arm is the sanctioned "vote on your local fault" pattern.
+
+3. **Checks.**
+
+   * **CX401** — a tainted ``if``/``while`` whose arms issue no data
+     collectives, positioned after one data collective with another
+     data collective following before any consensus vote.
+   * **CX402** — a tainted branch whose arms issue *different* data
+     collective sequences, or a data collective under a rank-local
+     trip count (tainted ``while`` test / ``for`` iterable).
+   * **CX403** — vote dominance: when a function contains both a plan
+     vote and its dependent collective (skew → ``split_exchange``,
+     topo → ``two_hop``, ckpt-commit → the ``os.replace`` manifest
+     publish, drain → ``drain_abort``), the vote must precede the
+     first dependent *and* sit on every path to it (its enclosing
+     branch chain must be a prefix of the dependent's).  Functions
+     with a dependent but no vote are out of scope for this
+     under-approximation — the interprocedural pairing is covered by
+     the TS115/TS116 facade rules.
+   * **CX404** — an *untyped* raise (not a ``CylonError`` subclass,
+     not ``recovery.make_fault``, not a bare re-raise) from an except
+     handler or a tainted path, after a data collective with no
+     consensus vote in between.
+
+Known under-approximations (deliberate — the gate must stay quiet on
+clean code): taint does not flow through call *arguments* into callee
+parameters (only through returns); dominance treats ``try`` bodies as
+transparent; call edges resolve by leaf name and skip a small set of
+generic object-protocol names.  Suppression uses the shared TS grammar
+(``# tracecheck: off[CX401]``) from :mod:`cylon_tpu.analysis.rules`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .rules import Finding, file_suppressed, is_suppressed, suppressions
+
+# --------------------------------------------------------------------------
+# seeds
+
+#: jax.lax collective primitives that move data between ranks.  A call
+#: like ``lax.all_to_all`` / ``jax.lax.psum`` (the dotted path must
+#: mention ``lax``) marks the enclosing function as data-entering.
+_LAX_COLLECTIVES = frozenset({
+    "all_to_all", "all_gather", "psum", "pmax", "pmin", "pmean",
+    "ppermute", "pshuffle",
+})
+
+#: the pmax consensus wires in exec/recovery.py — rank-coherent votes,
+#: the sanctioned boundary between rank-local state and control flow.
+CONSENSUS_LEAVES = frozenset({
+    "consensus_code", "guard_consensus", "spill_consensus",
+    "drain_consensus", "count_consensus", "ckpt_commit_consensus",
+    "watermark_consensus", "_plan_hash_consensus", "skew_plan_consensus",
+    "topo_plan_consensus", "ckpt_resume_consensus", "_consensus_wire",
+    "_ns_consensus", "_consensus_fn",
+})
+
+#: collective facades resolvable without the full tree (single-file
+#: fixtures, synthetic test modules).  In a whole-tree run these names
+#: also resolve through the call graph; the list just guarantees the
+#: classification is stable either way.
+DATA_FACADE_LEAVES = frozenset({
+    "exchange", "two_hop", "allgather_table", "gather_table",
+    "bcast_table", "allreduce", "process_allgather", "split_exchange",
+})
+
+#: rank-local taint sources, matched on the call's leaf name.
+SOURCE_LEAVES = frozenset({
+    "process_index",                 # jax.process_index / process_index
+    "probe", "maybe_inject", "injected",   # chaos injector state
+    "perf_counter", "monotonic", "time_ns", "process_time",  # wall clock
+    "open", "listdir", "stat", "scandir",  # file IO
+})
+
+#: sources that need a dotted qualifier (the bare leaf is too generic).
+SOURCE_QUALIFIED = frozenset({
+    "time.time", "os.path.exists", "os.path.isfile", "os.path.getsize",
+    "os.path.getmtime", "os.path.islink", "preempt.requested",
+})
+
+#: host-pull funnels: ``len(host_array(...))`` / ``host_array(..).shape``
+#: taints (per-rank shapes), the pulled values themselves do not.
+_HOST_PULL_LEAVES = frozenset({"host_array", "device_get", "host_pull"})
+
+#: leaf names too generic to resolve through the call graph (object
+#: protocol / container noise — resolving ``f.close()`` to a window
+#: sink's collective ``close`` would poison every file handle).
+_GENERIC_LEAVES = frozenset({
+    "close", "flush", "write", "read", "get", "put", "update", "reset",
+    "clear", "copy", "items", "keys", "values", "append", "add", "pop",
+    "extend", "join", "split", "run", "start", "stop", "send", "next",
+})
+
+#: CX403 vote-dominance contract: per plan kind, the vote wires (and
+#: their facades) and the dependent-collective names whose shape the
+#: vote decides.  ``os.replace`` is the ckpt two-phase manifest publish;
+#: a dotted spec must match the full call path at a dot boundary.
+VOTE_KINDS = {
+    "skew": {
+        "votes": frozenset({"skew_plan_consensus", "adopt"}),
+        "deps": frozenset({"split_exchange", "skew_split_targets"}),
+    },
+    "topo": {
+        "votes": frozenset({"topo_plan_consensus", "ensure_adopted"}),
+        "deps": frozenset({"two_hop"}),
+    },
+    "ckpt": {
+        "votes": frozenset({"ckpt_commit_consensus"}),
+        "deps": frozenset({"os.replace"}),
+    },
+    "drain": {
+        "votes": frozenset({"drain_consensus", "drain_requested"}),
+        "deps": frozenset({"drain_abort"}),
+    },
+}
+
+#: fallback typed-status names (kept in sync with cylon_tpu/status.py;
+#: the harvest below extends this with any CylonError subclass found in
+#: the analyzed tree, so single-file runs still recognize the taxonomy).
+DEFAULT_TYPED_STATUS = frozenset({
+    "CylonError", "InvalidError", "PredictedResourceExhausted",
+    "DeviceOOMError", "CapacityOverflowError", "RankDesyncError",
+    "ResumableAbort", "CheckpointCorruptError", "CylonTypeError",
+    "CylonKeyError", "CylonIndexError", "CylonIOError",
+    "NotImplementedCylonError", "ExecutionError",
+})
+
+#: modules whose collectives never propagate to callers: the host-pull
+#: funnel (budgeted by JX204/RT303, would mark every count-sidecar read
+#: as "between collectives") and the rank-report diagnostics (their
+#: allgather fires from watchdog/teardown paths that are rank-local by
+#: design — a straggler report is the point).
+_NO_PROPAGATE_SUFFIXES = (
+    os.path.join("utils", "host.py"),
+    os.path.join("obs", "comm.py"),
+    os.path.join("obs", "rank_report.py"),
+)
+
+#: Python builtins: a *bare* call (``max(a, b)``) is the builtin and
+#: never resolves through the call graph; a dotted call
+#: (``series.max()``) may still resolve to a collective-entering method.
+_PY_BUILTINS = frozenset({
+    "max", "min", "sum", "abs", "len", "sorted", "any", "all", "map",
+    "filter", "round", "hash", "id", "iter", "print", "repr", "str",
+    "int", "float", "bool", "list", "dict", "set", "tuple", "type",
+    "getattr", "setattr", "hasattr", "isinstance", "enumerate", "zip",
+    "range", "format", "divmod",
+})
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of the call target ('' when not a name chain)."""
+    parts = []
+    t = node.func
+    while isinstance(t, ast.Attribute):
+        parts.append(t.attr)
+        t = t.value
+    if isinstance(t, ast.Name):
+        parts.append(t.id)
+    elif not parts:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def _leaf(fname: str) -> str:
+    return fname.rsplit(".", 1)[-1]
+
+
+def _matches_spec(fname: str, spec: str) -> bool:
+    """Dotted specs match at a dot boundary; bare specs match the leaf."""
+    if "." in spec:
+        return fname == spec or fname.endswith("." + spec)
+    return _leaf(fname) == spec
+
+
+def _calls_in(node: ast.AST):
+    """Every ast.Call under ``node``, skipping nested function defs
+    (their bodies execute at their own call sites, not here).  Lambda
+    bodies are included — they are applied in place in this codebase
+    (retry_io thunks, key functions)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n is not node:
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _names_in(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+
+
+def _target_roots(target: ast.AST):
+    """Root names bound by an assignment target (tuple-aware)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _target_roots(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _target_roots(target.value)
+    elif isinstance(target, (ast.Attribute, ast.Subscript)):
+        t = target
+        while isinstance(t, (ast.Attribute, ast.Subscript)):
+            t = t.value
+        if isinstance(t, ast.Name):
+            yield t.id
+
+
+def _is_lax_collective(fname: str) -> bool:
+    parts = fname.split(".")
+    return parts[-1] in _LAX_COLLECTIVES and "lax" in parts[:-1]
+
+
+# --------------------------------------------------------------------------
+# static harvest of declare_builder(...) call sites (jax-free registry
+# ground truth: the same declarations registry.collect() imports)
+
+def _harvest_builders(tree: ast.Module):
+    """Yield ``(builder_leaf, has_collectives)`` for every
+    ``declare_builder(f"{__name__}._foo_fn", ..., collectives={...})``
+    call at module level.  The first argument is an f-string whose
+    literal tail names the builder (``._foo_fn`` or
+    ``._foo_fn[variant]``)."""
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _leaf(_call_name(call)) != "declare_builder" or not call.args:
+            continue
+        name = None
+        first = call.args[0]
+        if isinstance(first, ast.JoinedStr):
+            for part in first.values:
+                if isinstance(part, ast.Constant) \
+                        and isinstance(part.value, str) \
+                        and part.value.startswith("."):
+                    name = part.value[1:].split("[", 1)[0]
+        elif isinstance(first, ast.Constant) and isinstance(first.value, str):
+            name = first.value.rsplit(".", 1)[-1].split("[", 1)[0]
+        if not name:
+            continue
+        has_coll = False
+        for kw in call.keywords:
+            if kw.arg == "collectives":
+                v = kw.value
+                if isinstance(v, (ast.Set, ast.List, ast.Tuple)):
+                    has_coll = bool(v.elts)
+                elif isinstance(v, ast.Call):   # frozenset({...})
+                    has_coll = any(
+                        isinstance(a, (ast.Set, ast.List, ast.Tuple))
+                        and a.elts for a in v.args)
+                else:
+                    has_coll = not (isinstance(v, ast.Constant)
+                                    and not v.value)
+        yield name, has_coll
+
+
+def _harvest_typed_status(trees) -> frozenset[str]:
+    """Typed fault taxonomy: DEFAULT_TYPED_STATUS plus every class in
+    the analyzed tree whose base chain reaches a known typed name."""
+    typed = set(DEFAULT_TYPED_STATUS)
+    classes = []     # (name, base leaf names)
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                bases = set()
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        bases.add(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        bases.add(b.attr)
+                classes.append((node.name, bases))
+    for _ in range(3):   # transitive closure, shallow hierarchies
+        grew = False
+        for name, bases in classes:
+            if name not in typed and bases & typed:
+                typed.add(name)
+                grew = True
+        if not grew:
+            break
+    return frozenset(typed)
+
+
+# --------------------------------------------------------------------------
+# function index + call graph
+
+@dataclass
+class FuncInfo:
+    qualname: str                  # module-relative: Class.method / func
+    path: str
+    node: ast.AST
+    leaf: str = ""
+    calls: frozenset = frozenset()       # leaf names called anywhere in body
+    has_lax: bool = False
+    no_propagate: bool = False
+    enters_data: bool = False
+    enters_consensus: bool = False
+    returns_tainted: bool = False
+
+
+def _index_functions(path: str, tree: ast.Module, no_propagate: bool):
+    """Top-level functions and class methods (nested defs excluded from
+    the callee index — their bodies belong to the enclosing scan)."""
+    out = []
+
+    def add(node, prefix=""):
+        qn = prefix + node.name
+        leaves, has_lax = set(), False
+        for call in _calls_in_body(node):
+            fname = _call_name(call)
+            if not fname:
+                continue
+            leaves.add(_leaf(fname))
+            if _is_lax_collective(fname):
+                has_lax = True
+        out.append(FuncInfo(qualname=qn, path=path, node=node,
+                            leaf=node.name, calls=frozenset(leaves),
+                            has_lax=has_lax, no_propagate=no_propagate))
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add(node)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(sub, prefix=node.name + ".")
+    return out
+
+
+def _calls_in_body(func_node: ast.AST):
+    """Every call in a function INCLUDING nested defs/lambdas — used for
+    call-graph propagation, where a builder's per-shard closure issuing
+    ``lax.psum`` makes the builder itself collective-entering."""
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+# --------------------------------------------------------------------------
+# per-function linear scan
+
+@dataclass
+class _Site:
+    order: int
+    line: int
+    blocks: tuple
+    fname: str
+
+
+@dataclass
+class _BranchCheck:
+    line: int
+    start: int                 # event order at branch entry
+    end: int                   # event order after both arms
+    names: tuple               # tainted names steering the branch
+    kind: str                  # 'if' | 'while' | 'for'
+    arm_seqs: tuple            # (body data seq, orelse data seq)
+    arm_consensus: bool        # either arm votes → sanctioned
+    in_loop_data: bool         # inside a loop whose body enters data
+
+
+@dataclass
+class _RaiseCheck:
+    line: int
+    order: int
+    in_handler: bool
+    on_tainted_path: bool
+    expr_tainted: bool
+    typed: bool
+    bare: bool
+
+
+class _FuncScan:
+    """One linear pass over a function body: taint, entering events in
+    program order, branch/raise candidates, CX403 vote/dep sites."""
+
+    def __init__(self, analyzer, info: FuncInfo):
+        self.an = analyzer
+        self.info = info
+        self.tainted: set[str] = set()
+        self.order = 0
+        self.events: list[tuple[int, str]] = []   # (order, 'data'|'consensus')
+        self.branches: list[_BranchCheck] = []
+        self.raises: list[_RaiseCheck] = []
+        self.votes: dict[str, list[_Site]] = {k: [] for k in VOTE_KINDS}
+        self.deps: dict[str, list[_Site]] = {k: [] for k in VOTE_KINDS}
+        self.returns_tainted = False
+
+    # -- classification ---------------------------------------------------
+
+    def _classify(self, fname: str) -> str | None:
+        return self.an.classify(fname)
+
+    def _record_calls(self, expr, blocks):
+        """Record entering events + vote/dep sites for every call in an
+        expression (lambda bodies included, nested defs skipped)."""
+        if expr is None:
+            return
+        for call in _calls_in(expr):
+            fname = _call_name(call)
+            if not fname:
+                continue
+            kind = self._classify(fname)
+            self.order += 1
+            if kind:
+                self.events.append((self.order, kind))
+            site = _Site(self.order, call.lineno, blocks, fname)
+            for vk, spec in VOTE_KINDS.items():
+                if any(_matches_spec(fname, s) for s in spec["votes"]):
+                    self.votes[vk].append(site)
+                if any(_matches_spec(fname, s) for s in spec["deps"]):
+                    self.deps[vk].append(site)
+
+    # -- taint ------------------------------------------------------------
+
+    def _is_source_call(self, call: ast.Call) -> bool:
+        fname = _call_name(call)
+        if not fname:
+            return False
+        if _leaf(fname) in SOURCE_LEAVES:
+            return True
+        if any(_matches_spec(fname, q) for q in SOURCE_QUALIFIED):
+            return True
+        # returns-taint through the call graph (unambiguous leaves only)
+        return self.an.returns_tainted(fname)
+
+    def _expr_tainted(self, expr) -> bool:
+        if expr is None:
+            return False
+        # a consensus vote anywhere in the expression sanitizes it
+        for call in _calls_in(expr):
+            fname = _call_name(call)
+            if fname and self._classify(fname) == "consensus":
+                return False
+        for name in _names_in(expr):
+            if name in self.tainted:
+                return True
+        for call in _calls_in(expr):
+            if self._is_source_call(call):
+                return True
+            # per-rank shape off a host pull: len(pull(...)) / pull().shape
+            if _leaf(_call_name(call)) == "len" and call.args:
+                if self._has_host_pull(call.args[0]):
+                    return True
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape", "nbytes"):
+                if self._has_host_pull(n.value):
+                    return True
+        return False
+
+    @staticmethod
+    def _has_host_pull(expr) -> bool:
+        return any(_leaf(_call_name(c)) in _HOST_PULL_LEAVES
+                   for c in _calls_in(expr))
+
+    def _assign(self, targets, value):
+        roots = [r for t in targets for r in _target_roots(t)]
+        if value is not None and self._expr_tainted(value):
+            self.tainted.update(roots)
+        else:
+            self.tainted.difference_update(roots)
+
+    # -- arm summaries ----------------------------------------------------
+
+    def _data_seq(self, stmts) -> tuple:
+        """Ordered leaf names of data-entering calls in a block (nested
+        compounds included, nested defs skipped)."""
+        seq = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in _calls_in(stmt):
+                fname = _call_name(call)
+                if fname and self._classify(fname) == "data":
+                    seq.append(_leaf(fname))
+        return tuple(seq)
+
+    def _has_consensus(self, stmts) -> bool:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in _calls_in(stmt):
+                fname = _call_name(call)
+                if fname and self._classify(fname) == "consensus":
+                    return True
+        return False
+
+    def _block_enters_data(self, stmts) -> bool:
+        return bool(self._data_seq(stmts))
+
+    # -- the walk ---------------------------------------------------------
+
+    def run(self):
+        node = self.info.node
+        self._scan(node.body, blocks=(), guard=False, taintpath=False,
+                   in_handler=False, in_loop_data=False)
+        return self
+
+    def _scan(self, stmts, *, blocks, guard, taintpath, in_handler,
+              in_loop_data):
+        for stmt in stmts:
+            self._scan_stmt(stmt, blocks=blocks, guard=guard,
+                            taintpath=taintpath, in_handler=in_handler,
+                            in_loop_data=in_loop_data)
+
+    def _scan_stmt(self, stmt, *, blocks, guard, taintpath, in_handler,
+                   in_loop_data):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._record_calls(stmt.value, blocks)
+            self._assign(stmt.targets, stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._record_calls(stmt.value, blocks)
+            self._assign([stmt.target], stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._record_calls(stmt.value, blocks)
+            if self._expr_tainted(stmt.value):
+                self.tainted.update(_target_roots(stmt.target))
+            return
+        if isinstance(stmt, ast.Expr):
+            self._record_calls(stmt.value, blocks)
+            return
+        if isinstance(stmt, ast.Return):
+            self._record_calls(stmt.value, blocks)
+            if stmt.value is not None and self._expr_tainted(stmt.value):
+                self.returns_tainted = True
+            return
+        if isinstance(stmt, ast.Raise):
+            self._record_calls(stmt.exc, blocks)
+            self._raise(stmt, guard=guard, taintpath=taintpath,
+                        in_handler=in_handler)
+            return
+        if isinstance(stmt, ast.If):
+            self._branch(stmt, stmt.body, stmt.orelse, kind="if",
+                         blocks=blocks, guard=guard, taintpath=taintpath,
+                         in_handler=in_handler, in_loop_data=in_loop_data)
+            return
+        if isinstance(stmt, ast.While):
+            self._branch(stmt, stmt.body, stmt.orelse, kind="while",
+                         blocks=blocks, guard=guard, taintpath=taintpath,
+                         in_handler=in_handler, in_loop_data=in_loop_data)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._for(stmt, blocks=blocks, guard=guard, taintpath=taintpath,
+                      in_handler=in_handler, in_loop_data=in_loop_data)
+            return
+        if isinstance(stmt, ast.Try):
+            self._try(stmt, blocks=blocks, guard=guard, taintpath=taintpath,
+                      in_handler=in_handler, in_loop_data=in_loop_data)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._record_calls(item.context_expr, blocks)
+                if item.optional_vars is not None \
+                        and self._expr_tainted(item.context_expr):
+                    self.tainted.update(_target_roots(item.optional_vars))
+            self._scan(stmt.body, blocks=blocks, guard=guard,
+                       taintpath=taintpath, in_handler=in_handler,
+                       in_loop_data=in_loop_data)
+            return
+        # default: record any calls in child expressions (assert, del, …)
+        self._record_calls(stmt, blocks)
+
+    def _branch(self, stmt, body, orelse, *, kind, blocks, guard,
+                taintpath, in_handler, in_loop_data):
+        self._record_calls(stmt.test, blocks)
+        test_consensus = any(
+            self._classify(_call_name(c)) == "consensus"
+            for c in _calls_in(stmt.test) if _call_name(c))
+        test_tainted = (not test_consensus
+                        and self._expr_tainted(stmt.test))
+        start = self.order
+        arm_guard = guard or test_consensus
+        arm_taint = taintpath or test_tainted
+        body_in_loop = in_loop_data or (
+            kind == "while" and self._block_enters_data(body))
+        frame_base = (id(stmt), kind)
+        self._scan(body, blocks=blocks + ((*frame_base, "body"),),
+                   guard=arm_guard, taintpath=arm_taint,
+                   in_handler=in_handler, in_loop_data=body_in_loop)
+        saved = set(self.tainted)
+        self._scan(orelse, blocks=blocks + ((*frame_base, "else"),),
+                   guard=arm_guard, taintpath=arm_taint,
+                   in_handler=in_handler, in_loop_data=in_loop_data)
+        # merge: a name tainted on either arm stays tainted after the join
+        self.tainted |= saved
+        end = self.order
+        if test_tainted and not guard:
+            names = tuple(sorted(set(_names_in(stmt.test)) & self.tainted))
+            body_seq = self._data_seq(body)
+            else_seq = self._data_seq(orelse)
+            if kind == "while" and body_seq:
+                # rank-local trip count over a data collective
+                self.branches.append(_BranchCheck(
+                    stmt.lineno, start, end, names, kind,
+                    (body_seq, ("<loop-exit>",)), False, in_loop_data))
+            else:
+                self.branches.append(_BranchCheck(
+                    stmt.lineno, start, end, names, kind,
+                    (body_seq, else_seq),
+                    self._has_consensus(body) or self._has_consensus(orelse),
+                    in_loop_data))
+
+    def _for(self, stmt, *, blocks, guard, taintpath, in_handler,
+             in_loop_data):
+        self._record_calls(stmt.iter, blocks)
+        iter_tainted = self._expr_tainted(stmt.iter) and not guard
+        if self._expr_tainted(stmt.iter):
+            self.tainted.update(_target_roots(stmt.target))
+        else:
+            self.tainted.difference_update(_target_roots(stmt.target))
+        start = self.order
+        body_seq = self._data_seq(stmt.body)
+        self._scan(stmt.body, blocks=blocks + ((id(stmt), "for", "body"),),
+                   guard=guard, taintpath=taintpath or iter_tainted,
+                   in_handler=in_handler,
+                   in_loop_data=in_loop_data or bool(body_seq))
+        self._scan(stmt.orelse, blocks=blocks, guard=guard,
+                   taintpath=taintpath, in_handler=in_handler,
+                   in_loop_data=in_loop_data)
+        if iter_tainted and body_seq:
+            names = tuple(sorted(set(_names_in(stmt.iter)) & self.tainted))
+            self.branches.append(_BranchCheck(
+                stmt.lineno, start, self.order, names, "for",
+                (body_seq, ("<loop-exit>",)), False, in_loop_data))
+
+    def _try(self, stmt, *, blocks, guard, taintpath, in_handler,
+             in_loop_data):
+        # try body is transparent (executes unconditionally up to a
+        # fault); handlers are branches and taint their bound name
+        self._scan(stmt.body, blocks=blocks, guard=guard,
+                   taintpath=taintpath, in_handler=in_handler,
+                   in_loop_data=in_loop_data)
+        for i, handler in enumerate(stmt.handlers):
+            added = None
+            if handler.name:
+                self.tainted.add(handler.name)
+                added = handler.name
+            self._scan(handler.body,
+                       blocks=blocks + ((id(stmt), "except", i),),
+                       guard=guard, taintpath=taintpath, in_handler=True,
+                       in_loop_data=in_loop_data)
+            if added:
+                self.tainted.discard(added)
+        self._scan(stmt.orelse, blocks=blocks + ((id(stmt), "try", "else"),),
+                   guard=guard, taintpath=taintpath, in_handler=in_handler,
+                   in_loop_data=in_loop_data)
+        self._scan(stmt.finalbody, blocks=blocks, guard=guard,
+                   taintpath=taintpath, in_handler=in_handler,
+                   in_loop_data=in_loop_data)
+
+    def _raise(self, stmt, *, guard, taintpath, in_handler):
+        exc = stmt.exc
+        bare = exc is None or isinstance(exc, ast.Name)  # re-raise
+        typed = False
+        if isinstance(exc, ast.Call):
+            ctor = _leaf(_call_name(exc))
+            typed = ctor in self.an.typed_status or ctor == "make_fault"
+        self.order += 1
+        self.raises.append(_RaiseCheck(
+            stmt.lineno, self.order, in_handler and not guard,
+            taintpath and not guard,
+            (not bare and exc is not None and self._expr_tainted(exc)
+             and not guard),
+            typed, bare))
+
+
+# --------------------------------------------------------------------------
+# the analyzer
+
+@dataclass
+class Report:
+    """Outcome of a coherence run: suppression-filtered findings, the
+    raw pre-suppression list (stale-suppression audit / --json), the
+    CX403 verification summary (kind -> "path:line" of every vote site
+    proven to dominate its first dependent collective), and the files
+    analyzed."""
+    findings: list[Finding] = field(default_factory=list)
+    raw: list[Finding] = field(default_factory=list)
+    vote_summary: dict = field(default_factory=dict)
+    files: list[str] = field(default_factory=list)
+
+
+class Analyzer:
+    def __init__(self, files: dict[str, str]):
+        self.files = files
+        self.trees: dict[str, ast.Module] = {}
+        self.functions: list[FuncInfo] = []
+        self.by_leaf: dict[str, list[FuncInfo]] = {}
+        self.data_builders: set[str] = set()
+        self._syntax_errors: list[Finding] = []
+        self._parse()
+        self.typed_status = _harvest_typed_status(self.trees.values())
+        self._propagate()
+        self._classify_cache: dict[str, str | None] = {}
+
+    # -- construction -----------------------------------------------------
+
+    def _parse(self):
+        for path, source in sorted(self.files.items()):
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                self._syntax_errors.append(Finding(
+                    "CX401", path, e.lineno or 0,
+                    f"syntax error prevents coherence analysis: {e.msg}"))
+                continue
+            self.trees[path] = tree
+            norm = path.replace("\\", "/")
+            nop = any(norm.endswith(s.replace(os.sep, "/"))
+                      for s in _NO_PROPAGATE_SUFFIXES)
+            self.functions.extend(_index_functions(path, tree, nop))
+            for name, has_coll in _harvest_builders(tree):
+                if has_coll and name not in CONSENSUS_LEAVES:
+                    self.data_builders.add(name)
+        for fi in self.functions:
+            self.by_leaf.setdefault(fi.leaf, []).append(fi)
+
+    def _propagate(self):
+        """Fixed point for enters_data / enters_consensus over leaf-name
+        call edges."""
+        for fi in self.functions:
+            if fi.leaf in CONSENSUS_LEAVES:
+                # consensus wires never count as data, even when their
+                # builder body holds the lax pmax primitive
+                fi.enters_consensus = True
+                continue
+            if fi.has_lax or fi.leaf in self.data_builders \
+                    or fi.calls & DATA_FACADE_LEAVES \
+                    or fi.calls & self.data_builders:
+                fi.enters_data = True
+            if fi.calls & CONSENSUS_LEAVES:
+                fi.enters_consensus = True
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if fi.leaf in CONSENSUS_LEAVES:
+                    continue
+                if fi.enters_data and fi.enters_consensus:
+                    continue
+                for leaf in fi.calls:
+                    # calls are indexed by bare leaf here, so a builtin
+                    # leaf can't be told apart from a dotted method —
+                    # skip the edge (quiet direction)
+                    if leaf in _GENERIC_LEAVES or leaf in _PY_BUILTINS:
+                        continue
+                    for callee in self.by_leaf.get(leaf, ()):
+                        if callee.no_propagate or callee is fi:
+                            continue
+                        if callee.enters_data and not fi.enters_data:
+                            fi.enters_data = changed = True
+                        if callee.enters_consensus \
+                                and not fi.enters_consensus:
+                            fi.enters_consensus = changed = True
+
+    # -- queries used by _FuncScan ---------------------------------------
+
+    def classify(self, fname: str) -> str | None:
+        """'data' | 'consensus' | None for a call target name."""
+        if fname in self._classify_cache:
+            return self._classify_cache[fname]
+        leaf = _leaf(fname)
+        out = None
+        if leaf in CONSENSUS_LEAVES:
+            out = "consensus"
+        elif leaf in DATA_FACADE_LEAVES or leaf in self.data_builders \
+                or _is_lax_collective(fname):
+            out = "data"
+        elif leaf not in _GENERIC_LEAVES \
+                and not (leaf in _PY_BUILTINS and "." not in fname):
+            cands = [f for f in self.by_leaf.get(leaf, ())
+                     if not f.no_propagate]
+            if cands:
+                if any(f.enters_data for f in cands):
+                    out = "data"
+                elif any(f.enters_consensus for f in cands):
+                    out = "consensus"
+        self._classify_cache[fname] = out
+        return out
+
+    def returns_tainted(self, fname: str) -> bool:
+        leaf = _leaf(fname)
+        if leaf in _GENERIC_LEAVES:
+            return False
+        cands = self.by_leaf.get(leaf, ())
+        return bool(cands) and all(f.returns_tainted for f in cands)
+
+    # -- the run ----------------------------------------------------------
+
+    def run(self) -> Report:
+        # returns-taint fixpoint: scan everything, fold the returns-taint
+        # bits back in, rescan until stable (shallow chains: 2-3 rounds)
+        scans = {}
+        for _ in range(5):
+            scans = {fi.qualname + "@" + fi.path: _FuncScan(self, fi).run()
+                     for fi in self.functions}
+            changed = False
+            for fi in self.functions:
+                rt = scans[fi.qualname + "@" + fi.path].returns_tainted
+                if rt != fi.returns_tainted:
+                    fi.returns_tainted = rt
+                    changed = True
+            if not changed:
+                break
+
+        raw = list(self._syntax_errors)
+        summary = {k: [] for k in VOTE_KINDS}
+        for fi in self.functions:
+            scan = scans[fi.qualname + "@" + fi.path]
+            raw.extend(self._check_branches(fi, scan))
+            raw.extend(self._check_raises(fi, scan))
+            raw.extend(self._check_votes(fi, scan, summary))
+        raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+        findings = self._filter(raw)
+        return Report(findings=findings, raw=raw, vote_summary=summary,
+                      files=sorted(self.trees))
+
+    # -- checks -----------------------------------------------------------
+
+    def _check_branches(self, fi, scan):
+        for b in scan.branches:
+            who = ", ".join(b.names) if b.names else "a rank-local value"
+            if b.arm_seqs[0] != b.arm_seqs[1]:
+                if b.kind in ("while", "for"):
+                    msg = (f"data collective {'/'.join(b.arm_seqs[0])} "
+                           f"under a rank-local trip count ({who}) in "
+                           f"{fi.qualname} — ranks can run different "
+                           f"iteration counts and desync the sequence")
+                else:
+                    msg = (f"branch on {who} issues different collective "
+                           f"sequences per arm "
+                           f"({'/'.join(b.arm_seqs[0]) or 'none'} vs "
+                           f"{'/'.join(b.arm_seqs[1]) or 'none'}) in "
+                           f"{fi.qualname}")
+                yield Finding("CX402", fi.path, b.line, msg)
+                continue
+            if b.arm_seqs[0]:
+                continue    # identical non-empty sequences: coherent
+            if b.arm_consensus:
+                continue    # an arm votes: sanctioned realignment
+            before = b.in_loop_data or any(
+                k == "data" for o, k in scan.events if o <= b.start)
+            if not before:
+                continue
+            nxt = next((k for o, k in scan.events if o > b.end), None)
+            after = (nxt == "data") or (nxt is None and b.in_loop_data)
+            if after:
+                yield Finding(
+                    "CX401", fi.path, b.line,
+                    f"rank-local branch on {who} between two data "
+                    f"collectives in {fi.qualname} with no intervening "
+                    f"consensus vote")
+
+    def _check_raises(self, fi, scan):
+        for r in scan.raises:
+            if r.bare or r.typed:
+                continue
+            if not (r.in_handler or r.on_tainted_path or r.expr_tainted):
+                continue
+            last_data = max((o for o, k in scan.events
+                             if k == "data" and o < r.order), default=None)
+            if last_data is None:
+                continue
+            if any(k == "consensus" for o, k in scan.events
+                   if last_data < o < r.order):
+                continue
+            yield Finding(
+                "CX404", fi.path, r.line,
+                f"untyped rank-local raise in {fi.qualname} after a data "
+                f"collective with no consensus vote in between — route "
+                f"through recovery.make_fault / a CylonError subclass and "
+                f"a consensus'd code")
+
+    def _check_votes(self, fi, scan, summary):
+        for kind, spec in VOTE_KINDS.items():
+            deps, votes = scan.deps[kind], scan.votes[kind]
+            if not deps or not votes:
+                continue
+            first = min(deps, key=lambda s: s.order)
+            dominating = [v for v in votes if v.order < first.order
+                          and v.blocks == first.blocks[:len(v.blocks)]]
+            if dominating:
+                summary[kind].append(f"{fi.path}:{dominating[0].line}")
+            else:
+                yield Finding(
+                    "CX403", fi.path, votes[0].line,
+                    f"{kind} plan vote ({votes[0].fname}) does not "
+                    f"dominate its first dependent collective "
+                    f"({first.fname}, line {first.line}) in "
+                    f"{fi.qualname} — the vote must run before, and on "
+                    f"every path to, the collective whose shape it "
+                    f"decides")
+
+    # -- suppression ------------------------------------------------------
+
+    def def_spans(self, path: str):
+        """(lineno, end_lineno) of every def in a file, nested included —
+        a suppression on a def line covers its body."""
+        tree = self.trees.get(path)
+        if tree is None:
+            return []
+        return [(n.lineno, getattr(n, "end_lineno", n.lineno))
+                for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def _filter(self, raw):
+        out = []
+        sup_cache, span_cache, off_cache = {}, {}, {}
+        for f in raw:
+            src = self.files.get(f.path, "")
+            if f.path not in off_cache:
+                off_cache[f.path] = file_suppressed(src)
+                sup_cache[f.path] = suppressions(src)
+                span_cache[f.path] = self.def_spans(f.path)
+            if off_cache[f.path]:
+                continue
+            def_lines = sorted((s for s, e in span_cache[f.path]
+                                if s <= f.line <= e), reverse=True)
+            if not is_suppressed(f, sup_cache[f.path], def_lines):
+                out.append(f)
+        return out
+
+
+# --------------------------------------------------------------------------
+# public entry points
+
+def analyze_files(files: dict[str, str]) -> Report:
+    """Run the coherence pass over in-memory sources (path -> source)."""
+    return Analyzer(files).run()
+
+
+def analyze_source(path: str, source: str) -> Report:
+    return analyze_files({path: source})
+
+
+def iter_py_files(paths):
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def analyze_paths(paths) -> Report:
+    """Run the coherence pass over files/directories (whole-tree mode:
+    the call graph spans every file, so interprocedural marks resolve)."""
+    files = {}
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            files[path] = f.read()
+    return analyze_files(files)

@@ -8,7 +8,10 @@ Rule families (documented in ``docs/trace_safety.md``):
 * ``JX2xx`` — jaxpr verification (:mod:`cylon_tpu.analysis.jaxpr_check`),
   SPMD invariants checked on the traced program;
 * ``RT3xx`` — runtime sentinel (:mod:`cylon_tpu.analysis.runtime`),
-  retrace / transfer budgets enforced during test sessions.
+  retrace / transfer budgets enforced during test sessions;
+* ``CX4xx`` — interprocedural collective coherence
+  (:mod:`cylon_tpu.analysis.coherence`), rank-local control flow
+  positioned between collectives and plan-vote dominance.
 
 Suppression: a trailing comment ``# tracecheck: off[TS101]`` (comma-
 separated rule ids, or bare ``off`` for all rules) on the flagged line or
@@ -20,7 +23,9 @@ auto-inserts them.
 
 from __future__ import annotations
 
+import io
 import re
+import tokenize
 from dataclasses import dataclass
 
 RULES = {
@@ -88,6 +93,29 @@ RULES = {
     "JX203": "int32→int64 widening of a row-scale array under x64",
     "JX204": "host callback count exceeds the builder's budget",
     "JX205": "collective set differs from the builder's declaration",
+    "CX401": "rank-local branch between two collectives without an "
+             "intervening consensus vote — a value tainted by a "
+             "rank-local source (process_index, injector state, caught "
+             "exception, file IO, wall clock, per-rank host shapes) "
+             "steers control flow after one collective has been entered "
+             "and before the next, so ranks can disagree about what "
+             "happens in between",
+    "CX402": "path-dependent collective sequence — a branch or loop on a "
+             "rank-local value issues different collectives on its arms "
+             "(or a data collective under a rank-local trip count), so "
+             "ranks can enter mismatched collective sequences and "
+             "deadlock",
+    "CX403": "plan/epoch vote does not dominate its first dependent "
+             "collective — a Code.SkewPlan/TopoPlan/CkptCommit/"
+             "PreemptDrain consensus vote must execute before (and on "
+             "every path to) the first collective whose shape it "
+             "decides",
+    "CX404": "rank-local raise after a collective was entered without a "
+             "consensus'd typed status — an untyped exception raised "
+             "from an except handler or a tainted path desyncs ranks "
+             "that already passed a collective; route it through the "
+             "fault taxonomy (recovery.make_fault / CylonError "
+             "subclasses) and a consensus vote",
     "RT301": "builder recompiled for an identical shape signature",
     "RT302": "builder compiled more distinct programs than its budget",
     "RT303": "op exceeded its declared host-transfer budget",
@@ -109,11 +137,29 @@ _SUPPRESS_RE = re.compile(
     r"#\s*tracecheck:\s*off(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
 
 
+def _comment_lines(source: str) -> set[int] | None:
+    """1-based line numbers holding a real ``#`` comment token, or None
+    when the source does not tokenize — a docstring that merely MENTIONS
+    the suppression grammar (like this module's) must not suppress
+    anything or trip the stale-suppression audit."""
+    lines: set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                lines.add(tok.start[0])
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        return None
+    return lines
+
+
 def suppressions(source: str) -> dict[int, set[str] | None]:
     """Per-line suppression map: line -> set of rule ids, or None = all.
     Line numbers are 1-based, matching ast/Finding."""
+    comments = _comment_lines(source)
     out: dict[int, set[str] | None] = {}
     for i, text in enumerate(source.splitlines(), start=1):
+        if comments is not None and i not in comments:
+            continue
         m = _SUPPRESS_RE.search(text)
         if not m:
             continue
@@ -124,7 +170,10 @@ def suppressions(source: str) -> dict[int, set[str] | None]:
 
 
 def file_suppressed(source: str) -> bool:
+    comments = _comment_lines(source)
     for i, text in enumerate(source.splitlines()[:5], start=1):
+        if comments is not None and i not in comments:
+            continue
         m = _SUPPRESS_RE.search(text)
         if m and m.group("rules") is None:
             return True

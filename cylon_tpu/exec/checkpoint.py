@@ -489,6 +489,8 @@ class Stage:
         # on shared storage — an NFS blip during a GKE drain — used to
         # abort a drain a 3-attempt backoff saves
         recovery.retry_io(stage_write, "ckpt.write")
+        # stage -> vote -> publish: the commit vote must precede the
+        # os.replace on every path (reordering fails the CX403 gate)
         recovery.ckpt_commit_consensus(getattr(self.env, "mesh", None),
                                        self.epoch)
         recovery.retry_io(lambda: os.replace(staged, self._manifest_path),

@@ -1131,7 +1131,9 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
         outs.append(out_r)
         if stage is not None and ckpt.drain_requested(env):
             # preemption grace (exec/preempt): a SIGTERM arrived and the
-            # drain vote agreed — this piece boundary is the planned
+            # drain vote agreed — the vote must guard the abort on every
+            # path (reordering fails the CX403 gate); this piece
+            # boundary is the planned
             # exit.  Pending sink chunks settle first (their partials
             # commit), then the typed ResumableAbort carries the resume
             # token out; the relaunch fast-forwards everything committed

@@ -2,29 +2,202 @@
 """Trace-safety / SPMD-hazard lint gate (CI entry point).
 
 Usage:
-    python scripts/check_trace_safety.py [paths...]      # AST lint only
-    python scripts/check_trace_safety.py --strict        # lint + jaxpr pass
+    python scripts/check_trace_safety.py [paths...]      # AST + CX passes
+    python scripts/check_trace_safety.py --strict        # + jaxpr pass
     python scripts/check_trace_safety.py --list-rules
+    python scripts/check_trace_safety.py --audit-suppressions
+    python scripts/check_trace_safety.py --json out.json
 
-Exit status: 0 when no findings, 1 when any rule fires (each printed as
-``file:line: RULE message``), 2 on usage errors.  ``--strict`` addition-
-ally traces every registered program builder over a virtual 8-device CPU
-mesh and verifies the jaxpr-level SPMD invariants (JX2xx) — tracing
-only, nothing compiles, so the gate stays fast enough to run before
-every test session (see ROADMAP.md tier-1 recipe).
+Stages (see docs/trace_safety.md for the rule catalog):
 
-Rule catalog + suppression syntax: docs/trace_safety.md.
+1. **AST lint** (TS1xx) — per-file source hazards, jax-free.
+2. **Collective coherence** (CX4xx) — interprocedural call-graph +
+   taint/dominance pass over the whole tree: rank-local control flow
+   between collectives, path-dependent collective sequences, plan-vote
+   dominance, untyped post-collective raises.
+3. **jaxpr verification** (JX2xx, ``--strict``/``--jaxpr`` only) —
+   traces every registered builder over a virtual 8-device CPU mesh.
+   Tracing only, nothing compiles.
+
+The jax-free stages are cached under ``.tracecheck_cache/`` keyed on
+content hashes of the analyzed files AND the analyzer modules, so a
+warm re-run skips every unchanged file (``--no-cache`` bypasses).
+
+``--audit-suppressions`` reports stale ``# tracecheck: off[...]``
+comments whose rules no longer fire on the covered lines; ``--strict``
+warns about them on stderr and ``--fail-stale-suppressions`` turns them
+into a gate failure.  ``--json FILE`` emits every finding (suppressed
+ones included, flagged) for CI diffing.
+
+Exit status: 0 when no unsuppressed findings, 1 when any rule fires
+(each printed as ``file:line: RULE message``), 2 on usage errors.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+CACHE_DIR = os.path.join(REPO, ".tracecheck_cache")
+CACHE_VERSION = 1
+
+
+# --------------------------------------------------------------------------
+# cache plumbing
+
+def _analyzer_hash() -> str:
+    """Content hash of the analyzer modules — any rule change invalidates
+    every cache entry."""
+    import cylon_tpu.analysis as pkg
+    base = os.path.dirname(os.path.abspath(pkg.__file__))
+    h = hashlib.sha256(str(CACHE_VERSION).encode())
+    for name in ("rules.py", "ast_lint.py", "coherence.py"):
+        try:
+            with open(os.path.join(base, name), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + name.encode())
+    return h.hexdigest()
+
+
+def _load_cache(name: str, analyzer_hash: str) -> dict:
+    try:
+        with open(os.path.join(CACHE_DIR, name), encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("analyzer") == analyzer_hash:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"analyzer": analyzer_hash}
+
+
+def _store_cache(name: str, data: dict) -> None:
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        tmp = os.path.join(CACHE_DIR, name + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, os.path.join(CACHE_DIR, name))
+    except OSError:
+        pass          # cache is best-effort; the gate still ran
+
+
+def _finding_to_list(f):
+    return [f.rule, f.path, f.line, f.message]
+
+
+def _finding_from_list(item):
+    from cylon_tpu.analysis.rules import Finding
+    return Finding(item[0], item[1], item[2], item[3])
+
+
+# --------------------------------------------------------------------------
+# stages
+
+def _ast_stage(files: dict[str, str], analyzer_hash: str, use_cache: bool):
+    """Per-file AST lint with content-hash skipping.  Returns
+    ``(kept, raw, spans_by_file, n_cached)``."""
+    from cylon_tpu.analysis import ast_lint
+    from cylon_tpu.analysis.rules import (file_suppressed, is_suppressed,
+                                          suppressions)
+    cache = _load_cache("ast.json", analyzer_hash) if use_cache else {}
+    entries = cache.setdefault("files", {})
+    kept, raw, spans_by_file, n_cached = [], [], {}, 0
+    for path, source in sorted(files.items()):
+        sha = hashlib.sha256(source.encode()).hexdigest()
+        ent = entries.get(path)
+        if ent is not None and ent.get("sha") == sha:
+            n_cached += 1
+        else:
+            file_raw, spans = ast_lint.lint_source_raw(path, source)
+            if file_suppressed(source):
+                file_kept = []
+            else:
+                sup = suppressions(source)
+                file_kept = [
+                    f for f in file_raw if not is_suppressed(
+                        f, sup, ast_lint.enclosing_def_lines(spans, f.line))]
+            ent = {"sha": sha,
+                   "kept": [_finding_to_list(f) for f in file_kept],
+                   "raw": [_finding_to_list(f) for f in file_raw],
+                   "spans": spans}
+            entries[path] = ent
+        kept.extend(_finding_from_list(i) for i in ent["kept"])
+        raw.extend(_finding_from_list(i) for i in ent["raw"])
+        spans_by_file[path] = [tuple(s) for s in ent["spans"]]
+    if use_cache:
+        _store_cache("ast.json", cache)
+    return kept, raw, spans_by_file, n_cached
+
+
+def _cx_stage(files: dict[str, str], analyzer_hash: str, use_cache: bool):
+    """Whole-tree coherence pass.  The call graph is interprocedural, so
+    the cache key is the hash of EVERY analyzed file: any change reruns
+    the pass, no change skips it entirely."""
+    from cylon_tpu.analysis import coherence
+    h = hashlib.sha256()
+    for path, source in sorted(files.items()):
+        h.update(path.encode())
+        h.update(hashlib.sha256(source.encode()).digest())
+    tree_sha = h.hexdigest()
+    cache = _load_cache("cx.json", analyzer_hash) if use_cache else {}
+    trees = cache.setdefault("trees", {})
+    ent = trees.get(tree_sha)
+    if ent is not None:
+        return ([_finding_from_list(i) for i in ent["kept"]],
+                [_finding_from_list(i) for i in ent["raw"]],
+                ent["vote_summary"], True)
+    report = coherence.analyze_files(files)
+    if use_cache:
+        # a handful of path-sets at most (default tree, fixture dirs)
+        while len(trees) >= 8:
+            trees.pop(next(iter(trees)))
+        trees[tree_sha] = {
+            "kept": [_finding_to_list(f) for f in report.findings],
+            "raw": [_finding_to_list(f) for f in report.raw],
+            "vote_summary": report.vote_summary}
+        _store_cache("cx.json", cache)
+    return report.findings, report.raw, report.vote_summary, False
+
+
+def _audit_suppressions(files: dict[str, str], raw, spans_by_file):
+    """Dead-suppression report: every ``# tracecheck: off[...]`` comment
+    none of whose rules fires (pre-suppression) on the lines it covers.
+    Returns ``[(path, line, rules-or-None), ...]``."""
+    from cylon_tpu.analysis.rules import suppressions
+    raw_by_file = {}
+    for f in raw:
+        raw_by_file.setdefault(f.path, []).append(f)
+    dead = []
+    for path, source in sorted(files.items()):
+        sup = suppressions(source)
+        if not sup:
+            continue
+        file_raw = raw_by_file.get(path, [])
+        spans = spans_by_file.get(path, [])
+        n_lines = source.count("\n") + 1
+        for line, rules in sorted(sup.items()):
+            covered = {line}
+            for s, e in spans:
+                if s == line:                 # comment on the def line
+                    covered.update(range(s, e + 1))
+            if rules is None and line <= 5:   # file-level off
+                covered.update(range(1, n_lines + 1))
+            live = any(f.line in covered
+                       and (rules is None or f.rule in rules)
+                       for f in file_raw)
+            if not live:
+                dead.append((path, line, rules))
+    return dead
+
+
+# --------------------------------------------------------------------------
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -35,8 +208,18 @@ def main(argv=None) -> int:
                     help="also run the jaxpr verification pass over every "
                          "registered builder")
     ap.add_argument("--jaxpr", action="store_true",
-                    help="run only the jaxpr pass (skip the AST lint)")
+                    help="run only the jaxpr pass (skip the AST/CX stages)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write .tracecheck_cache/")
+    ap.add_argument("--json", metavar="FILE", dest="json_out",
+                    help="write every finding (suppressed included, "
+                         "flagged) as JSON for CI diffing")
+    ap.add_argument("--audit-suppressions", action="store_true",
+                    help="report stale tracecheck suppression comments "
+                         "and exit (no gate verdict)")
+    ap.add_argument("--fail-stale-suppressions", action="store_true",
+                    help="fail the gate when a stale suppression is found")
     args = ap.parse_args(argv)
 
     # rules import is jax-free; keep the lint-only path light
@@ -47,10 +230,45 @@ def main(argv=None) -> int:
             print(f"{rule}  {desc}")
         return 0
 
-    findings = []
+    findings, raw, dead = [], [], []
+    use_cache = not args.no_cache
     if not args.jaxpr:
-        from cylon_tpu.analysis.ast_lint import lint_paths
-        findings.extend(lint_paths(args.paths))
+        from cylon_tpu.analysis.coherence import iter_py_files
+        files = {}
+        for path in iter_py_files(args.paths):
+            with open(path, encoding="utf-8") as f:
+                files[path] = f.read()
+        ah = _analyzer_hash()
+
+        ast_kept, ast_raw, spans_by_file, n_cached = _ast_stage(
+            files, ah, use_cache)
+        print(f"ast lint: {len(files)} files "
+              f"({n_cached} cached)", file=sys.stderr)
+
+        cx_kept, cx_raw, vote_summary, cx_cached = _cx_stage(
+            files, ah, use_cache)
+        votes = ", ".join(f"{k}={len(v)}"
+                          for k, v in sorted(vote_summary.items()))
+        print(f"coherence pass: {'cached' if cx_cached else 'ran'}; "
+              f"dominating vote sites: {votes}", file=sys.stderr)
+
+        findings += ast_kept + cx_kept
+        raw += ast_raw + cx_raw
+        dead = _audit_suppressions(files, raw, spans_by_file)
+        if args.audit_suppressions:
+            for path, line, rules in dead:
+                what = "all rules" if rules is None \
+                    else ",".join(sorted(rules))
+                print(f"{path}:{line}: stale suppression ({what} — "
+                      f"nothing fires on the covered lines)")
+            print(f"suppression audit: {len(dead)} stale"
+                  if dead else "suppression audit: clean",
+                  file=sys.stderr)
+            return 0
+        for path, line, rules in dead:
+            what = "all rules" if rules is None else ",".join(sorted(rules))
+            print(f"warning: stale suppression at {path}:{line} ({what})",
+                  file=sys.stderr)
 
     if args.strict or args.jaxpr:
         # the jaxpr pass needs a mesh: force the virtual 8-device CPU rig
@@ -70,13 +288,40 @@ def main(argv=None) -> int:
             print("error: no builders registered for the jaxpr pass",
                   file=sys.stderr)
             return 2
-        findings.extend(jaxpr_check.verify_all(env.mesh, decls))
+        jx = jaxpr_check.verify_all(env.mesh, decls)
+        findings.extend(jx)
+        raw.extend(jx)
         checked = ", ".join(sorted({t for d in decls for t in d.tags}))
         print(f"jaxpr pass: {len(decls)} builders verified ({checked})",
               file=sys.stderr)
 
+    if args.json_out:
+        kept_keys = {(f.rule, f.path, f.line, f.message) for f in findings}
+        payload = {
+            "version": 1,
+            "findings": [
+                {"rule": f.rule, "file": f.path, "line": f.line,
+                 "message": f.message,
+                 "suppressed": (f.rule, f.path, f.line, f.message)
+                 not in kept_keys}
+                for f in raw],
+            "stale_suppressions": [
+                {"file": p, "line": ln,
+                 "rules": sorted(r) if r is not None else None}
+                for p, ln, r in dead],
+            "counts": {},
+        }
+        for f in findings:
+            payload["counts"][f.rule] = payload["counts"].get(f.rule, 0) + 1
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {args.json_out}", file=sys.stderr)
+
     for f in findings:
         print(f)
+    if args.fail_stale_suppressions and dead:
+        print(f"\n{len(dead)} stale suppression(s)", file=sys.stderr)
+        return 1
     if findings:
         counts = {}
         for f in findings:

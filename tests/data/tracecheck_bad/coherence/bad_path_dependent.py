@@ -1,0 +1,15 @@
+"""CX402 fixture: path-dependent collective sequence.
+
+A branch on injector state issues a different collective on each arm —
+ranks that disagree about ``armed`` enter mismatched collectives and
+deadlock.  Must fire CX402 and nothing else.
+"""
+
+
+def reordered_on_one_path(mesh, table, probe, exchange, allgather_table):
+    kind, armed = probe("fixture.plan")     # rank-local injector state
+    if armed:                               # CX402: arms issue different
+        table = allgather_table(mesh, table)    # collective sequences
+    else:
+        table = exchange(mesh, table)
+    return table

@@ -1,0 +1,16 @@
+"""CX404 fixture: untyped rank-local raise after a collective.
+
+The raise fires from an except handler after a data collective was
+entered, without a consensus'd typed status — peer ranks sit in the
+next collective while this rank unwinds with a foreign exception.  Must
+fire CX404 and nothing else.
+"""
+
+
+def raise_after_collective(mesh, table, exchange, write_page):
+    out = exchange(mesh, table)             # data collective entered
+    try:
+        write_page(out)
+    except OSError:                         # rank-local fault...
+        raise RuntimeError("page write failed")   # CX404: untyped raise
+    return out

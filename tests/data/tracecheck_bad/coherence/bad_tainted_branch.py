@@ -1,0 +1,15 @@
+"""CX401 fixture: a rank-local branch between two data collectives.
+
+``probe`` is injector state (rank-local); the branch sits after one
+``exchange`` and before the next with no consensus vote in between, so
+ranks that disagree about ``armed`` diverge mid-sequence.  Must fire
+CX401 and nothing else.
+"""
+
+
+def tainted_branch_between(mesh, table, probe, exchange):
+    out = exchange(mesh, table)             # first data collective
+    kind, armed = probe("fixture.recv_guard")   # rank-local injector state
+    if armed:                               # CX401: divergent decision
+        kind = "armed"                      # (no collectives in the arm)
+    return exchange(mesh, out)              # second data collective

@@ -1,0 +1,16 @@
+"""CX403 fixture: plan vote after its first dependent collective.
+
+The ``Code.SkewPlan`` vote must dominate the split exchange whose shape
+it decides; here the vote lands after the dependent collective, so a
+rank that faults mid-exchange resumes against an un-voted plan.  Must
+fire CX403 and nothing else.
+"""
+
+
+# TS115 suppressed: this fixture exercises the CX403 ordering check in
+# isolation — the facade-scoping hazard has its own fixture
+# (relational/bad_skew_salt.py).
+def vote_after_dependent(mesh, table, plan, split_exchange, skew_plan_consensus):  # tracecheck: off[TS115]
+    parts = split_exchange(mesh, table, plan)     # dependent collective
+    skew_plan_consensus(mesh, plan.plan_hash())   # CX403: vote too late
+    return parts

@@ -10,6 +10,7 @@ Slow tests: the jaxpr pass over EVERY registered builder and the CLI
 subprocess round-trip.
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -17,7 +18,7 @@ import sys
 import numpy as np
 import pytest
 
-from cylon_tpu.analysis import ast_lint, rules
+from cylon_tpu.analysis import ast_lint, coherence, rules
 from cylon_tpu.analysis.registry import BuilderDecl
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -509,6 +510,164 @@ def test_fixture_package_is_dirty():
 
 
 # ---------------------------------------------------------------------------
+# coherence pass (CX4xx): fixtures, call graph, taint, vote dominance
+# ---------------------------------------------------------------------------
+
+COH = os.path.join(BAD, "coherence")
+
+
+def _cx_rules(name):
+    rep = coherence.analyze_paths([os.path.join(COH, name)])
+    return [f.rule for f in rep.findings]
+
+
+def test_cx_fixtures_fire_exactly_their_rule():
+    assert _cx_rules("bad_tainted_branch.py") == ["CX401"]
+    assert _cx_rules("bad_path_dependent.py") == ["CX402"]
+    assert _cx_rules("bad_vote_after_collective.py") == ["CX403"]
+    assert _cx_rules("bad_raise_post_collective.py") == ["CX404"]
+
+
+def test_cx_fixture_package_fires_all_four():
+    rep = coherence.analyze_paths([COH])
+    assert sorted(f.rule for f in rep.findings) == [
+        "CX401", "CX402", "CX403", "CX404"]
+
+
+def test_callgraph_propagates_collective_entry():
+    files = {
+        "cylon_tpu/fake/a.py":
+            "def leafop(mesh, t):\n"
+            "    return exchange(mesh, t)\n",
+        "cylon_tpu/fake/b.py":
+            "def mid(mesh, t):\n"
+            "    return leafop(mesh, t)\n\n\n"
+            "def top(mesh, t):\n"
+            "    return mid(mesh, t)\n\n\n"
+            "def voter(mesh, x):\n"
+            "    return consensus_code(mesh, x)\n\n\n"
+            "def pure(x):\n"
+            "    return x + 1\n",
+    }
+    an = coherence.Analyzer(files)
+    info = {f.qualname: f for f in an.functions}
+    assert info["leafop"].enters_data          # facade seed
+    assert info["mid"].enters_data             # direct call edge
+    assert info["top"].enters_data             # transitive, via fixpoint
+    assert info["voter"].enters_consensus and not info["voter"].enters_data
+    assert not info["pure"].enters_data
+    assert not info["pure"].enters_consensus
+
+
+def test_registry_harvest_seeds_data_builders():
+    src = (
+        "def _make(mesh):\n"
+        "    def _sortish_fn(t):\n"
+        "        return t\n"
+        "    declare_builder(f\"{__name__}._sortish_fn\", _sortish_fn,\n"
+        "                    collectives={\"all_to_all\"})\n"
+        "    return _sortish_fn\n")
+    an = coherence.Analyzer({"cylon_tpu/fake/reg.py": src})
+    assert "_sortish_fn" in an.data_builders
+    assert an.classify("_sortish_fn") == "data"
+
+
+def test_taint_flows_through_assignment_and_returns():
+    src = (
+        "def my_rank():\n"
+        "    return jax.process_index()\n\n\n"
+        "def step(mesh, t):\n"
+        "    t = exchange(mesh, t)\n"
+        "    r = my_rank()\n"               # returns-taint across the call
+        "    k = r + 1\n"                   # taint through assignment
+        "    if k > 0:\n"
+        "        t = t[:1]\n"
+        "    return exchange(mesh, t)\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/taint.py", src)
+    assert [(f.rule, f.line) for f in rep.findings] == [("CX401", 9)]
+
+
+def test_consensus_vote_sanitizes_branch():
+    src = (
+        "def step(mesh, t):\n"
+        "    t = exchange(mesh, t)\n"
+        "    r = jax.process_index()\n"
+        "    voted = consensus_code(mesh, r)\n"   # sanitizer: all ranks agree
+        "    if voted:\n"
+        "        t = t[:1]\n"
+        "    return exchange(mesh, t)\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/voted.py", src)
+    assert rep.findings == []
+
+
+def test_vote_before_loop_dominates():
+    src = (
+        "def adopt_plan(mesh, t, plan):\n"
+        "    skew_plan_consensus(mesh, plan)\n"
+        "    for _ in range(2):\n"
+        "        t = split_exchange(mesh, t, plan)\n"
+        "    return t\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/skew.py", src)
+    assert rep.findings == []
+    assert rep.vote_summary["skew"] == ["cylon_tpu/fake/skew.py:2"]
+
+
+def test_vote_moved_after_collective_fires():
+    # the same function with the vote after its dependent collective —
+    # the dominance proof must break
+    src = (
+        "def adopt_plan(mesh, t, plan):\n"
+        "    t = split_exchange(mesh, t, plan)\n"
+        "    skew_plan_consensus(mesh, plan)\n"
+        "    return t\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/skew.py", src)
+    assert [f.rule for f in rep.findings] == ["CX403"]
+    assert rep.vote_summary["skew"] == []
+
+
+def test_vote_on_one_path_only_fires():
+    src = (
+        "def adopt_plan(mesh, t, plan, cheap):\n"
+        "    if cheap:\n"
+        "        skew_plan_consensus(mesh, plan)\n"
+        "    return split_exchange(mesh, t, plan)\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/skew.py", src)
+    assert [f.rule for f in rep.findings] == ["CX403"]
+
+
+def test_vote_in_branch_test_dominates_body():
+    # the drain idiom: the vote is the branch condition itself
+    src = (
+        "def maybe_abort(mesh, env):\n"
+        "    if drain_requested(env):\n"
+        "        drain_abort('preempt')\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/drain.py", src)
+    assert rep.findings == []
+    assert rep.vote_summary["drain"] == ["cylon_tpu/fake/drain.py:2"]
+
+
+def test_cx_suppression_honored():
+    src = (
+        "def tainted(mesh, table, probe, exchange):\n"
+        "    out = exchange(mesh, table)\n"
+        "    kind, armed = probe('guard')\n"
+        "    if armed:  # tracecheck: off[CX401] — fixture for the test\n"
+        "        kind = 'armed'\n"
+        "    return exchange(mesh, out)\n")
+    rep = coherence.analyze_source("cylon_tpu/fake/sup.py", src)
+    assert rep.findings == []
+    assert [f.rule for f in rep.raw] == ["CX401"]
+
+
+def test_package_coherence_clean_and_votes_dominate():
+    rep = coherence.analyze_paths([PKG])
+    assert [str(f) for f in rep.findings] == []
+    # the four plan votes are each proven to dominate at >=1 real site
+    for kind in ("skew", "topo", "ckpt", "drain"):
+        assert rep.vote_summary.get(kind), kind
+
+
+# ---------------------------------------------------------------------------
 # jaxpr pass: required op families verify clean; seeded hazards are caught
 # ---------------------------------------------------------------------------
 
@@ -662,3 +821,67 @@ def test_cli_strict_green_on_repo_red_on_fixtures():
                          capture_output=True, text=True, env=env, cwd=REPO)
     assert bad.returncode == 1
     assert "TS102" in bad.stdout and ":" in bad.stdout.splitlines()[0]
+
+
+@pytest.mark.slow
+def test_cli_json_schema_and_suppressed_flag(tmp_path):
+    script = os.path.join(REPO, "scripts", "check_trace_safety.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = tmp_path / "findings.json"
+    r = subprocess.run([sys.executable, script, "--json", str(out), COH],
+                       capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 1
+    payload = json.loads(out.read_text())
+    assert payload["version"] == 1
+    assert set(payload["counts"]) >= {"CX401", "CX402", "CX403", "CX404"}
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "file", "line", "message", "suppressed"}
+    by_rule = {}
+    for f in payload["findings"]:
+        by_rule.setdefault(f["rule"], []).append(f)
+    # the CX403 fixture's def-line TS115 suppression is reported, flagged
+    assert all(f["suppressed"] for f in by_rule["TS115"])
+    for cx in ("CX401", "CX402", "CX403", "CX404"):
+        assert [f["suppressed"] for f in by_rule[cx]] == [False]
+
+
+@pytest.mark.slow
+def test_cli_suppression_audit_and_stale_failure(tmp_path):
+    script = os.path.join(REPO, "scripts", "check_trace_safety.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    dead = tmp_path / "dead.py"
+    dead.write_text("def f(x):  # tracecheck: off[TS101]\n    return x\n")
+    audit = subprocess.run(
+        [sys.executable, script, "--audit-suppressions", str(dead)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert audit.returncode == 0
+    assert "TS101" in audit.stdout
+    fail = subprocess.run(
+        [sys.executable, script, "--fail-stale-suppressions", str(dead)],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert fail.returncode == 1
+    clean = subprocess.run(
+        [sys.executable, script, "--audit-suppressions", PKG],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert clean.returncode == 0
+    assert "clean" in clean.stdout + clean.stderr
+
+
+@pytest.mark.slow
+def test_cli_gate_cache_warm_and_bypass():
+    script = os.path.join(REPO, "scripts", "check_trace_safety.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    first = subprocess.run([sys.executable, script, COH],
+                           capture_output=True, text=True, env=env, cwd=REPO)
+    warm = subprocess.run([sys.executable, script, COH],
+                          capture_output=True, text=True, env=env, cwd=REPO)
+    assert warm.returncode == first.returncode == 1
+    assert "coherence pass: cached" in warm.stderr
+    assert "(4 cached)" in warm.stderr
+    # identical findings from the cached path
+    assert warm.stdout == first.stdout
+    cold = subprocess.run([sys.executable, script, "--no-cache", COH],
+                          capture_output=True, text=True, env=env, cwd=REPO)
+    assert "(0 cached)" in cold.stderr
+    assert "coherence pass: ran" in cold.stderr
+    assert cold.stdout == first.stdout
