@@ -104,8 +104,8 @@ CONSENSUS_LEAVES = frozenset({
     "consensus_code", "guard_consensus", "spill_consensus",
     "drain_consensus", "count_consensus", "ckpt_commit_consensus",
     "watermark_consensus", "_plan_hash_consensus", "skew_plan_consensus",
-    "topo_plan_consensus", "ckpt_resume_consensus", "_consensus_wire",
-    "_ns_consensus", "_consensus_fn",
+    "topo_plan_consensus", "ckpt_resume_consensus", "preempt_consensus",
+    "_consensus_wire", "_ns_consensus", "_consensus_fn",
 })
 
 #: collective facades resolvable without the full tree (single-file
@@ -165,6 +165,14 @@ VOTE_KINDS = {
         "votes": frozenset({"drain_consensus", "drain_requested"}),
         "deps": frozenset({"drain_abort"}),
     },
+    # the preempt-DECISION vote (exec/scheduler._maybe_preempt): the
+    # agreed victim must be flagged for its boundary drain only after
+    # the vote — flagging from a rank-local choice would drain
+    # different tenants per rank
+    "preempt": {
+        "votes": frozenset({"preempt_consensus"}),
+        "deps": frozenset({"_begin_preempt_drain"}),
+    },
 }
 
 #: fallback typed-status names (kept in sync with cylon_tpu/status.py;
@@ -176,6 +184,7 @@ DEFAULT_TYPED_STATUS = frozenset({
     "ResumableAbort", "CheckpointCorruptError", "CylonTypeError",
     "CylonKeyError", "CylonIndexError", "CylonIOError",
     "NotImplementedCylonError", "ExecutionError",
+    "AdmissionTimeoutError", "RequeueOverflowError",
 })
 
 #: modules whose collectives never propagate to callers: the host-pull

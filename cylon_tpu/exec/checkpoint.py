@@ -93,6 +93,7 @@ import json
 import os
 import pickle
 import re
+import time
 
 import numpy as np
 
@@ -115,8 +116,17 @@ def enabled() -> bool:
 
 def resume_requested() -> bool:
     """``CYLON_TPU_RESUME=1``: committed pieces of matching stages are
-    restored instead of recomputed."""
-    return os.environ.get("CYLON_TPU_RESUME") == "1"
+    restored instead of recomputed.  A serving session the scheduler
+    preempted and REQUEUED resumes in-process the same way: its
+    ``_resume_pending`` flag arms the resume for the re-granted fn run
+    only (per-session stage namespaces keep the tokens collision-free),
+    without flipping the process-wide env knob for co-tenants."""
+    if os.environ.get("CYLON_TPU_RESUME") == "1":
+        return True
+    from .scheduler import current_session
+    sess = current_session()
+    return bool(sess is not None
+                and getattr(sess, "_resume_pending", False))
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +223,14 @@ def reset_stages() -> None:
     to exercise the resume path without a fresh interpreter)."""
     _STAGE_SEQ.clear()
     _OPEN_DIRS.clear()
+
+
+def reset_session_stages(sid: str) -> None:
+    """Restart ONE serving session's stage sequence — the scheduler's
+    preemptive-requeue path: the re-granted session replays its
+    workload from the top, so its stage identities must restart at
+    seq 0 for the resume to match the committed directories."""
+    _STAGE_SEQ.pop(sid, None)
 
 
 def plan_token(*parts) -> str:
@@ -554,6 +572,13 @@ class Stage:
         _STATS["bytes_checkpointed"] += nbytes
         timing.add_bytes("ckpt.write", nbytes)
         timing.bump("ckpt.piece_committed")
+        # per-tenant durable-progress accounting: the scheduler's
+        # no-progress guard keys off pieces committed since the last
+        # preemption (docs/serving.md)
+        from .scheduler import current_session
+        sess = current_session()
+        if sess is not None:
+            sess.pieces_committed += 1
 
     def _write_pages(self, i: int, table, corrupt: bool):
         from ..utils.host import host_shard_blocks
@@ -836,7 +861,25 @@ def drain_requested(env) -> bool:
     preemption notice arrived somewhere.  With checkpointing unarmed
     the SIGTERM flag changes nothing — no drain, no writes, no
     collectives (the happy-path contract, asserted in
-    tests/test_checkpoint.py)."""
+    tests/test_checkpoint.py).
+
+    A serving session the scheduler flagged for a PREEMPTIVE or FLEET
+    drain (docs/serving.md) exits through the same poll: the flag is
+    one thread-local read (zero cost for unflagged tenants), the vote
+    rides the identical session-namespaced wire, and the
+    ``sched.preempt`` injector site fires here so a SIGKILL *during* a
+    preemption drain is a constructible chaos schedule."""
+    from .scheduler import current_session
+    sess = current_session()
+    if (sess is not None and sess._drain_mode is not None and enabled()):
+        from . import recovery
+        kind = recovery.maybe_inject("sched.preempt",
+                                     intercept=("stall",))
+        if kind == "stall":
+            # widen the drain window for kill/term races in chaos
+            # schedules — the stall is injected, never organic
+            time.sleep(0.25)
+        return recovery.drain_consensus(getattr(env, "mesh", None), True)
     from . import preempt
     if not (preempt.armed() and enabled()):
         return False
@@ -856,10 +899,17 @@ def drain_abort(label: str) -> None:
     token = flush_for_abort(label)
     recovery._record(label, "preempt", "drain")
     timing.bump("ckpt.preempt_drain")
-    left = preempt.remaining_s()
+    g = preempt.grace_seconds()
+    if g is not None:
+        left = preempt.remaining_s()
+        why = (f"preemption notice received (grace {g:g}s"
+               f"{'' if left is None else f', {left:.1f}s left'})")
+    else:
+        # scheduler-initiated drain (preemptive requeue / fleet
+        # resize): no OS grace budget is armed
+        why = "scheduler drain requested"
     raise ResumableAbort(
-        f"{label}: preemption notice received (grace "
-        f"{preempt.grace_seconds():g}s{'' if left is None else f', {left:.1f}s left'}) "
+        f"{label}: {why} "
         "— current stage flushed and committed; rerun with "
         f"CYLON_TPU_RESUME=1 to fast-forward (resume token: {token}); a "
         "different world size re-shards committed state automatically",

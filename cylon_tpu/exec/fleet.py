@@ -1,0 +1,146 @@
+"""Elastic serving fleet control: chaos-proven mesh resize under live
+traffic (docs/serving.md, "Preemption & elastic serving").
+
+The serving scheduler multiplexes many tenants over ONE mesh whose
+world size is fixed at process launch; resizing the fleet therefore
+means draining the whole box and relaunching at the new world — the
+same planned-scale-down protocol the preemption grace path uses
+(exec/preempt → exec/checkpoint), but DRIVEN BY LOAD instead of a
+SIGTERM.  :class:`ResizeController` is that driver: the scheduler
+polls :meth:`maybe_resize` once per baton turn, and when the local
+pressure signals (admission queue depth, realized ledger pressure)
+say the current world is wrong AND at least a minimum amount of work
+has been durably committed, the controller engages the scheduler's
+all-or-nothing fleet drain:
+
+* every RUNNING tenant is flagged; each drains at its own next
+  checkpoint boundary (commits, raises typed ``ResumableAbort`` —
+  rank-coherent over the session-namespaced drain wire);
+* PENDING tenants fail typed-resumable (nothing committed, a resume
+  simply recomputes them);
+* the caller observes ``scheduler.resize_target`` set, writes nothing
+  else, and exits ``RESUMABLE_EXIT``; the supervisor relaunches at the
+  new world with ``CYLON_TPU_RESUME=1`` and every tenant resumes —
+  same-topology stages fast-forward bit-identically, different-world
+  stages take the PR 9 base-token re-shard path.
+
+**All-or-nothing, voted.**  Realized ledger pressure is rank-LOCAL, so
+in multiprocess sessions the engage decision is agreed over the count
+wire (max target wins — if ANY rank wants the resize, every rank
+drains): a rank draining its tenants while its peers keep granting
+them is exactly the desync the consensus module exists to prevent.
+The vote is entered every poll while a controller is attached
+(armed-only: attaching a controller requires durable checkpointing),
+so the vote structure is rank-uniform by construction; schedulers
+without a controller — the happy path — add zero collectives.
+
+A ``FLEET_RESIZE.json`` breadcrumb with the agreed target world lands
+in the checkpoint root next to ``RESUME_TOKEN.json`` so the relauncher
+(`scripts/chaos_soak.py --fleet`, the deploy/gke scale drill) can read
+the decision back without parsing logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..status import InvalidError
+
+
+class ResizeController:
+    """Queue-depth / ledger-pressure resize driver for the serving
+    scheduler.  Pass as ``QueryScheduler(env, fleet=...)``.
+
+    ``target_world`` is the world size to relaunch at.  The drain
+    engages when EITHER trigger fires: admission queue depth (pending
+    sessions) reaches ``queue_depth_high``, or the realized resident
+    ledger balance exceeds ``ledger_frac_high`` of the budget — and at
+    least ``min_committed_pieces`` checkpoint pieces are durable across
+    the session set (resizing a fleet that has committed nothing would
+    just be a restart).  Either trigger may be None (disabled)."""
+
+    def __init__(self, env, *, target_world: int,
+                 queue_depth_high: int | None = 2,
+                 ledger_frac_high: float | None = None,
+                 min_committed_pieces: int = 1):
+        if int(target_world) < 1:
+            raise InvalidError(
+                f"resize target world {target_world!r} must be >= 1")
+        self.env = env
+        self.target_world = int(target_world)
+        self.queue_depth_high = queue_depth_high
+        self.ledger_frac_high = ledger_frac_high
+        self.min_committed_pieces = int(min_committed_pieces)
+        self.engaged = False
+
+    # -- local pressure signals --------------------------------------------
+    def pressure(self, sched) -> dict:
+        """The rank-local observation the decision is made from (also
+        exported into the breadcrumb for postmortems)."""
+        from . import memory
+        from .session import PENDING
+        queue_depth = sum(1 for s in sched.sessions
+                          if s.state == PENDING)
+        committed = sum(s.pieces_committed for s in sched.sessions)
+        mem = memory.stats()
+        budget = memory.budget_bytes()
+        frac = (mem["ledger_bytes"] / budget) if budget > 0 else 0.0
+        return {"queue_depth": queue_depth,
+                "pieces_committed": committed,
+                "ledger_bytes": mem["ledger_bytes"],
+                "ledger_frac": round(frac, 4)}
+
+    def should_resize(self, sched) -> bool:
+        """Rank-local decision (consensus reconciles divergence)."""
+        p = self.pressure(sched)
+        if p["pieces_committed"] < self.min_committed_pieces:
+            return False
+        if (self.queue_depth_high is not None
+                and p["queue_depth"] >= self.queue_depth_high):
+            return True
+        if (self.ledger_frac_high is not None
+                and p["ledger_frac"] >= self.ledger_frac_high):
+            return True
+        return False
+
+    # -- the scheduler hook ------------------------------------------------
+    def maybe_resize(self, sched) -> bool:
+        """Polled by the scheduler loop once per baton turn.  Votes the
+        local decision over the count wire (max target wins) and, on
+        agreement, engages the all-or-nothing fleet drain.  Returns
+        True when the drain engaged this call."""
+        if self.engaged or sched._fleet_drain:
+            return False
+        from . import checkpoint
+        if not checkpoint.enabled():
+            # nothing durable to resume from: a drain now would lose
+            # work, which is the one thing this tier must never do
+            return False
+        want = self.target_world if self.should_resize(sched) else 0
+        if sched._multi():
+            from . import recovery
+            want = recovery.count_consensus(self.env.mesh, want)
+        if not want:
+            return False
+        self.engaged = True
+        info = self.pressure(sched)
+        self._write_breadcrumb(want, info)
+        sched._begin_fleet_drain(
+            want, f"queue_depth={info['queue_depth']} "
+                  f"ledger_frac={info['ledger_frac']}")
+        return True
+
+    def _write_breadcrumb(self, target_world: int, info: dict) -> None:
+        from . import checkpoint
+        root = checkpoint.ckpt_dir()
+        try:
+            os.makedirs(root, exist_ok=True)
+            path = os.path.join(root, "FLEET_RESIZE.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump({"target_world": int(target_world),
+                           "from_world": int(self.env.world_size),
+                           "pid": os.getpid(), **info}, f)
+        except OSError:
+            pass  # the committed manifests are the durable state; the
+            # breadcrumb is best-effort, like RESUME_TOKEN.json

@@ -131,11 +131,19 @@ shard_map = jax.shard_map
 #: disk→host/device promotion's verify pass (``corrupt`` simulates a
 #: failed sha check — the owner degrades to recompute, never a wrong
 #: answer; ``stall`` hangs the verify read inside the watchdog).
+#: ``sched.preempt`` fires at a serving session's preemptive/fleet
+#: drain boundary (exec/checkpoint.drain_requested, on the VICTIM's
+#: thread — so ``@session`` targets the drained tenant and ``nth``
+#: counts its own drain boundaries): ``stall`` widens the drain window,
+#: ``kill``/``term`` deliver the signal mid-drain — the chaos-soak
+#: schedule proving a crash DURING a preemption drain still resumes
+#: every tenant bit-identically (docs/serving.md, docs/robustness.md).
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
          "disk.write", "disk.read",
          "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
-         "stream.append", "stream.watermark", "obs.export")
+         "stream.append", "stream.watermark", "obs.export",
+         "sched.preempt")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
@@ -728,6 +736,23 @@ def drain_consensus(mesh: Mesh | None, local_flag: bool) -> bool:
     (one env read per boundary)."""
     local = Code.PreemptDrain if local_flag else Code.OK
     return consensus_code(mesh, local) == Code.PreemptDrain
+
+
+def preempt_consensus(mesh: Mesh | None, victim_plus1: int) -> int:
+    """Preempt-DECISION agreement (exec/scheduler._maybe_preempt): every
+    rank votes its locally chosen victim as ``ordinal + 1`` (0 = no
+    eligible victim) and the max wins, so either every rank flags the
+    SAME running tenant for a boundary drain or none does.  Policy
+    inputs like fair-share clocks are wall time and not rank-uniform —
+    without the vote one rank could drain tenant A while its peers keep
+    granting it, leaving them alone in A's next collective.  Rides the
+    count transport (one-int32 pmax, session-namespaced) under its own
+    site label; entered only when the preemptive preconditions (policy,
+    checkpointing armed, candidate blocked) hold — all rank-uniform —
+    so the happy path stays collective-free."""
+    return int(_ns_consensus(
+        mesh, min(max(int(victim_plus1), 0), (1 << 20) - 1),
+        1 << 20, "sched.preempt"))
 
 
 def count_consensus(mesh: Mesh | None, n: int) -> int:

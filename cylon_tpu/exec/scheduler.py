@@ -159,6 +159,36 @@ def spill_retry() -> int:
     return memory.spill_for_retry()
 
 
+# ---------------------------------------------------------------------------
+# admission estimates from ANALYZE history (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+#: shape family -> max observed peak-ledger bytes, recorded by
+#: obs.plan.explain_analyze(family=...) ANALYZE runs.  Admission uses
+#: min(declared, observed_peak x safety_factor) for sessions submitted
+#: with a shape_family, so a conservative declared footprint no longer
+#: serializes tenants that demonstrably co-fit.
+_FAMILY_PEAKS: dict[str, int] = {}
+
+
+def note_family_peak(family: str, peak_bytes: int) -> None:
+    """Record an observed peak-ledger-bytes sample for a shape family
+    (max-update; called by ``explain_analyze(family=...)``)."""
+    prev = _FAMILY_PEAKS.get(family, 0)
+    _FAMILY_PEAKS[family] = max(prev, int(peak_bytes))
+
+
+def observed_peak(family: str | None) -> int | None:
+    """The recorded peak for a shape family, or None when unknown."""
+    if family is None:
+        return None
+    return _FAMILY_PEAKS.get(family)
+
+
+def reset_family_history() -> None:
+    _FAMILY_PEAKS.clear()
+
+
 def estimate_footprint(*tables, factor: float = 2.0) -> int:
     """Pack-time HBM footprint estimate for a query over ``tables``
     (Tables or DataFrames): resident column bytes (data + validity),
@@ -222,9 +252,17 @@ class QueryScheduler:
     session list; failed sessions carry their exception in ``.error``
     (pass ``raise_errors=True`` to re-raise the first one)."""
 
+    #: policies under which a higher-ranked arrival may preemptively
+    #: drain a running tenant (docs/serving.md, "Preemption")
+    PREEMPTIVE_POLICIES = ("priority", "fair")
+
     def __init__(self, env, policy: str = "fair",
                  budget_bytes: int | None = None,
-                 max_concurrency: int | None = None):
+                 max_concurrency: int | None = None,
+                 admission_timeout_s: float | None = None,
+                 requeue_capacity: int | None = None,
+                 history_safety_factor: float = 1.5,
+                 fleet=None):
         if policy not in POLICIES:
             raise InvalidError(
                 f"unknown scheduling policy {policy!r}; one of "
@@ -234,18 +272,40 @@ class QueryScheduler:
         self._key = POLICIES[policy]
         self.budget_bytes = budget_bytes
         self.max_concurrency = max_concurrency
+        #: admission deadline (seconds); falls back to the
+        #: CYLON_TPU_ADMISSION_TIMEOUT_S env knob when None
+        self.admission_timeout_s = admission_timeout_s
+        #: max preempt-requeues per run; beyond it a drained tenant
+        #: stays failed TYPED (RequeueOverflowError).  None = unbounded.
+        self.requeue_capacity = requeue_capacity
+        #: multiplier on the ANALYZE-observed family peak when clamping
+        #: declared admission footprints (satellite: estimates from
+        #: history)
+        self.history_safety_factor = float(history_safety_factor)
+        #: optional exec.fleet.ResizeController polled each loop turn
+        self._fleet = fleet
         self.sessions: list[QuerySession] = []
         self._control = threading.Event()
         self._abort = False
         self._forced_admissions = 0
         self._scheduler_evictions = 0
         self._preempt_drained = 0
+        self._preemptions = 0
+        self._requeues = 0
+        self._requeue_overflows = 0
+        self._admission_timeouts = 0
+        self._fleet_drains = 0
+        self._fleet_drain = False
+        #: set by a fleet drain: the agreed new world size the caller
+        #: should relaunch at (with CYLON_TPU_RESUME=1)
+        self.resize_target: int | None = None
 
     # -- submission --------------------------------------------------------
     def submit(self, name: str, fn, *, footprint_bytes: int = 0,
                priority: int = 0, weight: float = 1.0,
                tenant: str | None = None,
-               kind: str = "query") -> QuerySession:
+               kind: str = "query", preempt_budget: int = 2,
+               shape_family: str | None = None) -> QuerySession:
         """Queue one query.  ``fn`` is a zero-arg callable executed on
         the session's thread under the baton; its return value lands in
         ``session.result``.  ``footprint_bytes`` is the pack-time HBM
@@ -263,7 +323,8 @@ class QueryScheduler:
         sess = QuerySession(name, fn, len(self.sessions),
                             footprint_bytes=footprint_bytes,
                             priority=priority, weight=weight, tenant=tenant,
-                            kind=kind)
+                            kind=kind, preempt_budget=preempt_budget,
+                            shape_family=shape_family)
         self.sessions.append(sess)
         return sess
 
@@ -294,6 +355,14 @@ class QueryScheduler:
                 if s._thread is not None:
                     s._thread.join(timeout=60.0)
             _ACTIVE = None
+        # per-tenant outcome accounting: one sched_outcome_* counter
+        # tick per finished session per lifetime (re-enterable run()s
+        # must not double-count), so "zero failed tenants" is a
+        # checkable counter (docs/serving.md)
+        for s in self.sessions:
+            if s.state in (DONE, FAILED) and not s._outcome_counted:
+                s._outcome_counted = True
+                _metrics.counter(f"sched_outcome_{s.outcome()}").inc()
         if raise_errors:
             for s in self.sessions:
                 if s.error is not None:
@@ -316,8 +385,17 @@ class QueryScheduler:
                 # ResumableAbort, so a multi-tenant box preempts as
                 # cleanly as a single query (docs/serving.md).
                 self._drain_pending()
+            elif self._fleet_drain:
+                # elastic resize in flight (exec/fleet): same protocol
+                # as the grace drain — no new admissions, pending fail
+                # typed-resumable, running sessions drain at their own
+                # boundaries; the caller relaunches at resize_target
+                self._drain_pending()
             else:
+                self._requeue_preempted()
                 self._admit_pending()
+                if self._fleet is not None and not self._fleet_drain:
+                    self._fleet.maybe_resize(self)
             running = [s for s in self.sessions if s.state == RUNNING]
             if not running:
                 if any(s.state == PENDING for s in self.sessions):
@@ -374,6 +452,23 @@ class QueryScheduler:
             return int(self.budget_bytes)
         return memory.budget_bytes()
 
+    def _admission_footprint(self, sess: QuerySession) -> int:
+        """The footprint admission charges a session: the declared
+        pack-time estimate, clamped by ANALYZE history when the
+        session's shape family has a recorded peak-ledger observation —
+        ``min(declared, observed_peak x safety_factor)`` — so a
+        conservative declared maximum no longer serializes tenants that
+        demonstrably co-fit (docs/serving.md, "Admission estimates from
+        history").  History values are recorded by
+        ``obs.plan.explain_analyze(family=...)`` and are rank-uniform
+        (every rank ran the same ANALYZE), so the clamp cannot fork
+        admission across ranks."""
+        peak = observed_peak(sess.shape_family)
+        if peak is None:
+            return sess.footprint_bytes
+        return min(sess.footprint_bytes,
+                   int(peak * self.history_safety_factor))
+
     def _fits(self, sess: QuerySession) -> bool:
         """Admission predicate: the candidate's DECLARED footprint on
         top of the running sessions' declared footprints must fit the
@@ -383,13 +478,15 @@ class QueryScheduler:
         were wrong is already handled at allocation time by the
         ledger's own admission path (``ensure_headroom`` evicts/spills
         with consensus) — gating here on the process-global balance
-        would also leak unrelated residents into every decision."""
+        would also leak unrelated residents into every decision.
+        Declared values are history-clamped per shape family
+        (:meth:`_admission_footprint`)."""
         b = self._budget()
         if b <= 0:
             return True
-        committed = sum(s.footprint_bytes for s in self.sessions
+        committed = sum(self._admission_footprint(s) for s in self.sessions
                         if s.state == RUNNING)
-        return committed + sess.footprint_bytes <= b
+        return committed + self._admission_footprint(sess) <= b
 
     def _multi(self) -> bool:
         import jax
@@ -409,7 +506,8 @@ class QueryScheduler:
         b = self._budget()
         if b <= 0:
             return
-        want = memory.ledger().evict_count_for(sess.footprint_bytes, b)
+        want = memory.ledger().evict_count_for(
+            self._admission_footprint(sess), b)
         if self._multi():
             want = recovery.count_consensus(self.env.mesh, want)
         if want <= 0:
@@ -424,20 +522,41 @@ class QueryScheduler:
                      sess.footprint_bytes)
 
     def _admit_pending(self) -> None:
+        self._expire_admissions()
         while True:
             pend = [s for s in self.sessions if s.state == PENDING]
             if not pend:
                 return
             running = [s for s in self.sessions if s.state == RUNNING]
+            cand = min(pend, key=self._key)
+            if self._multi() and any(s.requeues for s in pend):
+                # a requeued tenant's fair-share clocks are wall time
+                # and NOT rank-uniform, so once one is queued the head-
+                # of-line pick itself must be agreed (same wire as the
+                # running pick) — never-ran pendings tie at 0 and need
+                # no vote
+                from . import recovery
+                from ..status import RankDesyncError
+                agreed = recovery.count_consensus(self.env.mesh,
+                                                  cand.ordinal)
+                cand = next((s for s in pend if s.ordinal == agreed), None)
+                if cand is None:
+                    raise RankDesyncError(
+                        f"admission pick consensus chose ordinal {agreed},"
+                        " which is not pending on this rank — session "
+                        "states diverged", site="scheduler.admit")
             if (self.max_concurrency is not None
                     and len(running) >= self.max_concurrency):
+                self._maybe_preempt(cand, running)
                 self._note_wait(pend)
                 return
-            cand = min(pend, key=self._key)
             if not self._fits(cand):
                 # head-of-line admission (no overtaking): deterministic
                 # and starvation-free — smaller later queries never
-                # leapfrog a waiting tenant
+                # leapfrog a waiting tenant.  A higher-ranked candidate
+                # may instead preemptively DRAIN the lowest-ranked
+                # running tenant at its next checkpoint boundary
+                self._maybe_preempt(cand, running)
                 self._note_wait([cand])
                 return
             # the declared footprint fits; clear REALIZED residue first
@@ -446,6 +565,218 @@ class QueryScheduler:
             # session allocates anything
             self._evict_for(cand)
             self._start(cand)
+
+    # -- admission deadline ------------------------------------------------
+    def _admission_timeout(self) -> float | None:
+        """Effective admission deadline: constructor knob first, then
+        ``CYLON_TPU_ADMISSION_TIMEOUT_S``.  None / non-positive =>
+        unbounded (the pre-PR-18 behavior)."""
+        t = self.admission_timeout_s
+        if t is None:
+            import os
+            raw = os.environ.get("CYLON_TPU_ADMISSION_TIMEOUT_S")
+            if not raw:
+                return None
+            try:
+                t = float(raw)
+            except ValueError:
+                return None
+        return t if t > 0 else None
+
+    def _expire_admissions(self) -> None:
+        """Fail pending sessions whose admission wait exceeded the
+        deadline — typed (:class:`AdmissionTimeoutError`), never a
+        hang.  In multiprocess sessions wall clocks diverge, so the
+        expiry DECISION is agreed over the count wire: the vote is
+        entered whenever a deadline is configured and someone is
+        waiting (both rank-uniform facts), and the max expired ordinal
+        wins — one session per loop turn, the loop converges on the
+        rest."""
+        t = self._admission_timeout()
+        if t is None:
+            return
+        waiting = [s for s in self.sessions
+                   if s.state == PENDING and s._wait_mark is not None]
+        if not waiting:
+            return
+        now = time.perf_counter()
+        expired = [s for s in waiting if now - s._wait_mark > t]
+        if self._multi():
+            from . import recovery
+            want = max((s.ordinal + 1 for s in expired), default=0)
+            agreed = recovery.count_consensus(self.env.mesh, want)
+            expired = [s for s in waiting if s.ordinal + 1 == agreed]
+        for s in expired:
+            waited = now - s._wait_mark
+            s.admission_wait_s += waited
+            s._wait_mark = None
+            s.state = FAILED
+            from ..status import AdmissionTimeoutError
+            s.error = AdmissionTimeoutError(
+                f"session {s.name} exceeded the admission deadline "
+                f"({t:g}s) after waiting {waited:.3f}s at head of line "
+                "— failing typed instead of waiting unboundedly "
+                "(CYLON_TPU_ADMISSION_TIMEOUT_S / admission_timeout_s)",
+                session=s.name, waited_s=waited)
+            s.finished_s = time.perf_counter()
+            self._admission_timeouts += 1
+            _metrics.counter("sched_admission_timeouts").inc()
+            from ..utils.logging import log
+            log.warning("scheduler: admission deadline (%gs) expired for "
+                        "session %s after %.3fs", t, s.name, waited)
+
+    # -- preemptive scheduling (docs/serving.md) ---------------------------
+    def _pick_victim(self, cand: QuerySession,
+                     running: list[QuerySession]) -> QuerySession | None:
+        """Rank-local victim choice for a preemptive drain: the LOWEST-
+        ranked (max policy key) running query session that (a) is not
+        already draining, (b) is strictly outranked by the candidate,
+        (c) has preemption budget left, and (d) passes the no-progress
+        guard — a tenant that committed zero new pieces since its last
+        preemption is temporarily unpreemptable (otherwise a storm of
+        arrivals could starve it forever)."""
+        victims = [
+            s for s in running
+            if s.kind == "query" and s._drain_mode is None
+            and self._key(cand) < self._key(s)
+            and s.preemptions < s.preempt_budget
+            and (s.preemptions == 0
+                 or s.pieces_committed > s._progress_mark)
+        ]
+        if not victims:
+            return None
+        return max(victims, key=self._key)
+
+    def _maybe_preempt(self, cand: QuerySession,
+                       running: list[QuerySession]) -> bool:
+        """Preemption decision for a blocked higher-ranked candidate.
+        Armed-only: preemptive policies + durable checkpointing (the
+        drain rides checkpoint boundaries; without it there is nothing
+        to resume).  The decision is agreed over the session-namespaced
+        consensus wire (max victim ordinal + 1 wins; 0 = no victim)
+        BEFORE the victim is flagged, so every rank drains the same
+        tenant — the vote short-circuits to the local choice in
+        single-controller runs."""
+        if self.policy not in self.PREEMPTIVE_POLICIES:
+            return False
+        from . import checkpoint
+        if not checkpoint.enabled():
+            return False
+        from . import recovery
+        from ..status import RankDesyncError
+        victim = self._pick_victim(cand, running)
+        want = 0 if victim is None else victim.ordinal + 1
+        agreed = recovery.preempt_consensus(
+            self.env.mesh if self._multi() else None, want)
+        if not agreed:
+            return False
+        victim = next((s for s in running if s.ordinal == agreed - 1),
+                      None)
+        if victim is None:
+            raise RankDesyncError(
+                f"preempt consensus chose session ordinal {agreed - 1}, "
+                "which is not running on this rank — session states "
+                "diverged across ranks", site="sched.preempt")
+        self._begin_preempt_drain(victim, cand)
+        return True
+
+    def _begin_preempt_drain(self, victim: QuerySession,
+                             cand: QuerySession) -> None:
+        """Flag the agreed victim for a checkpoint-boundary drain: its
+        next ``checkpoint.drain_requested`` poll commits the current
+        stage and raises ResumableAbort; the requeue pass then turns
+        that into a fresh PENDING entry that fast-forwards on
+        re-grant."""
+        victim._drain_mode = "preempt"
+        victim._progress_mark = victim.pieces_committed
+        self._preemptions += 1
+        _metrics.counter("sched_preemptions").inc()
+        _trace.instant("sched.preempt", session=victim.name,
+                       by=cand.name, policy=self.policy)
+        from ..utils.logging import log
+        log.info("scheduler: preempting session %s at its next "
+                 "checkpoint boundary to admit %s (policy=%s)",
+                 victim.name, cand.name, self.policy)
+
+    def _requeue_preempted(self) -> None:
+        """Turn completed preempt drains back into PENDING sessions.
+        The drained tenant's committed pieces survive in its session-
+        namespaced checkpoint stages; ``_resume_pending`` makes its
+        next fn run resume in-process (checkpoint.resume_requested), so
+        the re-granted run fast-forwards the committed prefix
+        bit-identically.  Requeue capacity overflow is TYPED
+        (RequeueOverflowError, resume token on ``__cause__``)."""
+        for s in self.sessions:
+            if s._drain_mode is None:
+                continue
+            if s.state == DONE:
+                # flagged but finished before reaching a boundary —
+                # nothing to requeue (sessions without checkpoint
+                # stages never poll the drain; preemption is
+                # best-effort for them by design)
+                s._drain_mode = None
+                continue
+            if s.state != FAILED:
+                continue   # still draining
+            if s._drain_mode == "fleet":
+                continue   # stays failed-resumable for the relaunch
+            if not isinstance(s.error, ResumableAbort):
+                s._drain_mode = None   # real failure mid-drain: keep it
+                continue
+            if (self.requeue_capacity is not None
+                    and self._requeues >= self.requeue_capacity):
+                from ..status import RequeueOverflowError
+                err = RequeueOverflowError(
+                    f"session {s.name} drained resumably but the "
+                    f"requeue capacity ({self.requeue_capacity}) is "
+                    "exhausted — relaunch with CYLON_TPU_RESUME=1 to "
+                    "resume it", session=s.name)
+                err.__cause__ = s.error
+                s.error = err
+                s._drain_mode = None
+                self._requeue_overflows += 1
+                _metrics.counter("sched_requeue_overflows").inc()
+                continue
+            from . import checkpoint
+            checkpoint.reset_session_stages(s.name)
+            s._drain_mode = None
+            s.preemptions += 1
+            s.requeues += 1
+            s._progress_mark = s.pieces_committed
+            s._resume_pending = True
+            s.state = PENDING
+            s.error = None
+            s.finished_s = None
+            s._thread = None
+            s._grant = threading.Event()
+            self._requeues += 1
+            _metrics.counter("sched_requeues").inc()
+            _trace.instant("sched.requeue", session=s.name)
+
+    # -- elastic fleet drain (exec/fleet) ----------------------------------
+    def _begin_fleet_drain(self, target_world: int, reason: str) -> None:
+        """All-or-nothing elastic drain: every running tenant drains at
+        its next checkpoint boundary (same flag the preempt path uses,
+        but WITHOUT requeue — the resumes happen in the relaunched
+        process at the new world), pending tenants fail
+        typed-resumable, and the caller exits RESUMABLE_EXIT with
+        ``resize_target`` set."""
+        if self._fleet_drain:
+            return
+        self._fleet_drain = True
+        self.resize_target = int(target_world)
+        self._fleet_drains += 1
+        _metrics.counter("sched_fleet_drains").inc()
+        for s in self.sessions:
+            if s.state == RUNNING and s._drain_mode is None:
+                s._drain_mode = "fleet"
+        _trace.instant("sched.fleet_drain", target_world=target_world,
+                       reason=reason)
+        from ..utils.logging import log
+        log.warning("scheduler: elastic fleet drain engaged (%s) — "
+                    "draining all tenants at their boundaries, relaunch "
+                    "at world=%d with CYLON_TPU_RESUME=1",
+                    reason, target_world)
 
     def _note_wait(self, sessions) -> None:
         now = time.perf_counter()
@@ -459,6 +790,18 @@ class QueryScheduler:
         cand = min(pend, key=self._key)
         self._forced_admissions += 1
         _metrics.counter("sched_forced_admissions").inc()
+        # force-degrade-to-serial is a distinct serving condition from a
+        # plain forced admission start: count it under its own name and
+        # close the candidate's open wait period HERE — _start would
+        # also close it, but a force-serial grant that raced the wait
+        # bookkeeping used to leave the period open (stale
+        # admission_wait_s) when the candidate was force-admitted on
+        # the same loop turn it was first noted waiting
+        _metrics.counter("sched_admission_force_serial").inc()
+        now = time.perf_counter()
+        if cand._wait_mark is not None:
+            cand.admission_wait_s += now - cand._wait_mark
+            cand._wait_mark = None
         from ..utils.logging import log
         log.warning("scheduler: nothing running and session %s "
                     "(footprint %d B) cannot fit the budget — force-"
@@ -587,6 +930,11 @@ class QueryScheduler:
         detail rides each session's ``summary()``)."""
         from . import memory
         mem = memory.stats()
+        outcomes: dict[str, int] = {}
+        for s in self.sessions:
+            if s.state in (DONE, FAILED):
+                o = s.outcome()
+                outcomes[o] = outcomes.get(o, 0) + 1
         return {
             "policy": self.policy,
             "sessions": len(self.sessions),
@@ -599,8 +947,16 @@ class QueryScheduler:
             "admission_wait_s": round(sum(s.admission_wait_s
                                           for s in self.sessions), 4),
             "forced_admissions": self._forced_admissions,
+            "admission_force_serial": self._forced_admissions,
+            "admission_timeouts": self._admission_timeouts,
             "scheduler_evictions": self._scheduler_evictions,
             "preempt_drained": self._preempt_drained,
+            "preemptions": self._preemptions,
+            "requeues": self._requeues,
+            "requeue_overflows": self._requeue_overflows,
+            "fleet_drains": self._fleet_drains,
+            "resize_target": self.resize_target,
+            "outcomes": outcomes,
             "resumable_aborts": sum(1 for s in self.sessions
                                     if isinstance(s.error, ResumableAbort)),
             "cross_session_evictions": mem["cross_session_evictions"],

@@ -53,7 +53,8 @@ class QuerySession:
     def __init__(self, name: str, fn, ordinal: int, *,
                  footprint_bytes: int = 0, priority: int = 0,
                  weight: float = 1.0, tenant: str | None = None,
-                 kind: str = "query"):
+                 kind: str = "query", preempt_budget: int = 2,
+                 shape_family: str | None = None):
         if "/" in name or name != name.strip() or not name:
             raise ValueError(
                 f"session name {name!r} must be a non-empty path-safe "
@@ -71,6 +72,14 @@ class QuerySession:
         if self.weight <= 0:
             raise ValueError("session weight must be > 0")
         self.tenant = tenant or name
+        #: max times this session may be preemptively drained; beyond
+        #: the budget it becomes unpreemptable (storm bound)
+        self.preempt_budget = int(preempt_budget)
+        #: admission shape family: when ANALYZE history has recorded a
+        #: peak-ledger observation for this family, admission uses
+        #: min(declared, observed_peak x safety_factor) instead of the
+        #: declared maximum (docs/serving.md, "Admission contract")
+        self.shape_family = shape_family
         self.state = PENDING
         self.result = None
         self.error: BaseException | None = None
@@ -87,11 +96,26 @@ class QuerySession:
         self.submitted_s = time.perf_counter()
         self.started_s: float | None = None
         self.finished_s: float | None = None
+        # preemption / requeue accounting (scheduler-owned)
+        self.preemptions = 0       # completed preempt-drain cycles
+        self.requeues = 0          # times requeued after a drain
+        self.pieces_committed = 0  # checkpoint pieces durably committed
         # baton machinery (scheduler-owned)
         self._thread: threading.Thread | None = None
         self._grant = threading.Event()
         self._slice_t0 = 0.0
         self._wait_mark: float | None = None  # admission-wait start
+        #: None, "preempt" (drain + requeue) or "fleet" (drain, stay
+        #: failed-resumable for a cross-process relaunch) — set by the
+        #: scheduler, polled by checkpoint.drain_requested at boundaries
+        self._drain_mode: str | None = None
+        #: pieces_committed snapshot at the last preemption — the
+        #: no-progress guard compares against it before re-preempting
+        self._progress_mark = 0
+        #: requeued session: next fn run resumes in-process (read by
+        #: checkpoint.resume_requested on the session thread)
+        self._resume_pending = False
+        self._outcome_counted = False
 
     # -- derived metrics ---------------------------------------------------
     @property
@@ -108,6 +132,24 @@ class QuerySession:
         if self.timing is not None:
             return self.timing.total_seconds()
         return self.service_s
+
+    def outcome(self) -> str:
+        """Per-tenant outcome bucket (docs/serving.md): ``completed`` /
+        ``preempted_requeued`` (finished, but only after >= 1 preempt
+        cycle) / ``drained_resumable`` (failed with a ResumableAbort —
+        committed work survives, a relaunch resumes it) /
+        ``failed_typed`` / ``failed_untyped``; unfinished sessions
+        report their lifecycle state."""
+        from ..status import CylonError, ResumableAbort
+        if self.state == DONE:
+            return "preempted_requeued" if self.preemptions else "completed"
+        if self.state == FAILED:
+            if isinstance(self.error, ResumableAbort):
+                return "drained_resumable"
+            if isinstance(self.error, CylonError):
+                return "failed_typed"
+            return "failed_untyped"
+        return self.state
 
     # -- isolation audits --------------------------------------------------
     def recovery_events(self) -> list[dict]:
@@ -133,6 +175,10 @@ class QuerySession:
             "admission_wait_s": round(self.admission_wait_s, 4),
             "bytes_admitted": self.bytes_admitted,
             "slices": self.slices,
+            "preemptions": self.preemptions,
+            "requeues": self.requeues,
+            "pieces_committed": self.pieces_committed,
+            "outcome": self.outcome(),
             "service_s": round(self.service_s, 4),
             "latency_s": (round(self.latency_s, 4)
                           if self.latency_s is not None else None),

@@ -484,7 +484,8 @@ def explain(fn, *args, **kwargs) -> QueryPlan:
 
 
 def explain_analyze(fn, *args, reset_timings: bool = True,
-                    profile_keys: bool = True, **kwargs) -> QueryPlan:
+                    profile_keys: bool = True,
+                    family: str | None = None, **kwargs) -> QueryPlan:
     """:func:`explain` plus measurements: arms ``config.BENCH_TIMINGS``
     for the duration (restoring the caller's flags), resets the global
     phase table (``reset_timings=False`` to accumulate instead), runs
@@ -497,7 +498,14 @@ def explain_analyze(fn, *args, reset_timings: bool = True,
     host pulls of its own.  bench.py's profiled iteration uses this so
     its ``profiled_iter_s``/phase split stay comparable with
     pre-profiler rounds (the BENCH_rNN baselines) and the async-mode
-    one-designated-block contract holds."""
+    one-designated-block contract holds.
+
+    ``family`` names the query's admission SHAPE FAMILY: after the run
+    the observed peak-ledger bytes are recorded against it
+    (:func:`cylon_tpu.exec.scheduler.note_family_peak`), and serving
+    sessions submitted with the same ``shape_family`` are admitted at
+    ``min(declared, observed_peak x safety_factor)`` — ANALYZE history
+    replacing the conservative declared maximum (docs/serving.md)."""
     from .. import config
     from ..utils import timing
     from . import comm
@@ -517,6 +525,10 @@ def explain_analyze(fn, *args, reset_timings: bool = True,
         config.BENCH_TIMINGS = prev
     if comm.armed():
         prof.comm = comm.report()
+    if family is not None:
+        from ..exec import memory, scheduler
+        scheduler.note_family_peak(
+            family, int(memory.stats()["peak_ledger_bytes"]))
     return prof
 
 

@@ -183,6 +183,42 @@ class ResumableAbort(CylonError):
         self.token = token
 
 
+class AdmissionTimeoutError(CylonError):
+    """A pending serving session exceeded the admission deadline
+    (``CYLON_TPU_ADMISSION_TIMEOUT_S`` or the scheduler's
+    ``admission_timeout_s``) while waiting at the head of line: the
+    tenant is failed TYPED instead of waiting unboundedly behind a
+    long-running co-tenant (docs/serving.md, "Admission deadline").
+    Rank-coherent under multi-controller runs — the expiry decision
+    rides the count-consensus wire, so every rank fails the same
+    session."""
+
+    code = Code.ExecutionError
+    kind = "admission_timeout"
+
+    def __init__(self, msg: str = "", session: str | None = None,
+                 waited_s: float | None = None):
+        super().__init__(msg)
+        self.session = session
+        self.waited_s = waited_s
+
+
+class RequeueOverflowError(CylonError):
+    """A preempted tenant drained resumably but the scheduler's requeue
+    capacity was already exhausted: the tenant stays failed TYPED with
+    its resume token preserved on ``__cause__`` (the original
+    :class:`ResumableAbort`), so an operator can relaunch it with
+    ``CYLON_TPU_RESUME=1`` instead of silently losing the work
+    (docs/serving.md, "Preemption & elastic serving")."""
+
+    code = Code.CapacityError
+    kind = "requeue_overflow"
+
+    def __init__(self, msg: str = "", session: str | None = None):
+        super().__init__(msg)
+        self.session = session
+
+
 class CheckpointCorruptError(CylonError):
     """A checkpoint page or manifest failed its content-hash check (or
     an injected ``corrupt`` fault simulated that) on the resume path:

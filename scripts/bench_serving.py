@@ -29,7 +29,9 @@ Usage::
 
     python scripts/bench_serving.py                    # 4 tenants
     python scripts/bench_serving.py --tenants 6 --queries 4 \
-        --policy fair --budget-mb 24 --out SERVING_r02.json
+        --policy fair --budget-mb 24 --out SERVING_rNN.json
+    python scripts/bench_serving.py --tenants 64 --smoke --preempt 8 \
+        --slo-ms 2000 --out SERVING_r02.json   # preemptive serving round
 
 Exit status 0 = completed and bit-equal; 1 otherwise.  A trimmed run is
 wired as a slow-marked test (tests/test_scheduler.py).
@@ -114,14 +116,26 @@ def _make_qpipe(env, dfs):
     return qpipe
 
 
-def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record, hist=None):
+def _tenant_fn(name, mix, queries, dfs, env, qfuncs, record, hist=None,
+               on_start=None):
     """Closed loop: cycle the mix for ``queries`` iterations, recording
     (query, latency, sha) into ``record`` as each completes.  ``hist``
     (concurrent pass only) is the tenant's streaming latency histogram
     in the metrics registry (cylon_tpu.obs) — the SLO-attainment
     source, bit-consistent with the sorted-list quantiles by the
-    histogram's exact-sample contract."""
+    histogram's exact-sample contract.
+
+    The fn RESETS its record and histogram on entry: a preempted tenant
+    is requeued and its fn replayed from the top (committed qpipe
+    pieces fast-forward), so stale partial observations from the
+    drained attempt must not double-count — bit-equality compares the
+    LAST full replay against the solo oracle."""
     def fn():
+        if on_start is not None:
+            on_start()
+        record.clear()
+        if hist is not None:
+            hist.reset()
         for k in range(queries):
             qname = mix[k % len(mix)]
             t0 = time.perf_counter()
@@ -148,13 +162,25 @@ def _percentile(xs, p):
 
 def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
                 policy: str = "fair", budget_mb=None, world: int = 4,
-                seed: int = 0, slo_ms: float | None = None) -> dict:
+                seed: int = 0, slo_ms: float | None = None,
+                preempt_tenants: int = 0,
+                ckpt_dir: str | None = None) -> dict:
     """Drive the bench in-process and return the report dict (the CLI
     wraps this; tests call it directly with trimmed parameters).
     ``budget_mb``: None = unlimited (no pressure), "auto" = ~2.2 tenant
     footprints (the acceptance configuration), or explicit MiB.
     ``slo_ms``: per-query latency SLO target — each tenant's report
-    then carries its attainment fraction from the latency histogram."""
+    then carries its attainment fraction from the latency histogram.
+
+    ``preempt_tenants``: hold back the LAST N tenants and submit them
+    from inside the first tenant's closed loop at priority 5 — a
+    high-priority arrival against an already-running fleet, which is
+    the preemptive-scheduling trigger (docs/serving.md).  Requires a
+    preemptive policy and ``ckpt_dir`` (victims drain at checkpoint
+    boundaries and requeue; without durable stages preemption is
+    flag-only best-effort).  ``ckpt_dir`` is armed for the CONCURRENT
+    pass only — the solo oracle stays unarmed so the bit-equality
+    baseline carries zero checkpoint machinery."""
     import jax
     import cylon_tpu as ct
     from cylon_tpu import config, obs, tpch
@@ -209,10 +235,17 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
     else:
         budget = int(float(budget_mb) * (1 << 20))
         ledger_budget = budget
+    preempt_tenants = min(int(preempt_tenants), max(tenants - 1, 0))
+    if preempt_tenants and policy not in QueryScheduler.PREEMPTIVE_POLICIES:
+        raise ValueError(f"preempt_tenants requires a preemptive policy "
+                         f"({QueryScheduler.PREEMPTIVE_POLICIES}), "
+                         f"got {policy!r}")
     prev_budget = config.HBM_BUDGET_BYTES
+    prev_ckpt = os.environ.get("CYLON_TPU_CKPT_DIR")
     memory.reset_stats()
     recovery.reset_events()
     checkpoint.reset_stats()
+    checkpoint.reset_stages()
     records: dict[str, list] = {p["name"]: [] for p in plans}
     sched = QueryScheduler(env, policy=policy,
                            budget_bytes=budget or None)
@@ -221,19 +254,45 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
         # gates on the config budget
         config.HBM_BUDGET_BYTES = ledger_budget
     obs.metrics.reset("serving_latency")   # fresh histograms per round
+
+    early = plans[:tenants - preempt_tenants]
+    late = plans[tenants - preempt_tenants:]
+
+    def _submit(p, priority=0, on_start=None):
+        sched.submit(p["name"],
+                     _tenant_fn(p["name"], p["mix"], queries, dfs,
+                                env, qfuncs, records[p["name"]],
+                                hist=obs.histogram(
+                                    f"serving_latency_{p['name']}"),
+                                on_start=on_start),
+                     footprint_bytes=p["footprint"], priority=priority)
+
+    fired = []
+
+    def _submit_late():
+        # runs on the first tenant's thread (under the baton); guarded
+        # so a requeued replay of that tenant does not resubmit
+        if fired or not late:
+            return
+        fired.append(True)
+        for p in late:
+            _submit(p, priority=5)
+
     try:
-        for p in plans:
-            sched.submit(p["name"],
-                         _tenant_fn(p["name"], p["mix"], queries, dfs,
-                                    env, qfuncs, records[p["name"]],
-                                    hist=obs.histogram(
-                                        f"serving_latency_{p['name']}")),
-                         footprint_bytes=p["footprint"])
+        if ckpt_dir is not None:
+            os.environ["CYLON_TPU_CKPT_DIR"] = ckpt_dir
+        for i, p in enumerate(early):
+            _submit(p, on_start=_submit_late if i == 0 else None)
         t0 = time.perf_counter()
         sessions = sched.run()
         elapsed = time.perf_counter() - t0
     finally:
         config.HBM_BUDGET_BYTES = prev_budget
+        if ckpt_dir is not None:
+            if prev_ckpt is None:
+                os.environ.pop("CYLON_TPU_CKPT_DIR", None)
+            else:
+                os.environ["CYLON_TPU_CKPT_DIR"] = prev_ckpt
 
     # ---- verdicts + metrics ---------------------------------------------
     failures = []
@@ -339,15 +398,38 @@ def main() -> int:
                     help="per-query latency SLO target (ms): per-tenant "
                          "attainment is reported from the latency "
                          "histogram registry (docs/observability.md)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="trimmed acceptance smoke: caps queries/tenant "
+                         "at 2 and scale at SF0.004 (tenant count is NOT "
+                         "trimmed — the slow-lane test runs 64)")
+    ap.add_argument("--preempt", type=int, default=0, metavar="N",
+                    help="hold back the last N tenants and submit them "
+                         "mid-run at priority 5 (forces --policy "
+                         "priority and arms --ckpt-dir so victims "
+                         "drain at boundaries and requeue)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint root for the concurrent pass "
+                         "(default with --preempt: a fresh temp dir)")
     ap.add_argument("--out", default=os.path.join(REPO,
                                                   "SERVING_r01.json"))
     args = ap.parse_args()
+
+    if args.smoke:
+        args.queries = min(args.queries, 2)
+        args.scale = min(args.scale, 0.004)
+    ckpt_dir = args.ckpt_dir
+    if args.preempt:
+        args.policy = "priority"
+        if ckpt_dir is None:
+            import tempfile
+            ckpt_dir = tempfile.mkdtemp(prefix="cylon_serving_ckpt_")
 
     budget = None if args.budget_mb in ("none", "0") else args.budget_mb
     report = run_serving(tenants=args.tenants, queries=args.queries,
                          scale=args.scale, policy=args.policy,
                          budget_mb=budget, world=args.world,
-                         seed=args.seed, slo_ms=args.slo_ms)
+                         seed=args.seed, slo_ms=args.slo_ms,
+                         preempt_tenants=args.preempt, ckpt_dir=ckpt_dir)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     d = report["detail"]
@@ -357,6 +439,9 @@ def main() -> int:
           f"cross_session_evictions="
           f"{d['spill']['cross_session_evictions']} "
           f"spill_events={d['spill']['spill_events']}")
+    print(f"# preemptions={d['scheduler']['preemptions']} "
+          f"requeues={d['scheduler']['requeues']} "
+          f"outcomes={d['scheduler']['outcomes']}")
     print(f"# wrote {args.out}")
     return 0 if (d["bit_equal"] and not d["failures"]) else 1
 

@@ -214,6 +214,9 @@ def worker(args) -> int:
     if args.multislice:
         return _worker_topo(args, env)
 
+    if args.fleet:
+        return _worker_fleet(args, env, make_workload)
+
     if args.concurrent > 1:
         return _worker_concurrent(args, env, make_workload)
 
@@ -1062,6 +1065,137 @@ def _worker_concurrent(args, env, make_workload) -> int:
     return 0
 
 
+def _worker_fleet(args, env, make_workload) -> int:
+    """One ``--fleet`` worker process (docs/serving.md, "Preemption &
+    elastic serving").  The case rides ``CYLON_TPU_FLEET_CASE``:
+
+    * ``preempt`` — tA (long, low priority) submits tB (short, high
+      priority) from inside its own first run; under
+      ``policy=priority`` + ``max_concurrency=1`` the scheduler
+      preempt-drains tA at its next checkpoint boundary, runs tB, then
+      requeues tA which resumes in-process (fast-forward > 0).  Solo
+      oracles are computed in-process with checkpointing popped, so
+      ``bit_equal`` is decided right here.
+    * ``resize`` — three tenants under a ResizeController
+      (``CYLON_TPU_FLEET_TARGET`` armed): sustained queue depth
+      engages the all-or-nothing fleet drain; the worker exits
+      RESUMABLE_EXIT with zero failed_typed tenants, and the SAME case
+      relaunched without the target (at the new ``--world``) resumes
+      every tenant to a bit-equal finish.
+    * ``deadline`` — ``CYLON_TPU_ADMISSION_TIMEOUT_S`` armed, fifo,
+      one slot: the queued tenant must fail typed
+      (AdmissionTimeoutError), never hang.
+    """
+    from cylon_tpu.exec import checkpoint
+    from cylon_tpu.exec.fleet import ResizeController
+    from cylon_tpu.exec.scheduler import QueryScheduler
+    from cylon_tpu.status import AdmissionTimeoutError, ResumableAbort
+
+    case = os.environ.get("CYLON_TPU_FLEET_CASE", "preempt")
+
+    def df_of(seed, rows, nc):
+        out = make_workload(seed, rows)(nc)
+        return out.to_pandas().sort_values("l_orderkey") \
+            .reset_index(drop=True)
+
+    # tenant specs: (name, seed, rows, chunks) — tA long (many drain
+    # boundaries), tB short (the high-priority arrival)
+    specs = {
+        "tA": (20260803, args.rows, args.chunks + 2),
+        "tB": (20260810, max(args.rows // 3, 256), 2),
+        "tC": (20260817, args.rows, args.chunks),
+    }
+
+    # solo oracles, computed in-process with durable checkpointing (and
+    # any resume request) popped so they neither write stages nor
+    # fast-forward from the scheduler runs' stages
+    saved = {k: os.environ.pop(k, None)
+             for k in ("CYLON_TPU_CKPT_DIR", "CYLON_TPU_RESUME")}
+    solo = {name: _result_sha(df_of(*spec))
+            for name, spec in specs.items()}
+    for k, v in saved.items():
+        if v is not None:
+            os.environ[k] = v
+
+    def finish(sched, extra) -> int:
+        shas, outcomes = {}, sched.stats()["outcomes"]
+        for s in sched.sessions:
+            if isinstance(s.error, ResumableAbort):
+                print(json.dumps({
+                    "resumable": True, "token": s.error.token,
+                    "session": s.name, "outcomes": outcomes,
+                    "failed_typed": outcomes.get("failed_typed", 0),
+                    "resize_target": sched.resize_target, **extra}),
+                    flush=True)
+                return RESUMABLE_EXIT
+            if s.error is not None:
+                raise s.error
+            shas[s.name] = _result_sha(s.result)
+        print(json.dumps({
+            "ok": True, "shas": shas,
+            "bit_equal": all(shas[n] == solo[n] for n in shas),
+            "outcomes": outcomes,
+            "failed_typed": outcomes.get("failed_typed", 0),
+            "preemptions": sched.stats()["preemptions"],
+            "requeues": sched.stats()["requeues"],
+            "resize_target": sched.resize_target,
+            **checkpoint.stats(), **extra}), flush=True)
+        return 0
+
+    if case == "preempt":
+        sched = QueryScheduler(env, policy="priority", max_concurrency=1)
+        runs = {"n": 0}
+        fnA = lambda: df_of(*specs["tA"])  # noqa: E731
+
+        def tA():
+            runs["n"] += 1
+            if runs["n"] == 1:
+                # the high-priority arrival lands MID-TRAFFIC: tA's own
+                # first slice submits it
+                sched.submit("tB", lambda: df_of(*specs["tB"]),
+                             priority=5)
+            return fnA()
+
+        sched.submit("tA", tA)
+        sched.submit("tC", lambda: df_of(*specs["tC"]))
+        sched.run()
+        return finish(sched, {"case": case})
+
+    if case == "resize":
+        target = int(os.environ.get("CYLON_TPU_FLEET_TARGET", "0") or 0)
+        fleet = (ResizeController(env, target_world=target,
+                                  queue_depth_high=2)
+                 if target > 0 else None)
+        sched = QueryScheduler(env, policy="fair", max_concurrency=1,
+                               fleet=fleet)
+        for name in ("tA", "tB", "tC"):
+            sched.submit(name, lambda n=name: df_of(*specs[n]))
+        sched.run()
+        return finish(sched, {"case": case, "world": args.world})
+
+    if case == "deadline":
+        sched = QueryScheduler(env, policy="fifo", max_concurrency=1)
+        sched.submit("tA", lambda: df_of(*specs["tA"]))
+        sched.submit("tB", lambda: df_of(*specs["tB"]))
+        sched.run()
+        a = sched.sessions[0]
+        b = sched.sessions[1]
+        outcomes = sched.stats()["outcomes"]
+        print(json.dumps({
+            "ok": a.state == "done" and b.state == "failed",
+            "timeout_typed": isinstance(b.error, AdmissionTimeoutError),
+            "tA_bit_equal": (a.result is not None
+                             and _result_sha(a.result) == solo["tA"]),
+            "outcomes": outcomes,
+            "admission_timeouts": sched.stats()["admission_timeouts"],
+            "case": case}), flush=True)
+        return 0
+
+    print(json.dumps({"ok": False,
+                      "error": f"unknown fleet case {case!r}"}))
+    return 1
+
+
 # ---------------------------------------------------------------------------
 # parent: schedule generation + child supervision
 # ---------------------------------------------------------------------------
@@ -1130,7 +1264,7 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
            only: int | None = None, stream: bool = False,
            elastic: bool = False, world: int | None = None,
            skew: bool = False, skew_frac: float = 0.8,
-           multislice: bool = False) -> tuple:
+           multislice: bool = False, fleet: bool = False) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
@@ -1139,7 +1273,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
     # cap or re-route the baseline legs
     for k in ("CYLON_TPU_HBM_BUDGET", "CYLON_TPU_HOST_BUDGET",
               "CYLON_TPU_SPILL_DIR", "CYLON_TPU_SLICES",
-              "CYLON_TPU_TOPO_SHUFFLE"):
+              "CYLON_TPU_TOPO_SHUFFLE", "CYLON_TPU_FLEET_CASE",
+              "CYLON_TPU_FLEET_TARGET", "CYLON_TPU_ADMISSION_TIMEOUT_S"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -1167,6 +1302,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
         cmd += ["--skew", f"--skew-frac={skew_frac}"]
     if multislice:
         cmd.append("--multislice")
+    if fleet:
+        cmd.append("--fleet")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -1289,6 +1426,114 @@ def run_concurrent(args) -> int:
     return 1 if failures else 0
 
 
+def run_fleet(args) -> int:
+    """The ``--fleet`` acceptance flow (docs/serving.md): four pinned
+    legs proving fleet survival under live traffic — (1) a priority
+    arrival preempts a running tenant which requeues and finishes
+    bit-equal with ffwd > 0; (2) SIGKILL *during* the preemption drain
+    (the new ``sched.preempt`` injector site) → relaunch resumes every
+    tenant bit-equal; (3) elastic mesh resize world 4→2 mid-traffic
+    with ZERO failed tenants (``failed_typed == 0``, every tenant
+    bit-equal to its solo run after the cross-world resume); (4) the
+    admission-deadline leg surfaces a typed AdmissionTimeoutError, not
+    a hang."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_fleet_")
+    failures: list = []
+
+    def fail(msg, p=None):
+        if p is not None:
+            print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        failures.append(msg)
+        print(f"# FAIL: {msg}", flush=True)
+
+    # -- leg 1: preempt -> requeue -> resume, bit-equal ------------------
+    d1 = os.path.join(args.workdir, "preempt")
+    p, info = _spawn(args, d1, "", resume=False, fleet=True,
+                     extra_env={"CYLON_TPU_FLEET_CASE": "preempt"})
+    if p.returncode != 0 or not info or not info.get("ok"):
+        fail(f"preempt leg rc={p.returncode}: {info}", p)
+    elif (not info.get("bit_equal")
+          or info.get("preemptions", 0) < 1
+          or not info.get("resume_fast_forwarded_pieces")):
+        fail(f"preempt leg: expected bit-equal requeue with ffwd>0, "
+             f"got {info}")
+    else:
+        print(f"# preempt leg -> ok (preemptions="
+              f"{info['preemptions']}, ffwd="
+              f"{info['resume_fast_forwarded_pieces']})", flush=True)
+
+    # -- leg 2: SIGKILL during the preemption drain ----------------------
+    d2 = os.path.join(args.workdir, "killdrain")
+    p, info = _spawn(args, d2, "sched.preempt::1=kill@tA", resume=False,
+                     fleet=True,
+                     extra_env={"CYLON_TPU_FLEET_CASE": "preempt"})
+    if p.returncode != -9:
+        fail(f"kill during preemption drain did not crash the process "
+             f"(rc={p.returncode})", p)
+    else:
+        p2, info2 = _spawn(args, d2, "", resume=True, fleet=True,
+                           extra_env={"CYLON_TPU_FLEET_CASE": "preempt"})
+        if p2.returncode != 0 or not info2 or not info2.get("ok"):
+            fail(f"killdrain resume rc={p2.returncode}: {info2}", p2)
+        elif (not info2.get("bit_equal")
+              or not info2.get("resume_fast_forwarded_pieces")):
+            fail(f"killdrain resume diverged or recomputed: {info2}")
+        else:
+            print(f"# kill@drain + resume -> ok (ffwd="
+                  f"{info2['resume_fast_forwarded_pieces']})", flush=True)
+
+    # -- leg 3: elastic mesh resize world 4 -> 2, zero failed tenants ----
+    d3 = os.path.join(args.workdir, "resize")
+    p, info = _spawn(args, d3, "", resume=False, fleet=True, world=4,
+                     extra_env={"CYLON_TPU_FLEET_CASE": "resize",
+                                "CYLON_TPU_FLEET_TARGET": "2"})
+    if p.returncode != RESUMABLE_EXIT or not info \
+            or not info.get("resumable"):
+        fail(f"resize leg did not drain resumably rc={p.returncode}: "
+             f"{info}", p)
+    elif info.get("failed_typed"):
+        fail(f"resize drain failed tenants typed: {info}")
+    elif info.get("resize_target") != 2:
+        fail(f"resize drain carried wrong target: {info}")
+    else:
+        p2, info2 = _spawn(args, d3, "", resume=True, fleet=True,
+                           world=2,
+                           extra_env={"CYLON_TPU_FLEET_CASE": "resize"})
+        if p2.returncode != 0 or not info2 or not info2.get("ok"):
+            fail(f"resize resume rc={p2.returncode}: {info2}", p2)
+        elif not info2.get("bit_equal") or info2.get("failed_typed"):
+            fail(f"resize resume diverged or failed tenants: {info2}")
+        elif not info2.get("resume_world_mismatch"):
+            fail(f"resize resume never took the cross-world reshard "
+                 f"path: {info2}")
+        else:
+            print(f"# resize 4->2 + resume -> ok (world_mismatch="
+                  f"{info2['resume_world_mismatch']}, ffwd="
+                  f"{info2.get('resume_fast_forwarded_pieces', 0)})",
+                  flush=True)
+
+    # -- leg 4: admission deadline is typed, not a hang ------------------
+    d4 = os.path.join(args.workdir, "deadline")
+    p, info = _spawn(args, d4, "", resume=False, fleet=True,
+                     extra_env={"CYLON_TPU_FLEET_CASE": "deadline",
+                                "CYLON_TPU_ADMISSION_TIMEOUT_S": "0.3"})
+    if p.returncode != 0 or not info or not info.get("ok"):
+        fail(f"deadline leg rc={p.returncode}: {info}", p)
+    elif not info.get("timeout_typed") or not info.get("tA_bit_equal"):
+        fail(f"deadline leg: expected typed AdmissionTimeoutError with "
+             f"tA unharmed, got {info}")
+    else:
+        print(f"# admission deadline -> ok (typed, "
+              f"timeouts={info['admission_timeouts']})", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"fleet_legs": 4, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -1335,6 +1580,13 @@ def main() -> int:
                          "DCN messages; whole-slice kill resumes via "
                          "elastic reshard; unarmed single-slice leg "
                          "adds zero collectives)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the fleet-survival acceptance flow "
+                         "(preemptive drain/requeue with in-process "
+                         "resume, SIGKILL during a preemption drain, "
+                         "elastic mesh resize 4->2 mid-traffic with "
+                         "zero failed tenants, typed admission "
+                         "deadline)")
     ap.add_argument("--world", type=int, default=4,
                     help="(worker) mesh world size for this process")
     args = ap.parse_args()
@@ -1357,6 +1609,9 @@ def main() -> int:
 
     if args.elastic:
         return run_elastic(args)
+
+    if args.fleet:
+        return run_fleet(args)
 
     if args.concurrent > 1:
         return run_concurrent(args)

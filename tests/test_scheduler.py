@@ -198,6 +198,60 @@ class TestAdmission:
         assert s.result == 42
         assert sched.stats()["forced_admissions"] == 1
 
+    def test_force_serial_counted_and_wait_closed(self, env1):
+        """Regression (ISSUE 18 satellite): the force-degrade-to-serial
+        grant is counted under its own name AND closes the candidate's
+        open admission-wait period — it used to leave ``_wait_mark``
+        set, so a later ``summary()`` kept accruing phantom wait
+        seconds against a session that was already running."""
+        from cylon_tpu import obs
+        before = obs.counter("sched_admission_force_serial").value
+        sched = QueryScheduler(env1, budget_bytes=100)
+        s = sched.submit("huge", lambda: 42, footprint_bytes=10**9)
+        sched.run(raise_errors=True)
+        assert s.result == 42
+        assert sched.stats()["admission_force_serial"] == 1
+        assert obs.counter("sched_admission_force_serial").value \
+            == before + 1
+        assert s._wait_mark is None          # the period is CLOSED
+        assert s.outcome() == "completed"
+
+    def test_family_history_unblocks_co_fit(self, env1):
+        """Satellite (admission estimates from history): two tenants
+        declaring 600 B each against a 1000 B budget used to
+        serialize; with a recorded ANALYZE peak of 200 B for their
+        shape family, admission gates on min(declared, peak * 1.5) =
+        300 B and they co-fit — neither waits."""
+        events = []
+
+        def mk(name):
+            def fn():
+                events.append(("start", name))
+                scheduler.maybe_yield()
+                events.append(("end", name))
+            return fn
+
+        scheduler.reset_family_history()
+        scheduler.note_family_peak("mixA", 200)
+        try:
+            sched = QueryScheduler(env1, policy="fifo",
+                                   budget_bytes=1000,
+                                   history_safety_factor=1.5)
+            a = sched.submit("tA", mk("tA"), footprint_bytes=600,
+                             shape_family="mixA")
+            b = sched.submit("tB", mk("tB"), footprint_bytes=600,
+                             shape_family="mixA")
+            sched.run(raise_errors=True)
+        finally:
+            scheduler.reset_family_history()
+        # co-fit: neither tenant was ever noted waiting at admission
+        # (the identical schedule WITHOUT the family record is
+        # test_admission_wait_then_release's serialized case, where tB
+        # waits) — the baton order itself stays fifo either way
+        assert len(events) == 4
+        assert a.admission_waits == 0 and b.admission_waits == 0
+        assert sched.stats()["admission_waits"] == 0
+
     def test_cross_tenant_eviction_under_pressure(self, env1,
                                                   monkeypatch):
         """Tenant B's allocation admission evicts tenant A's cold
