@@ -107,6 +107,19 @@ function within the same module) — and flags:
   ad-hoc tier map or a post-vote mutation can put ranks into grouped
   collectives with different memberships, which deadlocks both tiers;
 
+* **TS117** raw compilation entry points outside ``utils/cache.py`` and
+  ``exec/compiler.py`` — a ``jax.jit``/``jax.pjit`` reference (as a
+  call, a decorator or a ``partial`` argument; bare ``pjit`` included)
+  or an AOT ``.lower(...).compile()`` chain anywhere else: every
+  compile must ride the compile-lifecycle facade (``utils.cache.jit``
+  deferring to ``exec/compiler.jit``, ``exec/compiler.aot_compile``)
+  so the bounded compile ledger counts the executable, the
+  compile-intent journal brackets the build (crash quarantine), the
+  watchdog bounds its wall-clock and the persistent-cache manifest can
+  hash-verify it — a raw jit is invisible to all four.  ``.compile()``
+  is only flagged when its receiver is a ``.lower(...)`` call, so
+  ``re.compile`` and friends never match;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -213,6 +226,13 @@ _SPILL_SANCTIONED_FILE = "exec/memory.py"
 #: on-disk naming: ``<owner>.a<j>.s<k>.spill.npy``)
 _SPILL_PAGE_RE = re.compile(r"\.spill(\.|$)")
 
+#: the two modules that may call jax.jit/jax.pjit or chain
+#: ``.lower(...).compile()`` directly (TS117): the cache-layer
+#: re-export and the compile-lifecycle facade it defers to — every
+#: other module compiles through them so the compile ledger, the
+#: intent journal, the watchdog and the quarantine see every compile
+_JIT_SANCTIONED_FILES = ("utils/cache.py", "exec/compiler.py")
+
 #: plan-node stack primitives callable ONLY from the obs/plan.py
 #: context-manager facade (TS113): an operator that calls push_node/
 #: pop_node directly can leave the query-scoped node stack unbalanced —
@@ -272,6 +292,16 @@ def _func_name(node: ast.expr) -> str:
 
 def _is_jit_name(name: str) -> bool:
     return name in ("jit", "jax.jit", "pjit", "jax.pjit")
+
+
+def _is_raw_jit_name(name: str) -> bool:
+    """A RAW (facade-bypassing) compilation name: dotted jax jit/pjit or
+    bare ``pjit``.  Bare ``jit`` is NOT raw — that is the facade
+    re-export operator modules bind from ``utils.cache``."""
+    parts = name.split(".")
+    return (name in ("jax.jit", "jax.pjit", "pjit")
+            or (len(parts) > 1 and parts[0] == "jax"
+                and parts[-1] in ("jit", "pjit")))
 
 
 def _is_shard_map_name(name: str) -> bool:
@@ -521,6 +551,7 @@ class _ModuleLint:
         self._check_spill_file_io()
         self._check_skew_plan()
         self._check_topo_plan()
+        self._check_raw_jit()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -1072,6 +1103,56 @@ class _ModuleLint:
             # 3. (re)bindings and dels clear the donated mark
             for name in stmt_bound(st):
                 donated.pop(name, None)
+
+    def _check_raw_jit(self) -> None:
+        """TS117: raw compilation entry points outside the
+        compile-lifecycle facade — a ``jax.jit``/``jax.pjit`` reference
+        (call, decorator, ``partial`` argument or alias; bare ``pjit``
+        too) or an AOT ``.lower(...).compile()`` chain anywhere but
+        ``utils/cache.py`` and ``exec/compiler.py``.  A raw jit's
+        executable is invisible to the bounded compile ledger, its
+        build is not bracketed by the crash-quarantine intent journal,
+        no watchdog bounds it, and the persistent-cache manifest cannot
+        hash-verify it (docs/robustness.md, docs/trace_safety.md).
+        ``.compile()`` only matches when its receiver is a
+        ``.lower(...)`` call, so ``re.compile``/``str.lower`` never
+        trip it."""
+        norm = self.path.replace(os.sep, "/")
+        if norm.endswith(_JIT_SANCTIONED_FILES):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute):
+                name = _func_name(node)
+                if _is_raw_jit_name(name):
+                    self._emit(
+                        "TS117", node,
+                        f"raw `{name}` reference outside the compile-"
+                        "lifecycle facade — compile through utils.cache."
+                        "jit (exec/compiler.jit) or exec/compiler."
+                        "aot_compile so the compile ledger, intent "
+                        "journal, watchdog and quarantine see it")
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Name)
+                        and _is_raw_jit_name(func.id)):
+                    self._emit(
+                        "TS117", node,
+                        f"raw `{func.id}(...)` call outside the compile-"
+                        "lifecycle facade — compile through utils.cache."
+                        "jit (exec/compiler.jit) so the compile ledger, "
+                        "intent journal, watchdog and quarantine see it")
+                elif (isinstance(func, ast.Attribute)
+                        and func.attr == "compile"
+                        and isinstance(func.value, ast.Call)
+                        and isinstance(func.value.func, ast.Attribute)
+                        and func.value.func.attr == "lower"):
+                    self._emit(
+                        "TS117", node,
+                        "raw `.lower(...).compile()` AOT chain outside "
+                        "the compile-lifecycle facade — use exec/"
+                        "compiler.aot_compile so the compile ledger, "
+                        "intent journal, watchdog and quarantine see "
+                        "the build")
 
     def _check_jit_sites(self) -> None:
         for node in ast.walk(self.tree):

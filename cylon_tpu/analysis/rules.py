@@ -88,6 +88,12 @@ RULES = {
              "rank-coherent Code.TopoPlan vote, so ranks can route "
              "the same exchange over different hop plans and deadlock "
              "the grouped collectives",
+    "TS117": "raw jax.jit/jax.pjit call (or .lower(...).compile() AOT "
+             "chain) outside utils/cache.py and exec/compiler.py — "
+             "compilation must ride the compile-lifecycle facade "
+             "(exec/compiler.jit via utils.cache, aot_compile) so the "
+             "compile ledger, intent journal, watchdog and quarantine "
+             "see every compile; a raw jit is invisible to all four",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

@@ -159,6 +159,39 @@ PREWARM_PIECE_PROGRAMS = _env_flag("CYLON_TPU_PREWARM", True)
 #: Round variable capacities up to powers of two to bound recompilation.
 POW2_CAPACITIES = _env_flag("CYLON_TPU_POW2_CAPS", True)
 
+#: Shape-family canonicalization at INGEST (exec/compiler.family_cap):
+#: single-controller tables pad their row capacity to the same pow2 family
+#: buckets the multi-rank distributor already uses, so N tenants with
+#: near-miss row counts share ONE compiled program per plan shape instead
+#: of compiling per-tenant.  Padding rides the existing validity lanes —
+#: results stay bit- and order-equal (tests/test_compiler.py).  The
+#: decision is a pure function of the row count (rank-uniform, no vote).
+#: ``CYLON_TPU_SHAPE_FAMILIES=0`` restores exact-shape placement.
+SHAPE_FAMILIES = _env_flag("CYLON_TPU_SHAPE_FAMILIES", True)
+
+#: Bounded in-process compile ledger (exec/compiler): maximum LIVE
+#: compiled programs per mesh across all program_cache builders; above it
+#: the facade retires least-recently-used programs (re-use recompiles,
+#: optionally warm from the persistent cache).  0 (default) = unbounded —
+#: only the per-builder PROGRAM_CACHE_SIZE LRU applies.  In multiprocess
+#: sessions the eviction count rides the count-consensus wire so every
+#: rank drops the same programs.
+COMPILE_BUDGET = int(os.environ.get("CYLON_TPU_COMPILE_BUDGET", "0"))
+
+#: Facade-owned persistent compile-cache directory (exec/compiler):
+#: houses the compile-intent journal, the quarantine ledger and the
+#: warm-manifest (and, on accelerator platforms, arms jax's own disk
+#: cache under ``<dir>/xla``).  Empty (default) = the facade's durable
+#: layer is DISARMED: zero filesystem writes on the happy path.
+COMPILE_CACHE_DIR = os.environ.get("CYLON_TPU_COMPILE_CACHE_DIR", "")
+
+#: Compile watchdog deadline in seconds (0 = off, the default): each
+#: facade-routed ``.lower()``/``.compile()``/first-trace call runs under
+#: this timeout and a hung compile surfaces as a typed
+#: CompileTimeoutError instead of wedging the rank (exec/compiler,
+#: same worker-thread pattern as the exchange watchdog).
+COMPILE_TIMEOUT_S = float(os.environ.get("CYLON_TPU_COMPILE_TIMEOUT_S", "0"))
+
 #: High-cardinality string-key crossover: columns with at least MIN_ROWS
 #: rows whose sampled distinct ratio reaches RATIO take the hashed-codes
 #: path (core.column.HashedStrings) instead of building a sorted

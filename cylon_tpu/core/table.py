@@ -76,17 +76,13 @@ class Table:
     def from_pydict(data: Mapping[str, np.ndarray], env: CylonEnv | None = None) -> "Table":
         env = env or default_env()
         cols = {k: Column.from_numpy(np.asarray(v)) for k, v in data.items()}
-        if env.world_size == 1:
-            return Table(_place_local(cols, env), env)
-        return _distribute(cols, env)
+        return _ingest(cols, env)
 
     @staticmethod
     def from_pandas(df, env: CylonEnv | None = None) -> "Table":
         env = env or default_env()
         cols = {str(k): _column_from_series(df[k]) for k in df.columns}
-        if env.world_size == 1:
-            return Table(_place_local(cols, env), env)
-        return _distribute(cols, env)
+        return _ingest(cols, env)
 
     @staticmethod
     def from_arrow(at, env: CylonEnv | None = None) -> "Table":
@@ -108,9 +104,7 @@ class Table:
         type and dictionary preserved) onto the env — the dtype-faithful
         ingest path (no pandas object round-trip)."""
         env = env or default_env()
-        if env.world_size == 1:
-            return Table(_place_local(dict(cols), env), env)
-        return _distribute(dict(cols), env)
+        return _ingest(dict(cols), env)
 
     # -- schema ------------------------------------------------------------
     @property
@@ -401,6 +395,28 @@ def _place_local(cols: dict[str, Column], env: CylonEnv) -> dict[str, Column]:
     return out
 
 
+def _ingest(cols: dict[str, Column], env: CylonEnv) -> Table:
+    """Ingest dispatch — the shape-family canonicalization gate
+    (exec/compiler.family_cap, docs/robustness.md "Compile lifecycle").
+
+    Single-controller tables historically placed EXACT shapes
+    (``_place_local``), so every distinct tenant row count compiled its
+    own program family — compile cost O(tenants).  With shape families
+    armed (the default) a world-1 ingest whose row count is not already
+    its own family representative routes through :func:`_distribute`,
+    which pow2-pads the capacity with a masked validity tail — exactly
+    what multi-rank ingest always did — so near-miss row counts share
+    one compiled program per plan shape, bit- and order-equal.
+    ``CYLON_TPU_SHAPE_FAMILIES=0`` (and already-canonical or empty
+    ingests) keep the zero-copy exact placement."""
+    if env.world_size == 1:
+        from ..exec.compiler import family_cap
+        n = len(next(iter(cols.values()))) if cols else 0
+        if family_cap(n) == n:
+            return Table(_place_local(cols, env), env)
+    return _distribute(cols, env)
+
+
 def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:
     """Split host-built columns into W contiguous row blocks, pad each to the
     common capacity, and place them sharded on the mesh.  This is the
@@ -413,6 +429,11 @@ def _distribute(cols: dict[str, Column], env: CylonEnv) -> Table:
     # pow2-bucketed capacity: bounds the family of compiled shapes across
     # ingests of varying row counts (config.POW2_CAPACITIES)
     cap = config.pow2ceil(chunk)
+    # the canonicalization decision is a pure function of (rows, world) —
+    # rank-uniform, no vote — recorded on the active plan node (no-op
+    # without a profile) so EXPLAIN output shows the family bucket
+    from ..obs.plan import annotate
+    annotate(shape_family=int(cap), ingest_rows=int(n))
     valid = np.asarray([max(0, min(chunk, n - i * chunk)) for i in range(w)],
                        np.int64)
     sharding = env.sharding()

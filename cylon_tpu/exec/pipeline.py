@@ -37,7 +37,7 @@ from jax.sharding import Mesh
 from .. import config
 from ..obs import plan as _plan
 from ..obs import trace as _trace
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -94,7 +94,7 @@ def _chunk_fn(mesh: Mesh, cap: int, step: int):
         out_v = tuple(sl(v) if v is not None else None for v in valids)
         return out_d, out_v
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
 
 
@@ -582,7 +582,7 @@ def _range_bounds_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
             sops.append(jnp.concatenate([op, sent])[jnp.clip(b, 0, cap)])
         return (b,) + tuple(sops)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
                              out_specs=(ROW,) * (1 + n_ops)))
 
 
@@ -627,7 +627,7 @@ def _probe_targets_fn(mesh: Mesh, n_ranges: int, narrow: tuple,
     sm_kwargs = _norep_kwargs() if use_pallas else {}
     jit_kwargs = {"donate_argnums": tuple(range(3, 3 + n_ops))} \
         if donate else {}
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                              out_specs=(ROW, ROW), **sm_kwargs),
                    **jit_kwargs)
 

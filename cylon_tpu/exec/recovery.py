@@ -138,12 +138,19 @@ shard_map = jax.shard_map
 #: ``kill``/``term`` deliver the signal mid-drain — the chaos-soak
 #: schedule proving a crash DURING a preemption drain still resumes
 #: every tenant bit-identically (docs/serving.md, docs/robustness.md).
+#: ``compile.build`` guards every facade-routed compile
+#: (exec/compiler._lifecycle): ``stall`` hangs the build inside the
+#: compile watchdog (typed CompileTimeoutError), ``kill`` SIGKILLs
+#: mid-compile AFTER the intent journal hit disk (the quarantine
+#: drill), and ``corrupt`` poisons the persistent warm-manifest entry
+#: the facade just wrote — the next process must drop it on the hash
+#: check (clean miss), never load wrong code.
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
          "disk.write", "disk.read",
          "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
          "stream.append", "stream.watermark", "obs.export",
-         "sched.preempt")
+         "sched.preempt", "compile.build")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
@@ -247,8 +254,10 @@ def compiler_crash_signatures() -> tuple:
         platform = jax.devices()[0].platform
         # probe compile: a working toolchain proves the backend is live
         # and tells us HOW its compiles run (in-process on CPU, helper
-        # subprocess / remote tunnel on TPU)
-        jax.jit(lambda x: x + 1)(jnp.zeros((), jnp.int32))
+        # subprocess / remote tunnel on TPU); rides the facade pinned —
+        # the probe must run even while the lifecycle is quarantining
+        from .compiler import jit as _jit
+        _jit(lambda x: x + 1, pinned=True)(jnp.zeros((), jnp.int32))
         if platform == "tpu":
             sigs.append("remote_compile")
     except Exception:  # noqa: BLE001 — no backend yet: defaults stand,
@@ -459,6 +468,17 @@ def injected(site: str) -> str | None:
     return probe(site)[0]
 
 
+def faults_declare(site: str) -> bool:
+    """True when any installed (or env-declared) spec names ``site`` —
+    a STATIC query that consumes no occurrence counter, for facades that
+    arm a guarded slow path only while their site could ever fire
+    (exec/compiler.armed)."""
+    global _FAULTS
+    if _FAULTS is None:
+        install_faults(None)
+    return any(f.site == site for f in _FAULTS)
+
+
 def make_fault(kind: str, site: str) -> Exception:
     """The typed (or deliberately foreign) exception for an injected
     fault.  ``device_oom`` returns a FOREIGN RuntimeError carrying the
@@ -597,8 +617,11 @@ def _consensus_fn(mesh: Mesh, w: int):
     def per_shard(code):
         return jax.lax.pmax(code, ROW_AXIS)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
-                             out_specs=P()))
+    # pinned: the consensus wire must never be evicted, journaled or
+    # fault-injected — it IS the mechanism coordinating those
+    from .compiler import jit as _jit
+    return _jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
+                          out_specs=P()), pinned=True)
 
 
 def _consensus_wire(mesh: Mesh | None, wire: int) -> int:

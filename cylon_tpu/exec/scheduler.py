@@ -928,8 +928,9 @@ class QueryScheduler:
     def stats(self) -> dict:
         """Serving-tier counters for bench JSON detail (per-session
         detail rides each session's ``summary()``)."""
-        from . import memory
+        from . import compiler, memory
         mem = memory.stats()
+        comp = compiler.stats()
         outcomes: dict[str, int] = {}
         for s in self.sessions:
             if s.state in (DONE, FAILED):
@@ -962,4 +963,11 @@ class QueryScheduler:
             "cross_session_evictions": mem["cross_session_evictions"],
             "spill_events": mem["spill_events"],
             "slices": sum(s.slices for s in self.sessions),
+            # the compile-lifecycle block: a serving summary always says
+            # whether tenant admission churned the executable population
+            # (flat programs_live under shape families is the multi-
+            # tenant compile-cost contract, docs/serving.md)
+            "compile": {k: comp[k] for k in
+                        ("programs_live", "cache_hits", "cache_misses",
+                         "cache_evictions", "compile_seconds")},
         }

@@ -512,9 +512,16 @@ BENCH_SPILL_KEYS = ("spill_events", "bytes_spilled", "peak_ledger_bytes",
 BENCH_CKPT_KEYS = ("checkpoint_events", "bytes_checkpointed",
                    "resume_fast_forwarded_pieces", "resume_resharded_pieces",
                    "resume_world_mismatch")
+#: the compile-lifecycle counters (exec/compiler.stats) every bench JSON
+#: carries — a bench number always says how many executables were live,
+#: how much wall-clock went to XLA, and whether the run re-used or
+#: rebuilt its program family (docs/robustness.md "Compile lifecycle")
+BENCH_COMPILE_KEYS = ("programs_live", "cache_hits", "cache_misses",
+                      "cache_evictions", "compile_seconds")
 
 
 def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
+                 compile_keys=BENCH_COMPILE_KEYS,
                  events: str | None = "drain", plan=None) -> dict:
     """The counter block every bench script previously hand-rolled:
     recovery events (``events="drain"`` empties the log like bench.py
@@ -528,7 +535,7 @@ def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
     rendered dict) adds a ``plan`` section — the EXPLAIN/ANALYZE tree
     the bench drivers emit alongside the phase table (absent by
     default, so unprofiled schemas are unchanged)."""
-    from ..exec import checkpoint, memory, recovery
+    from ..exec import checkpoint, compiler, memory, recovery
     out: dict = {}
     if events == "drain":
         out["recovery_events"] = recovery.drain_events()
@@ -538,6 +545,9 @@ def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
     out.update({k: mem[k] for k in spill_keys})
     ck = checkpoint.stats()
     out.update({k: ck[k] for k in ckpt_keys})
+    if compile_keys:
+        comp = compiler.stats()
+        out["compile"] = {k: comp[k] for k in compile_keys}
     if plan is not None:
         out["plan"] = plan.to_dict() if hasattr(plan, "to_dict") else plan
     return out

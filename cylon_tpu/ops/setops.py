@@ -22,12 +22,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..utils.cache import jit
+
 
 def _first_index_per_group(gids, idx, num_segments_cap):
     return jax.ops.segment_min(idx, gids, num_segments=num_segments_cap)
 
 
-@partial(jax.jit, static_argnames=("keep",))
+@partial(jit, static_argnames=("keep",))
 def unique_flags(gids, mask=None, keep: str = "first"):
     """Flag the kept occurrence of each distinct row (reference Unique
     :1306 keep-first/last).  gids: dense rank per row; masked rows never
@@ -46,7 +48,7 @@ def unique_flags(gids, mask=None, keep: str = "first"):
     return flag
 
 
-@partial(jax.jit, static_argnames=("op",))
+@partial(jit, static_argnames=("op",))
 def set_op_flags(gids_cat, side_is_b, op: str, mask=None):
     """Flags over the concatenated rows of A then B selecting the output rows
     of a set operation (distinct semantics, matching the reference):

@@ -16,6 +16,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..utils.cache import jit
+
 
 def sort_permutation(keyops) -> jax.Array:
     """Stable argsort of rows under a :class:`~cylon_tpu.ops.pack.KeyOps`
@@ -44,7 +46,7 @@ def take_with_nulls(data: jax.Array, validity, idx: jax.Array):
     return g, v
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
+@partial(jit, static_argnames=("out_cap",))
 def compact_by_flag(flag: jax.Array, out_cap: int):
     """Indices of rows with flag set, in original row order, padded to
     ``out_cap`` with -1; plus the true count.  The static-shape analog of the

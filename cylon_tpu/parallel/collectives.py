@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -64,7 +64,7 @@ def _allgather_fn(mesh: Mesh, w: int, cap: int, out_cap: int, ncols: int):
             outs.append(out.at[fslot].set(flat, mode="drop"))
         return tuple(outs)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP,) + (ROW,) * ncols,
                              out_specs=(ROW,) * ncols))
 
@@ -78,7 +78,7 @@ def _bcast_fn(mesh: Mesh, root: int, ncols: int):
             outs.append(g[root])
         return tuple(outs)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW,) * ncols,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW,) * ncols,
                              out_specs=(ROW,) * ncols))
 
 
@@ -112,7 +112,7 @@ def _allreduce_fn(mesh: Mesh, op: str, ncols: int):
             outs.append(_REDUCERS[op](masked, ROW_AXIS))
         return tuple(outs)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP,) + (ROW,) * ncols,
                              out_specs=(REP,) * ncols))
 

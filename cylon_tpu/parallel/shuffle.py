@@ -35,7 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import config
 from ..obs import comm as _comm, metrics as _metrics, plan as _plan
 from ..topo import model as _topo
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..ctx.context import ROW_AXIS
 from ..ops import hashing
 
@@ -60,7 +60,7 @@ def _hash_targets_fn(mesh: Mesh, w: int, nkeys: int, with_valids: bool):
 
     nargs = nkeys * 2 if with_valids else nkeys
     specs = (P(),) + tuple(P(ROW_AXIS) for _ in range(nargs))
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=P(ROW_AXIS)))
 
 
@@ -87,7 +87,7 @@ def _count_fn(mesh: Mesh, w: int):
             jnp.ones(tgt.shape[0], jnp.int32), tgt, num_segments=w + 1)
         return counts[:w].reshape(1, w)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
                              out_specs=P(ROW_AXIS)))
 
 
@@ -131,7 +131,7 @@ def _skew_targets_fn(mesh: Mesh, w: int, k_heavy: int, nkeys: int):
         return jnp.where(mask, tgt, jnp.int32(w))
 
     specs = (P(), P()) + (P(ROW_AXIS),) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=P(ROW_AXIS)))
 
 
@@ -199,7 +199,7 @@ def _skew_split_targets_fn(mesh: Mesh, w: int, k: int, nkeys: int,
 
     specs = (P(), P(), P(), P()) + (P(ROW_AXIS),) * (2 * nkeys) \
         + (P(),) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=P(ROW_AXIS)))
 
 
@@ -254,7 +254,7 @@ def _prep_fn(mesh: Mesh, w: int):
         pos = idx - offs[tgt_safe].astype(jnp.int32)
         return tgt_s, perm, pos
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(P(ROW_AXIS), P()),
                              out_specs=(P(ROW_AXIS),) * 3))
 
@@ -315,7 +315,7 @@ def _round_fn(mesh: Mesh, w: int, block: int, out_cap: int,
                        out_specs=(P(ROW_AXIS),) * n)
         return sm(tgt_s, perm, pos, counts, outs, cols)
 
-    return jax.jit(fn, donate_argnums=(4,))
+    return jit(fn, donate_argnums=(4,))
 
 
 @program_cache()
@@ -323,7 +323,7 @@ def _alloc_fn(mesh: Mesh, out_cap: int, dtype: str, extra_shape: tuple):
     def per_shard():
         return jnp.zeros((out_cap,) + extra_shape, jnp.dtype(dtype))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(),
                              out_specs=P(ROW_AXIS)))
 
 

@@ -301,7 +301,7 @@ def check_same_env(a: Table, b: Table) -> CylonEnv:
 # key-value sampling for the heavy-hitter profiler (obs/plan, obs/sketch)
 # ---------------------------------------------------------------------------
 
-from ..utils.cache import program_cache  # noqa: E402
+from ..utils.cache import jit, program_cache  # noqa: E402
 
 
 @program_cache()
@@ -335,7 +335,7 @@ def _key_sample_fn(mesh, m: int, nkeys: int, with_valids: bool = False):
 
     specs = (REP,) + (ROW,) * (2 * nkeys)
     nouts = nkeys * (2 if with_valids else 1) + 2
-    return jax.jit(jax.shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(jax.shard_map(per_shard, mesh=mesh, in_specs=specs,
                                  out_specs=(ROW,) * nouts))
 
 

@@ -37,7 +37,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType
 from ..core.table import DeferredTable, Table
@@ -218,7 +218,7 @@ def _fused_fn(mesh: Mesh, n_l: int, all_live: bool, lspec, rspec,
         return (tuple(key_out), tuple(kval_out), tuple(res_d), tuple(res_v),
                 meta)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW),
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 

@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from ..core.table import Table
@@ -337,7 +337,7 @@ def _combine_fn(mesh: Mesh, ops: tuple, seg_cap: int, grouped: bool,
                 inter_out.append(tuple(inter[k] for k in INTER_NAMES[op]))
         return key_out, kval_out, tuple(inter_out), n_groups.reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW, ROW),
                              out_specs=(ROW, ROW, ROW, ROW)))
 
@@ -423,7 +423,7 @@ def _final_fn(mesh: Mesh, ops: tuple, seg_cap: int, ddof: int, narrow: tuple,
             res_v.append(v)
         return key_out, kval_out, tuple(res_d), tuple(res_v), n_groups.reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW),
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
@@ -498,7 +498,7 @@ def _raw_fn(mesh: Mesh, specs: tuple, seg_cap: int, ddof: int, grouped: bool,
             res_v.append(v)
         return key_out, kval_out, tuple(res_d), tuple(res_v), n_groups.reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW, ROW),
                              out_specs=(ROW, ROW, ROW, ROW, ROW)))
 
@@ -508,7 +508,7 @@ def _shrink_fn(mesh: Mesh, new_cap: int):
     def per_shard(d):
         return d[:new_cap]
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
                              out_specs=ROW))
 
 
@@ -585,7 +585,7 @@ def _sink_finalize_fn(mesh: Mesh, ops: tuple, ddof: int):
         return tuple(outs)
 
     n_in = sum(2 if op == "mean" else 3 for op in ops)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW,) * n_in,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW,) * n_in,
                              out_specs=(ROW,) * (2 * len(ops))))
 
 

@@ -31,7 +31,7 @@ from jax.sharding import Mesh
 
 from .. import config
 from ..obs import plan as _plan
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -80,7 +80,7 @@ def _hash_sample_fn(mesh: Mesh, m: int, nkeys: int):
         return h[idx], live
 
     specs = (REP,) + (ROW,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW, ROW)))
 
 
@@ -147,7 +147,7 @@ def _heavy_flag_fn(mesh: Mesh, k: int, nkeys: int):
         return flag
 
     specs = (REP,) + (ROW,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=ROW))
 
 
@@ -341,7 +341,7 @@ def _semi_flag_fn(mesh: Mesh, narrow: tuple, all_live: bool, anti: bool):
         return jnp.zeros(cap_l + 1, bool).at[tgt].set(
             keep, mode="drop")[:cap_l]
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW),
                              out_specs=ROW))
 
@@ -392,7 +392,7 @@ def _count_fn(mesh: Mesh, how: str, narrow: tuple,
     n_pl = (lspec.n_lanes if lspec is not None else 0) + \
         (rspec.n_lanes if rspec is not None else 0)
     n_out = (3 + n_pl) if slim else (7 + n_pl)
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW, ROW,
                                        ROW, ROW, ROW),
                              out_specs=(ROW,) * n_out))
@@ -411,7 +411,7 @@ def _carry_fn(mesh: Mesh, how: str, cap_l: int, cap_r: int,
         _, carry = joink.join_carry(bnd, idx_s, live, cap_l, how)
         return tuple(carry)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW),
                              out_specs=(ROW,) * 6))
 
@@ -426,7 +426,7 @@ def _un_count_fn(mesh: Mesh):
     def per_shard(un):
         return jnp.sum(un, dtype=jnp.int32).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
                              out_specs=ROW))
 
 
@@ -488,7 +488,7 @@ def _materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
 
         return _plan_outputs(plan, ldat, lval, l_ok, rdat, rval, r_ok)
 
-    return jax.jit(shard_map(
+    return jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(ROW, ROW, ROW, ROW, ROW, ROW),
         out_specs=(ROW, ROW)))
@@ -612,7 +612,7 @@ def _packed_count_fn(mesh: Mesh, how: str, narrow: tuple, need_nf: tuple,
         (rspec.n_lanes if carry_match else 0)
     n_out = (3 + n_pl) if slim else (7 + n_pl)
     in_specs = (REP, REP, REP, REP) + (ROW,) * (n_arrs_l + n_arrs_r)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                              out_specs=(ROW,) * n_out))
 
 
@@ -709,7 +709,7 @@ def _packed_materialize_fn(mesh: Mesh, how: str, out_cap: int, cap_l: int,
 
     in_specs = (ROW, ROW, REP, REP) + (ROW,) * (n_arrs_l + n_arrs_r)
     jit_kwargs = {"donate_argnums": tuple(donate)} if donate else {}
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                              out_specs=(ROW, ROW)), **jit_kwargs)
 
 
@@ -863,8 +863,9 @@ def prewarm_packed_join(pl: PackedPiece, pr: PackedPiece, left_on,
             all_live, carry_emit, carry_match, slim)
         vcl = np.asarray(pl.lens, np.int32)
         vcr = np.asarray(pr.lens, np.int32)
-        fn.lower(vcl, vcr, pl.starts, pr.starts,
-                 *pl.arrs, *pr.arrs).compile()
+        from ..exec.compiler import aot_compile
+        aot_compile(fn, vcl, vcr, pl.starts, pr.starts,
+                    *pl.arrs, *pr.arrs)
     except Exception:  # noqa: BLE001 — best-effort warm only
         pass
 

@@ -47,7 +47,7 @@ from .. import config
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from .common import REP, ROW
 
 shard_map = jax.shard_map
@@ -73,7 +73,7 @@ def _piece_pack_fn(mesh: Mesh, spec, pad: int, donate: bool = False):
         return mat
 
     jit_kwargs = {"donate_argnums": (0, 1)} if donate else {}
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(ROW, ROW),
                              out_specs=ROW), **jit_kwargs)
 
 
@@ -83,7 +83,7 @@ def _pad_rows_fn(mesh: Mesh, pad: int, donate: bool = False):
         return jnp.concatenate([d, jnp.zeros((pad,), d.dtype)]) if pad else d
 
     jit_kwargs = {"donate_argnums": (0,)} if donate else {}
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
                              out_specs=ROW), **jit_kwargs)
 
 
@@ -117,7 +117,7 @@ def _piece_slice_fn(mesh: Mesh, spec, piece_cap: int):
         return tuple(datas), tuple(valids)
 
     in_specs = (REP,) + (ROW,) * (int(has_mat) + n_f64)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=in_specs,
                              out_specs=(ROW, ROW)))
 
 

@@ -23,7 +23,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import CylonEnv
@@ -48,7 +48,7 @@ def _pack_cols_fn(spec):
     def fn(datas, valids):
         return lanes.pack_lanes(spec, list(datas), list(valids))
 
-    return jax.jit(fn)
+    return jit(fn)
 
 
 @lru_cache(maxsize=config.PROGRAM_CACHE_SIZE)
@@ -60,7 +60,7 @@ def _unpack_cols_fn(spec):
         return (tuple(d for d in datas if d is not None),
                 tuple(v for v in valids if v is not None))
 
-    return jax.jit(fn)
+    return jit(fn)
 
 
 def _flatten_for_exchange(table: Table):
@@ -182,7 +182,7 @@ def _range_targets_fn(mesh: Mesh, cap: int):
         t = jnp.clip(t, 0, w - 1)
         return jnp.where(mask, t, jnp.int32(w))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, REP, ROW), out_specs=ROW))
 
 
@@ -236,7 +236,7 @@ def _pos_targets_fn(mesh: Mesh, cap: int):
         t = jnp.clip(t, 0, w - 1)
         return jnp.where(mask, t, jnp.int32(w))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW), out_specs=ROW))
 
 
@@ -259,7 +259,7 @@ def _sort_flat_by_pos_fn(mesh: Mesh, cap: int, n_arrs: int):
         return tuple(a[perm] for a in arrs)
 
     specs = (REP,) + (ROW,) * (1 + n_arrs)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW,) * n_arrs))
 
 
@@ -345,7 +345,7 @@ def _repad_fn(mesh: Mesh, cap: int, new_cap: int):
         pad = jnp.zeros((new_cap - cap,) + d.shape[1:], d.dtype)
         return jnp.concatenate([d, pad])
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=ROW,
                              out_specs=ROW))
 
 
@@ -387,7 +387,7 @@ def _compact_range_fn(mesh: Mesh, cap: int, out_cap: int, spec):
         # ONE lane-matrix gather for all columns (+ f64 side gathers)
         return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
-    return jax.jit(shard_map(
+    return jit(shard_map(
         per_shard, mesh=mesh,
         in_specs=(REP, REP, REP, REP, ROW, ROW), out_specs=(ROW, ROW)))
 
@@ -435,7 +435,7 @@ def _filter_count_fn(mesh: Mesh, cap: int):
         mask = live_mask(vc, cap)
         return jnp.sum(flag & mask).astype(jnp.int32).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
                              out_specs=ROW))
 
 
@@ -449,7 +449,7 @@ def _filter_mat_fn(mesh: Mesh, cap: int, out_cap: int, spec):
         # ONE lane-matrix gather for all columns (+ f64 side gathers)
         return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW),
                              out_specs=(ROW, ROW)))
 
@@ -514,7 +514,7 @@ def _concat_fn(mesh: Mesh, caps: tuple, out_cap: int, with_valid: tuple):
         return (tuple(o[:out_cap] for o in outs),
                 tuple(v[:out_cap] if v is not None else None for v in outv))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW), out_specs=(ROW, ROW)))
 
 

@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.dtypes import LogicalType
 from ..core.table import Table
@@ -55,7 +55,7 @@ def _unique_count_fn(mesh: Mesh, keep: str):
         flags, _ = _unique_flags_per_shard(vc, key_datas, key_valids, keep)
         return jnp.sum(flags, dtype=jnp.int32).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
                              out_specs=ROW))
 
 
@@ -69,7 +69,7 @@ def _unique_mat_fn(mesh: Mesh, keep: str, out_cap: int, spec):
         # ONE lane-matrix gather for all columns (+ f64 side gathers)
         return lanes.gather_columns(spec, list(datas), list(valids), idx)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW, ROW),
                              out_specs=(ROW, ROW)))
 
@@ -159,7 +159,7 @@ def _setop_count_fn(mesh: Mesh, op: str):
                                        b_valids, op)
         return jnp.sum(flags, dtype=jnp.int32).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW),
                              out_specs=ROW))
 
@@ -185,7 +185,7 @@ def _setop_mat_fn(mesh: Mesh, op: str, out_cap: int):
                 out_v.append(jnp.concatenate([va_, vb_])[safe])
         return tuple(out_d), tuple(out_v)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, REP, ROW, ROW, ROW, ROW),
                              out_specs=(ROW, ROW)))
 
@@ -268,7 +268,7 @@ def _equals_fn(mesh: Mesh, kinds: tuple):
             ok = ok & (va_ == vb_) & (val_eq | ~va_)
         return jnp.all(ok | ~mask).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, ROW, ROW),
                              out_specs=ROW))
 

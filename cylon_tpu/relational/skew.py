@@ -74,7 +74,7 @@ from ..core.table import Table
 from ..ctx.context import ROW_AXIS
 from ..ops import pack
 from ..status import ExecutionError
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..utils.host import host_array
 from .common import REP, ROW, fits_int32, live_mask
 
@@ -481,7 +481,7 @@ def _heavy_count_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
         return counts, gtt.T
 
     specs = (REP,) + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW, REP)))
 
 
@@ -523,7 +523,7 @@ def _heavy_member_flag_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
         return jnp.any(eq & member[:, my][None, :], axis=1) & mask
 
     specs = (REP, REP) + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=ROW))
 
 
@@ -581,7 +581,7 @@ def _heavy_partial_sum_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
 
     specs = (REP,) + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys) \
         + (ROW,) * nvals
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW,) * nvals))
 
 
@@ -616,7 +616,7 @@ def _patch_heavy_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
 
     specs = (REP, REP) + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys) \
         + (ROW,) * nvals + (REP,) * nvals
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=(ROW,) * (nvals + 1)))
 
 
@@ -704,7 +704,7 @@ def _out_ltcount_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
                        dtype=jnp.int32).reshape(1, k)
 
     specs = (REP, REP) + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=ROW))
 
 
@@ -776,7 +776,7 @@ def _stitch_pos_fn(mesh: Mesh, k: int, nkeys: int, need_nf: tuple,
         return jnp.where(live, pos, total)
 
     specs = (REP,) * 9 + (ROW,) * (2 * nkeys) + (REP,) * (2 * nkeys)
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=specs,
                              out_specs=ROW))
 
 

@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from .. import config
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -111,7 +111,7 @@ def _local_sort_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
         return tuple(out_d), tuple(out_v)
 
     jit_kwargs = {"donate_argnums": (1, 2)} if donate else {}
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW),
                              out_specs=(ROW, ROW)), **jit_kwargs)
 
@@ -135,7 +135,7 @@ def _sample_fn(mesh: Mesh, m: int, descendings: tuple, nulls_position: int,
         live = jnp.full((m,), True) & (n > 0)
         return sampled, live
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
                              out_specs=(ROW, ROW)))
 
 
@@ -161,7 +161,7 @@ def _target_fn(mesh: Mesh, descendings: tuple, nulls_position: int,
         tgt = jnp.sum(gt, axis=1, dtype=jnp.int32)
         return jnp.where(mask, tgt, jnp.int32(w))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(REP, ROW, ROW, REP), out_specs=ROW))
 
 

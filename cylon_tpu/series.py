@@ -22,7 +22,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from . import config
-from .utils.cache import program_cache
+from .utils.cache import jit, program_cache
 from .core.column import Column
 from .core.dtypes import LogicalType, from_numpy_dtype, physical_np_dtype
 from .core.table import Table
@@ -483,5 +483,5 @@ def _reduce_fn(mesh: Mesh, kind: str, cap: int):
         # dtype-preserving partials: int64 sums stay exact past 2^53
         return out.reshape(1), cnt.astype(jnp.int64).reshape(1)
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW, ROW),
                              out_specs=(ROW, ROW)))

@@ -219,6 +219,45 @@ class RequeueOverflowError(CylonError):
         self.session = session
 
 
+class CompileQuarantinedError(CapacityOverflowError):
+    """A compile signature is QUARANTINED: the compile-intent journal
+    (exec/compiler) shows a predecessor process died mid-compile on this
+    exact (builder, shape-signature) pair, so re-lowering it would walk
+    straight back into the compiler crash.  Subclasses
+    :class:`CapacityOverflowError` deliberately — the recovery ladder's
+    ``Code.CapacityError`` rung re-plans at a halved piece cap, which
+    changes the operand shapes and therefore the signature, sidestepping
+    the quarantined program instead of re-crashing
+    (docs/robustness.md, "Compile lifecycle")."""
+
+    kind = "quarantined"
+
+    def __init__(self, msg: str = "", site: str | None = None,
+                 signature: str | None = None):
+        super().__init__(msg, site=site)
+        self.signature = signature
+
+
+class CompileTimeoutError(CylonError):
+    """A ``.lower()``/``.compile()`` exceeded the compile watchdog budget
+    (``CYLON_TPU_COMPILE_TIMEOUT_S``): the build thread is hung inside
+    XLA, so the caller surfaces TYPED instead of wedging the whole rank
+    (and, in multi-controller runs, desyncing its peers).  Same worker
+    thread + bounded ``join`` pattern as the exchange watchdog
+    (exec/recovery.exchange_watchdog), but typed for the compile axis so
+    serving can count / alert on slow-compile tenants separately from
+    collective desyncs."""
+
+    code = Code.ExecutionError
+    kind = "compile_timeout"
+
+    def __init__(self, msg: str = "", site: str | None = None,
+                 signature: str | None = None):
+        super().__init__(msg)
+        self.site = site
+        self.signature = signature
+
+
 class CheckpointCorruptError(CylonError):
     """A checkpoint page or manifest failed its content-hash check (or
     an injected ``corrupt`` fault simulated that) on the resume path:

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 from ..core.column import Column
 from ..core.table import Table
 from ..ctx.context import ROW_AXIS
@@ -72,7 +72,7 @@ def _event_bounds_fn(mesh: Mesh, cap: int):
         hi = jnp.max(jnp.where(mask, t, jnp.int64(_T_MIN))).reshape(1)
         return lo, hi
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(REP, ROW),
                              out_specs=(ROW, ROW)))
 
 

@@ -53,7 +53,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import config
 from ..ctx.context import ROW_AXIS
-from ..utils.cache import program_cache
+from ..utils.cache import jit, program_cache
 
 shard_map = jax.shard_map
 
@@ -126,7 +126,7 @@ def _hop1_targets_fn(mesh: Mesh, w: int, n_slices: int):
         g = base + jnp.clip(tgt, 0, w - 1) % r_
         return jnp.where(tgt < w, g.astype(jnp.int32), jnp.int32(w))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
+    return jit(shard_map(per_shard, mesh=mesh, in_specs=(P(ROW_AXIS),),
                              out_specs=P(ROW_AXIS)))
 
 
@@ -141,7 +141,7 @@ def _hop2_targets_fn(mesh: Mesh, w: int, cap: int):
         mask = jnp.arange(cap, dtype=jnp.int32) < vc[my]
         return jnp.where(mask, jnp.clip(tgt, 0, w - 1), jnp.int32(w))
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
+    return jit(shard_map(per_shard, mesh=mesh,
                              in_specs=(P(), P(ROW_AXIS)),
                              out_specs=P(ROW_AXIS)))
 
@@ -229,7 +229,7 @@ def _tier_round_fn(mesh: Mesh, w: int, n_slices: int, hop: int,
                        out_specs=(P(ROW_AXIS),) * n)
         return sm(tgt_s, perm, pos, counts, outs, cols)
 
-    return jax.jit(fn, donate_argnums=(4,))
+    return jit(fn, donate_argnums=(4,))
 
 
 # ---------------------------------------------------------------------------

@@ -70,7 +70,12 @@ def _track_table(mesh, table) -> None:
     _TABLES[id(mesh)] = (ref, table)
     while len(_TABLES) > MESH_TABLE_LIMIT:
         _oldest, (_ref, old_table) = _TABLES.popitem(last=False)
+        n_programs = sum(len(lru) for lru in old_table.values())
         old_table.clear()
+        # previously silent: the compile ledger counts the cleared
+        # programs (compile_mesh_table_evict_total, docs/robustness.md)
+        from ..exec import compiler
+        compiler.on_table_evict(_oldest, n_programs)
 
 
 def _mesh_table(mesh) -> dict:
@@ -93,6 +98,54 @@ def _mesh_table(mesh) -> dict:
     return table
 
 
+class _LazyJit:
+    """Deferred facade program: ``jax.jit`` + lifecycle wrap happen on
+    the FIRST call (or attribute access), not at decoration time — so
+    module-level ``@partial(jit, ...)`` kernels (ops/) never import the
+    exec package mid-bootstrap."""
+
+    # __weakref__: jax weakrefs callables it is handed (jit cache keys,
+    # shard_map trace bookkeeping) — a slotted class without it fails
+    # deep inside tracing with "cannot create weak reference"
+    __slots__ = ("_fun", "_kw", "_prog", "__weakref__")
+
+    def __init__(self, fun, kw):
+        self._fun = fun
+        self._kw = kw
+        self._prog = None
+
+    def _resolve(self):
+        prog = self._prog
+        if prog is None:
+            from ..exec.compiler import jit as _jit
+            prog = self._prog = _jit(self._fun, **self._kw)
+        return prog
+
+    def __call__(self, *args, **kwargs):
+        return self._resolve()(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._resolve(), name)
+
+
+def jit(fun=None, **kw):
+    """The compile-lifecycle facade's ``jax.jit``, re-exported at the
+    cache layer: operator modules bind ``jit`` from HERE at import time
+    (``from ..utils.cache import jit``) because importing
+    ``cylon_tpu.exec.compiler`` at module scope would pull the whole
+    exec package — which imports the relational layer back (a cycle).
+    The facade wrap is deferred to the first call (:class:`_LazyJit`);
+    by then the exec package is importable.  Raw ``jax.jit`` outside
+    this module and exec/compiler.py is a lint finding (TS117): every
+    compile must ride the facade so the ledger, journal, watchdog and
+    quarantine see it.  Usable directly (``jit(fn, **kw)``) or as a
+    ``@partial(jit, static_argnames=...)`` decorator."""
+    if fun is None:
+        import functools
+        return functools.partial(jit, **kw)
+    return _LazyJit(fun, kw)
+
+
 def program_cache(maxsize: int | None = None):
     """LRU-memoize a program factory whose FIRST argument is the Mesh.
 
@@ -113,6 +166,7 @@ def program_cache(maxsize: int | None = None):
 
         def wrapper(mesh, *args, **kwargs):
             from ..analysis import runtime
+            from ..exec import compiler
             key = (args, tuple(sorted(kwargs.items())) if kwargs else ())
             with _lock:
                 table = _mesh_table(mesh)
@@ -124,6 +178,7 @@ def program_cache(maxsize: int | None = None):
                     lru.move_to_end(key)
             if hit is not None:
                 runtime.note_builder(name, key, miss=False)
+                compiler.on_hit(mesh, name, key)
                 return hit
             runtime.note_builder(name, key, miss=True)
             built = fn(mesh, *args, **kwargs)
@@ -133,10 +188,17 @@ def program_cache(maxsize: int | None = None):
             mesh_ident = (tuple(mesh.axis_names),
                           tuple(d.id for d in mesh.devices.flat))
             built = runtime.tag_program(name, built, (mesh_ident, key))
+            popped = []
             with _lock:
                 lru[key] = built
                 while len(lru) > limit:
-                    lru.popitem(last=False)
+                    popped.append(lru.popitem(last=False)[0])
+            # ledger hooks run OUTSIDE the cache lock (lock order:
+            # cache._lock before compiler._lock; the budget vote may
+            # ride the consensus wire and must never hold either lock)
+            if popped:
+                compiler.on_builder_evict(mesh, name, popped)
+            compiler.on_insert(mesh, name, key, lru)
             return built
 
         def cache_clear(mesh=None):

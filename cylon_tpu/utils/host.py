@@ -94,7 +94,8 @@ def sync_pull(arr) -> None:
     import jax
     import jax.numpy as jnp
     if _pull_fn is None:
-        _pull_fn = jax.jit(
+        from .cache import jit
+        _pull_fn = jit(
             lambda x: x.reshape(-1)[:4].astype(jnp.float32).sum())
     with _sanctioned_pull("sync_pull"):
         np.asarray(_pull_fn(arr))

@@ -25,6 +25,18 @@ The default budget ("auto") is sized to ~2.2 tenants' footprints so a
 admission until earlier ones drain, and concurrent packers evict each
 other's cold sources through the consensus'd admission path.
 
+``--families`` switches to the SHAPE-FAMILY compile-cost round
+(SERVING_r03, docs/serving.md "Compile-cost contract"): N single-
+controller tenants whose ingest row counts are near-misses inside ONE
+pow2 shape family run the same join+groupby mix, and the facade's
+compiled-program count must stay FLAT as the tenant count grows 4×
+(tenants 2..N ride tenant 1's executables).  The report carries cold
+(first-iteration, compiles included) vs warm p50/p99 and their gap, the
+compiled-program trajectory, the ``CYLON_TPU_SHAPE_FAMILIES=0`` contrast
+run (per-shape recompiles — the cost the canonicalization removes), and
+a ``bit_equal`` verdict of every canonicalized result against its
+exact-shape families-off oracle.
+
 Usage::
 
     python scripts/bench_serving.py                    # 4 tenants
@@ -32,6 +44,8 @@ Usage::
         --policy fair --budget-mb 24 --out SERVING_rNN.json
     python scripts/bench_serving.py --tenants 64 --smoke --preempt 8 \
         --slo-ms 2000 --out SERVING_r02.json   # preemptive serving round
+    python scripts/bench_serving.py --families \
+        --out SERVING_r03.json                 # shape-family round
 
 Exit status 0 = completed and bit-equal; 1 otherwise.  A trimmed run is
 wired as a slow-marked test (tests/test_scheduler.py).
@@ -92,6 +106,10 @@ def _result_sha(out) -> str:
         h.update(struct.pack("<d", out))
         return h.hexdigest()
     df = out.to_pandas() if hasattr(out, "to_pandas") else out
+    # object-dtype columns (e.g. a groupby max that surfaced through
+    # python scalars) hash their POINTER bytes — coerce to concrete
+    # dtypes first or the digest is a fresh random per materialization
+    df = df.infer_objects()
     df = df.sort_values(list(df.columns)).reset_index(drop=True)
     for col in df.columns:
         h.update(str(col).encode())
@@ -382,6 +400,159 @@ def run_serving(tenants: int = 4, queries: int = 4, scale: float = 0.01,
     return report
 
 
+def run_families(tenants: int = 16, queries: int = 3,
+                 family: int = 1024, seed: int = 0) -> dict:
+    """The shape-family compile-cost round (docs/serving.md,
+    "Compile-cost contract").  ``tenants`` single-controller tenants —
+    ingest row counts spread across ONE pow2 family ``(family/2,
+    family]`` — each run ``queries`` closed-loop join+groupby queries.
+    Phase 1 (families on) measures the compiled-program trajectory:
+    after tenant 1, after the first quarter of the fleet, and after the
+    full 4× fleet — the contract is FLAT (misses_after_all ==
+    misses_after_first).  Cold is each tenant's first iteration (tenant
+    1's includes every real compile; later tenants' measure the family
+    hit), warm is every subsequent iteration.  Phase 2 re-runs every
+    tenant once with ``SHAPE_FAMILIES`` off — the exact-shape oracle for
+    ``bit_equal`` AND the per-shape recompile contrast (its miss count
+    must GROW with tenant count)."""
+    import numpy as np
+    import pandas as pd
+
+    import cylon_tpu as ct
+    from cylon_tpu import config
+    from cylon_tpu.exec import compiler
+    from cylon_tpu.relational import groupby_aggregate, join_tables
+
+    env = ct.CylonEnv(config=ct.LocalConfig())
+    n_keys = 64
+
+    # distinct near-miss row counts inside one pow2 family: every
+    # tenant canonicalizes onto the same padded ingest (and, with
+    # unique build keys, the same data-independent join output cap)
+    lo, hi = family // 2 + 8, family - 4
+    sizes = sorted({int(x) for x in np.linspace(lo, hi, tenants)})
+    while len(sizes) < tenants:     # collisions only at tiny counts
+        sizes.append(sizes[-1] - 1)
+    sizes = sorted(sizes)[:tenants]
+
+    def make_inputs(i: int, n: int):
+        r = np.random.default_rng(seed * 7919 + 1000 + i)
+        ldf = pd.DataFrame({"k": r.integers(0, n_keys, n).astype(np.int32),
+                            "v": r.integers(0, 10_000, n).astype(np.int64)})
+        rdf = pd.DataFrame({"k": np.arange(n_keys, dtype=np.int32),
+                            "w": r.integers(0, 10_000,
+                                            n_keys).astype(np.int64)})
+        return ldf, rdf
+
+    def run_query(ldf, rdf):
+        lt = ct.Table.from_pandas(ldf, env)
+        rt = ct.Table.from_pandas(rdf, env)
+        j = join_tables(lt, rt, "k", "k", how="inner")
+        out = groupby_aggregate(j, "k", [("v", "sum"), ("w", "max")])
+        return out.to_pandas()
+
+    inputs = [make_inputs(i, n) for i, n in enumerate(sizes)]
+
+    # ---- phase 1: families on — the compile-cost trajectory ------------
+    prev = config.SHAPE_FAMILIES
+    config.SHAPE_FAMILIES = True
+    compiler.reset_stats()
+    cold, warm, fam_shas = [], [], []
+    misses_after_first = misses_after_quarter = 0
+    quarter = max(tenants // 4, 1)
+    try:
+        for i, (ldf, rdf) in enumerate(inputs):
+            lats = []
+            for it in range(queries):
+                t0 = time.perf_counter()
+                df = run_query(ldf, rdf)
+                lats.append(time.perf_counter() - t0)
+                if it == 0:
+                    fam_shas.append(_result_sha(df))
+            cold.append(lats[0])
+            warm.extend(lats[1:])
+            if i == 0:
+                misses_after_first = compiler.stats()["cache_misses"]
+            if i == quarter - 1:
+                misses_after_quarter = compiler.stats()["cache_misses"]
+        st = compiler.stats()
+        misses_after_all = st["cache_misses"]
+        programs_live = st["programs_live"]
+        family_hits = st["cache_hits"]
+
+        # ---- phase 2: families off — exact-shape oracle + contrast -----
+        config.SHAPE_FAMILIES = False
+        compiler.reset_stats()
+        off_shas, off_first = [], 0
+        for i, (ldf, rdf) in enumerate(inputs):
+            off_shas.append(_result_sha(run_query(ldf, rdf)))
+            if i == 0:
+                off_first = compiler.stats()["cache_misses"]
+        off_all = compiler.stats()["cache_misses"]
+    finally:
+        config.SHAPE_FAMILIES = prev
+
+    flat = misses_after_all == misses_after_first
+    bit_equal = fam_shas == off_shas
+    failures = []
+    if not flat:
+        failures.append(f"compiled programs grew with tenant count: "
+                        f"{misses_after_first} -> {misses_after_all}")
+    if not bit_equal:
+        bad = [i for i, (a, b) in enumerate(zip(fam_shas, off_shas))
+               if a != b]
+        failures.append(f"canonicalized results diverged from the "
+                        f"exact-shape oracle for tenants {bad}")
+    if off_all <= off_first:
+        failures.append(f"families-off contrast did not recompile per "
+                        f"shape: {off_first} -> {off_all}")
+
+    cold_p50, warm_p50 = _percentile(cold, 50), _percentile(warm, 50)
+    return {
+        "metric": f"shape-family serving, {tenants} tenants x {queries} "
+                  f"queries (single-controller, family {family})",
+        "value": misses_after_all,
+        "unit": "compiled programs at 4x tenant count",
+        "vs_baseline": 0.0,
+        "detail": {
+            "tenants": tenants, "queries": queries,
+            "family": family, "ingest_rows": sizes,
+            "compiled_programs": {
+                "after_first_tenant": misses_after_first,
+                "after_quarter_fleet": misses_after_quarter,
+                "after_full_fleet": misses_after_all,
+                "flat": flat,
+                "programs_live": programs_live,
+                "family_cache_hits": family_hits,
+            },
+            "families_off_contrast": {
+                "after_first_tenant": off_first,
+                "after_full_fleet": off_all,
+                "recompiles_added": off_all - off_first,
+            },
+            # tenant 1's first iteration is the only TRUE cold query
+            # (every real compile happens there); tenants 2.. first
+            # iterations measure the family hit — the contract is that
+            # they land near warm, nowhere near cold
+            "cold_first_tenant_s": round(cold[0], 4),
+            "family_first_iters": {
+                "p50_s": round(_percentile(cold[1:], 50), 4),
+                "p99_s": round(_percentile(cold[1:], 99), 4),
+                "n": len(cold) - 1},
+            "cold": {"p50_s": round(cold_p50, 4),
+                     "p99_s": round(_percentile(cold, 99), 4),
+                     "n": len(cold)},
+            "warm": {"p50_s": round(warm_p50, 4),
+                     "p99_s": round(_percentile(warm, 99), 4),
+                     "n": len(warm)},
+            "cold_warm_gap": (round(cold[0] / warm_p50, 2)
+                              if warm_p50 else 0.0),
+            "bit_equal": bit_equal,
+            "failures": failures,
+        },
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tenants", type=int, default=4)
@@ -410,9 +581,39 @@ def main() -> int:
     ap.add_argument("--ckpt-dir", default=None,
                     help="checkpoint root for the concurrent pass "
                          "(default with --preempt: a fresh temp dir)")
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "SERVING_r01.json"))
+    ap.add_argument("--families", action="store_true",
+                    help="run the shape-family compile-cost round "
+                         "(single-controller: 4x tenant count at a FLAT "
+                         "compiled-program count, cold vs warm latency, "
+                         "bit-equality vs the SHAPE_FAMILIES=0 exact-"
+                         "shape oracle); --tenants defaults to 16 here")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+
+    if args.families:
+        tenants = args.tenants if args.tenants != 4 else 16
+        report = run_families(tenants=tenants,
+                              queries=max(args.queries, 2),
+                              seed=args.seed)
+        out = args.out or os.path.join(REPO, "SERVING_r03.json")
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+        d = report["detail"]
+        cp = d["compiled_programs"]
+        print(f"# {report['metric']}: {report['value']} {report['unit']}")
+        print(f"# flat={cp['flat']} "
+              f"({cp['after_first_tenant']} -> {cp['after_full_fleet']} "
+              f"misses; families-off contrast adds "
+              f"{d['families_off_contrast']['recompiles_added']})")
+        print(f"# cold={d['cold_first_tenant_s']}s "
+              f"warm_p50={d['warm']['p50_s']}s "
+              f"gap={d['cold_warm_gap']}x "
+              f"bit_equal={d['bit_equal']}")
+        print(f"# wrote {out}")
+        return 0 if (d["bit_equal"] and cp["flat"]
+                     and not d["failures"]) else 1
+
+    args.out = args.out or os.path.join(REPO, "SERVING_r01.json")
 
     if args.smoke:
         args.queries = min(args.queries, 2)

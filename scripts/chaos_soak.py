@@ -88,6 +88,25 @@ contract leg: with no slice declaration the ARMED route must vote
 nothing and move exactly the flat run's exchange rows and exchange
 count — zero extra collectives, zero host syncs.
 
+``--compile`` switches to the COMPILE-LIFECYCLE acceptance flow
+(cylon_tpu/exec/compiler, docs/robustness.md "Compile lifecycle"): the
+standard join+sink workload with the facade's persistent compile cache
+armed per-leg (``CYLON_TPU_COMPILE_CACHE_DIR``).  Pinned legs: SIGKILL
+*inside* a guarded ``.lower()/.compile()`` (the ``compile.build``
+injector site) — the crash leaves the rank's intent journal on disk,
+and the rerun against the same dir must ADOPT the orphan into the
+crash quarantine (``quarantine_adoptions > 0``, the poisoned program
+surfaces as typed ``CompileQuarantinedError``) and still complete
+bit-equal via the ladder's capacity rung (a re-planned chunk count
+compiles DIFFERENT shapes, skirting the quarantined signature);
+corrupt-on-build (the manifest entry is poisoned, the relaunch's
+arm-time hash validation drops it — ``manifest_drops > 0`` — and the
+recompile is bit-equal); an injected compile stall with the watchdog
+budget armed (typed ``CompileTimeoutError``, never a hang, and the
+SAME dir reruns clean — a timeout does not poison the cache); and the
+unarmed contract leg (no compile env vars ⇒ the facade never arms,
+never creates its dir, and writes nothing).
+
 ``--skew`` switches to the ADAPTIVE-SKEW-SPLIT acceptance flow
 (docs/skew.md): a monolithic skewed-key join+groupby (one hot key on
 ~80% of probe rows) whose unsplit run (``CYLON_TPU_SKEW_SPLIT=0``) is
@@ -108,6 +127,7 @@ Usage::
     python scripts/chaos_soak.py --elastic --rows 1500 --chunks 3
     python scripts/chaos_soak.py --oocore --rows 2000 --chunks 3
     python scripts/chaos_soak.py --skew --rows 4000
+    python scripts/chaos_soak.py --compile --rows 3000
     python scripts/chaos_soak.py --multislice --rows 3000
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
@@ -213,6 +233,9 @@ def worker(args) -> int:
 
     if args.multislice:
         return _worker_topo(args, env)
+
+    if args.compile_flow:
+        return _worker_compile(args, env, make_workload)
 
     if args.fleet:
         return _worker_fleet(args, env, make_workload)
@@ -352,6 +375,173 @@ def run_stream(args) -> int:
     if own_workdir:
         shutil.rmtree(args.workdir, ignore_errors=True)
     print(json.dumps({"stream": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
+def _worker_compile(args, env, make_workload) -> int:
+    """The compile-lifecycle acceptance workload (docs/robustness.md,
+    "Compile lifecycle"): the standard join+sink workload under the
+    consensus ladder, with the compile facade armed per-leg through the
+    environment (CYLON_TPU_COMPILE_CACHE_DIR / _COMPILE_TIMEOUT_S /
+    CYLON_TPU_FAULTS at the ``compile.build`` site).  The JSON line
+    reports the result sha plus the facade's full counter set and the
+    persistent dir's file listing — the parent's evidence for quarantine
+    adoption, manifest poison drops, rewarm expectations and the
+    unarmed zero-write contract.  A watchdog timeout the ladder cannot
+    cure exits 3; an UNCURED quarantine (the ladder's re-planned shapes
+    still hit the poisoned signature) exits 4 — both typed, never
+    hangs."""
+    from cylon_tpu.exec import compiler, recovery
+    from cylon_tpu.status import (CompileQuarantinedError,
+                                  CompileTimeoutError)
+
+    attempt = make_workload(20260807, args.rows)
+    try:
+        out = recovery.run_with_recovery(
+            lambda: attempt(args.chunks), True, attempt, "soak", env=env)
+    except CompileTimeoutError as e:
+        print(json.dumps({"timeout_typed": True, "site": e.site,
+                          "signature": e.signature,
+                          **compiler.stats()}), flush=True)
+        return 3
+    except CompileQuarantinedError as e:
+        print(json.dumps({"quarantined_typed": True,
+                          "signature": e.signature,
+                          **compiler.stats()}), flush=True)
+        return 4
+    df = out.to_pandas().sort_values("l_orderkey").reset_index(drop=True)
+    d = compiler.cache_dir()
+    print(json.dumps({
+        "ok": True, "sha": _result_sha(df), "rows": int(len(df)),
+        "armed": bool(compiler.armed()),
+        "cache_files": (sorted(os.listdir(d))
+                        if d and os.path.isdir(d) else []),
+        "events": len(recovery.recovery_events()),
+        **compiler.stats(),
+    }), flush=True)
+    return 0
+
+
+def run_compile(args) -> int:
+    """The ``--compile`` acceptance flow (pinned, not drawn) — see the
+    module docstring.  The kill leg's occurrence index targets a PIECE
+    compile (chunk-shape-dependent), so the rerun's quarantine is
+    curable by the ladder's capacity rung: re-planned chunk counts
+    compile different shapes and skirt the poisoned signature."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_compile_")
+    failures: list = []
+    #: the first join _packed_count_fn compile in the pinned workload's
+    #: deterministic fresh-compile order (rows=3000, chunks=4, world=4)
+    #: — a per-piece program whose shapes change with the chunk count
+    kill_nth = 21
+
+    def spawn(tag, faults, cache_dir=None, extra=None):
+        workdir = os.path.join(args.workdir, tag)
+        env_extra = {}
+        if cache_dir is not None:
+            env_extra["CYLON_TPU_COMPILE_CACHE_DIR"] = cache_dir
+        env_extra.update(extra or {})
+        return _spawn(args, workdir, faults, resume=False,
+                      extra_env=env_extra, compile_flow=True)
+
+    # unarmed baseline: the bit-equality oracle AND the zero-write leg
+    p, base = spawn("base", "")
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: compile baseline failed", file=sys.stderr)
+        return 1
+    print(f"# compile unarmed baseline sha={base['sha'][:16]}", flush=True)
+    if base.get("armed"):
+        failures.append(f"facade armed with no compile env vars: {base}")
+    if base.get("quarantined") or base.get("watchdog_timeouts") \
+            or base.get("expected_warm"):
+        failures.append(f"unarmed run exercised armed-only state: {base}")
+
+    # kill mid-compile → orphan intent → rerun adopts + quarantines +
+    # completes bit-equal via the ladder's re-planned shapes
+    kdir = os.path.join(args.workdir, "kill", "ccache")
+    p, _ = spawn("kill", f"compile.build::{kill_nth}=kill",
+                 cache_dir=kdir)
+    if p.returncode != -9:
+        failures.append(f"kill mid-compile did not crash the process "
+                        f"(rc={p.returncode})")
+    elif not os.path.exists(os.path.join(kdir, "intent.rank0.json")):
+        failures.append("killed compile left no intent journal on disk")
+    else:
+        p2, info2 = spawn("kill_rerun", "", cache_dir=kdir)
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"rerun after kill mid-compile diverged "
+                            f"(rc={p2.returncode}): {info2}\n"
+                            f"{(p2.stdout + p2.stderr)[-2000:]}")
+        elif not info2.get("quarantine_adoptions"):
+            failures.append(f"rerun never adopted the orphan intent: "
+                            f"{info2}")
+        elif not info2.get("quarantined"):
+            failures.append(f"adopted orphan not quarantined: {info2}")
+        elif not info2.get("expected_warm"):
+            failures.append(f"rerun saw no rewarm expectations from the "
+                            f"killed run's manifest: {info2}")
+        elif "quarantine.json" not in info2.get("cache_files", []):
+            failures.append(f"quarantine not persisted: {info2}")
+        else:
+            print(f"# compile kill + rerun -> ok (adoptions="
+                  f"{info2['quarantine_adoptions']} expected_warm="
+                  f"{info2['expected_warm']})", flush=True)
+
+    # corrupt-on-build: the poisoned manifest entry fails its content
+    # hash at the relaunch's arm time — dropped to a clean recompile,
+    # bit-equal, never wrong code
+    cdir = os.path.join(args.workdir, "corrupt", "ccache")
+    p, info = spawn("corrupt", "compile.build::1=corrupt",
+                    cache_dir=cdir)
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"corrupt-on-build leg diverged "
+                        f"(rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    else:
+        p2, info2 = spawn("corrupt_rerun", "", cache_dir=cdir)
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"relaunch over poisoned manifest diverged "
+                            f"(rc={p2.returncode}): {info2}")
+        elif not info2.get("manifest_drops"):
+            failures.append(f"poisoned manifest entry not dropped at "
+                            f"arm time: {info2}")
+        else:
+            print(f"# compile corrupt + relaunch -> ok (drops="
+                  f"{info2['manifest_drops']})", flush=True)
+
+    # injected stall with the watchdog budget armed: typed
+    # CompileTimeoutError (exit 3), never a hang — and the SAME dir
+    # then reruns clean (a timeout does not poison the cache)
+    sdir = os.path.join(args.workdir, "stall", "ccache")
+    p, info = spawn("stall", "compile.build::1=stall", cache_dir=sdir,
+                    extra={"CYLON_TPU_COMPILE_TIMEOUT_S": "0.5"})
+    if p.returncode != 3 or not info or not info.get("timeout_typed"):
+        failures.append(f"stall did not surface a typed compile timeout "
+                        f"(rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("watchdog_timeouts"):
+        failures.append(f"watchdog timeout not counted: {info}")
+    else:
+        p2, info2 = spawn("stall_rerun", "", cache_dir=sdir)
+        if p2.returncode != 0 or not info2 \
+                or info2.get("sha") != base["sha"]:
+            failures.append(f"rerun after stall diverged "
+                            f"(rc={p2.returncode}): {info2}")
+        elif info2.get("quarantine_adoptions"):
+            failures.append(f"a watchdog timeout left an orphan intent "
+                            f"(must clear in finally): {info2}")
+        else:
+            print("# compile stall -> ok (typed timeout, dir reruns "
+                  "clean)", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"compile": True, "failures": len(failures),
                       "detail": failures[:10]}))
     return 1 if failures else 0
 
@@ -1264,7 +1454,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
            only: int | None = None, stream: bool = False,
            elastic: bool = False, world: int | None = None,
            skew: bool = False, skew_frac: float = 0.8,
-           multislice: bool = False, fleet: bool = False) -> tuple:
+           multislice: bool = False, fleet: bool = False,
+           compile_flow: bool = False) -> tuple:
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch a TPU tunnel
     env.pop("CYLON_TPU_PREEMPT_GRACE_S", None)  # armed per-leg only
@@ -1274,7 +1465,9 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
     for k in ("CYLON_TPU_HBM_BUDGET", "CYLON_TPU_HOST_BUDGET",
               "CYLON_TPU_SPILL_DIR", "CYLON_TPU_SLICES",
               "CYLON_TPU_TOPO_SHUFFLE", "CYLON_TPU_FLEET_CASE",
-              "CYLON_TPU_FLEET_TARGET", "CYLON_TPU_ADMISSION_TIMEOUT_S"):
+              "CYLON_TPU_FLEET_TARGET", "CYLON_TPU_ADMISSION_TIMEOUT_S",
+              "CYLON_TPU_COMPILE_CACHE_DIR", "CYLON_TPU_COMPILE_TIMEOUT_S",
+              "CYLON_TPU_COMPILE_BUDGET"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -1304,6 +1497,8 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
         cmd.append("--multislice")
     if fleet:
         cmd.append("--fleet")
+    if compile_flow:
+        cmd.append("--compile")
     p = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                        text=True, timeout=600)
     info = None
@@ -1573,6 +1768,14 @@ def main() -> int:
                          "must add zero collectives)")
     ap.add_argument("--skew-frac", type=float, default=0.8,
                     help="(worker) fraction of probe rows on the hot key")
+    ap.add_argument("--compile", dest="compile_flow",
+                    action="store_true",
+                    help="run the compile-lifecycle acceptance flow "
+                         "(SIGKILL mid-compile leaves an intent journal "
+                         "the rerun adopts into the crash quarantine; "
+                         "poisoned manifest entries drop to a clean "
+                         "recompile; stalls surface typed via the "
+                         "watchdog; the unarmed leg writes nothing)")
     ap.add_argument("--multislice", action="store_true",
                     help="run the multi-slice topology acceptance flow "
                          "(simulated two-tier grid: hierarchical route "
@@ -1606,6 +1809,9 @@ def main() -> int:
 
     if args.stream:
         return run_stream(args)
+
+    if args.compile_flow:
+        return run_compile(args)
 
     if args.elastic:
         return run_elastic(args)

@@ -34,6 +34,17 @@ import pytest  # noqa: E402
 # ---------------------------------------------------------------------------
 TRACECHECK = os.environ.get("CYLON_TPU_TRACECHECK") == "1"
 
+# CYLON_TPU_COMPILE_COUNT=1 (set by tests/run_all.py): count XLA
+# backend_compile events through the compile-lifecycle facade's
+# monitoring listener and print one greppable `# COMPILE_COUNT` line per
+# test file at session exit — the suite driver's per-file compile budget
+# audit (docs/robustness.md "Compile lifecycle")
+COMPILE_COUNT = os.environ.get("CYLON_TPU_COMPILE_COUNT") == "1"
+
+if COMPILE_COUNT:
+    from cylon_tpu.exec import compiler as _compiler
+    _compiler.install_listener()
+
 if TRACECHECK:
     from cylon_tpu.analysis import runtime as _rt
     _rt.enable()
@@ -51,6 +62,15 @@ def pytest_configure(config):
 
 
 def pytest_sessionfinish(session, exitstatus):
+    if COMPILE_COUNT:
+        from cylon_tpu.exec import compiler as _compiler
+        st = _compiler.stats()
+        names = sorted({os.path.basename(str(a)).split("::")[0]
+                        for a in session.config.args}) or ["?"]
+        print(f"\n# COMPILE_COUNT file={','.join(names)} "
+              f"n={st['compile_events']} "
+              f"seconds={st['compile_seconds']:g} "
+              f"live={st['programs_live']}", flush=True)
     if not TRACECHECK:
         return
     from cylon_tpu.analysis import runtime as _rt

@@ -1,0 +1,51 @@
+"""Known-bad fixture: raw compilation entry points outside the
+compile-lifecycle facade (TS117).  Every compile must ride
+``utils.cache.jit`` (deferring to ``exec/compiler.jit``) or
+``exec/compiler.aot_compile`` so the bounded compile ledger, the
+crash-quarantine intent journal, the watchdog and the persistent-cache
+manifest see it."""
+
+from functools import partial
+
+import jax
+
+
+def raw_jit_call(fn, x):
+    # TS117: raw jax.jit call
+    return jax.jit(fn)(x)
+
+
+@partial(jax.jit, static_argnames=("k",))  # TS117: raw partial argument
+def raw_jit_decorated(x, k):
+    return x * k
+
+
+def raw_pjit_call(fn):
+    from jax.experimental.pjit import pjit
+
+    # TS117: bare pjit is always raw (the facade only re-exports jit)
+    return pjit(fn)
+
+
+def raw_aot_chain(fn, x):
+    # TS117: .lower(...).compile() AOT chain bypasses aot_compile
+    return fn.lower(x).compile()
+
+
+def fine_facade(fn, x):
+    from cylon_tpu.utils.cache import jit
+
+    # clean: bare `jit` is the sanctioned cache-layer re-export
+    return jit(fn)(x)
+
+
+def fine_regex(pattern, text):
+    import re
+
+    # clean: .compile whose receiver is not a .lower(...) call
+    return re.compile(pattern).match(text)
+
+
+def fine_string_case(s):
+    # clean: str.lower without a trailing .compile
+    return s.lower()

@@ -21,5 +21,5 @@ def _builder_fn(mesh: Mesh, w: int):  # tracecheck: off[TS104]
             col = col * 2
         return col + counts[0]
 
-    return jax.jit(shard_map(per_shard, mesh=mesh,
-                             in_specs=None, out_specs=None))
+    return jax.jit(shard_map(per_shard,  # tracecheck: off[TS117]
+                             mesh=mesh, in_specs=None, out_specs=None))

@@ -33,8 +33,13 @@ RETRY_BACKOFF_S = 2.0
 
 def run_file(path: str, extra: list[str]) -> int:
     cmd = [sys.executable, "-m", "pytest", path, "-q", *extra]
+    # each file's process prints a greppable `# COMPILE_COUNT file=...
+    # n=...` line at exit (tests/conftest.py): the per-file compile
+    # budget audit that motivated this driver (XLA:CPU segfaults track
+    # compile accumulation) becomes a number in the tee'd log
+    env = dict(os.environ, CYLON_TPU_COMPILE_COUNT="1")
     for attempt in (1, 2):
-        r = subprocess.run(cmd, cwd=os.path.dirname(HERE))
+        r = subprocess.run(cmd, cwd=os.path.dirname(HERE), env=env)
         if r.returncode in (0, 5):     # 5 = no tests collected
             return 0
         # negative = killed by signal (SIGSEGV -11); retry once

@@ -1,0 +1,418 @@
+"""Compile-lifecycle facade tests (cylon_tpu.exec.compiler, round 19).
+
+Fast tests (tier-1): shape-family canonicalization is bit- AND
+order-equal to exact-shape placement for every join how, the fused
+join→groupby pushdown and the set ops at the pow2 boundary ±1; the
+compiled-program population stays FLAT as same-family tenant shapes
+multiply 4× (and grows without families — the escape hatch's contrast);
+the bounded compile ledger evicts LRU past ``CYLON_TPU_COMPILE_BUDGET``
+with consensus-wire builders pinned; orphaned compile intents are
+adopted into the quarantine and surface as typed
+``CompileQuarantinedError`` (a capacity fault — the recovery ladder's
+re-plan rung); injected stalls surface as typed ``CompileTimeoutError``
+via the compile watchdog; a poisoned persistent-manifest entry fails
+its content hash at arm time and drops to a clean recompile; and the
+unarmed happy path never enters the guarded lifecycle.
+"""
+
+import json
+from collections import OrderedDict
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import config
+from cylon_tpu.exec import compiler, recovery
+from cylon_tpu.frame import DataFrame
+from cylon_tpu.relational import join_tables, set_operation
+from cylon_tpu.status import (CapacityOverflowError, CompileQuarantinedError,
+                              CompileTimeoutError)
+
+HOWS = ["inner", "left", "right", "outer"]
+#: the pow2 family boundary the canonicalization tests straddle
+B = 256
+
+
+@pytest.fixture(autouse=True)
+def _clean_facade():
+    """Leave the facade exactly as tier-1 found it: injector disarmed,
+    counters zeroed, persistent-dir state dropped, armed-state cache
+    invalidated (recomputed lazily AFTER monkeypatch restores config)."""
+    yield
+    recovery.install_faults("")
+    compiler.reset_stats()
+    with compiler._lock:
+        compiler._DIR_STATE.update(path=None, quarantine=set(),
+                                   manifest={}, adopted=[])
+    compiler.rearm()
+
+
+# ---------------------------------------------------------------------------
+# shape families: the canonicalization decision
+# ---------------------------------------------------------------------------
+
+class TestFamilyCap:
+    def test_pow2_bucketing(self):
+        assert compiler.family_cap(0) == 0
+        assert compiler.family_cap(1) == config.pow2ceil(1)
+        for n in (B - 1, B + 1, 3 * B // 2):
+            assert compiler.family_cap(n) == config.pow2ceil(n)
+        # an exact family representative maps to itself: zero-copy ingest
+        assert compiler.family_cap(B) == B
+
+    def test_escape_hatch(self, monkeypatch):
+        monkeypatch.setattr(config, "SHAPE_FAMILIES", False)
+        for n in (0, B - 1, B, B + 1):
+            assert compiler.family_cap(n) == n
+
+    def test_pure_function_of_row_count(self):
+        # rank-uniform by construction: no env, mesh or clock input —
+        # repeated calls agree (the no-vote justification)
+        assert [compiler.family_cap(n) for n in (7, 300, 4097)] \
+            == [compiler.family_cap(n) for n in (7, 300, 4097)]
+
+
+# ---------------------------------------------------------------------------
+# canonicalized vs exact-shape: bit- and order-equality at the boundary
+# ---------------------------------------------------------------------------
+
+def _join_dfs(rng, n):
+    ldf = pd.DataFrame({"k": rng.integers(0, 40, n).astype(np.int32),
+                        "a": rng.random(n)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 40, 53).astype(np.int32),
+                        "b": rng.random(53)})
+    return ldf, rdf
+
+
+def _bit_equal(fam: pd.DataFrame, exact: pd.DataFrame):
+    # ORDER matters: no sort before comparison — the contract is that
+    # padding rides the validity lanes, so row order is identical too
+    pd.testing.assert_frame_equal(fam.reset_index(drop=True),
+                                  exact.reset_index(drop=True))
+
+
+class TestCanonicalizationBitEquality:
+    @pytest.mark.parametrize("n", [B - 1, B, B + 1])
+    @pytest.mark.parametrize("how", HOWS)
+    def test_join_hows(self, env1, rng, monkeypatch, n, how):
+        ldf, rdf = _join_dfs(rng, n)
+
+        def run():
+            lt = ct.Table.from_pandas(ldf, env1)
+            rt = ct.Table.from_pandas(rdf, env1)
+            return join_tables(lt, rt, "k", "k", how=how).to_pandas()
+
+        fam = run()
+        monkeypatch.setattr(config, "SHAPE_FAMILIES", False)
+        _bit_equal(fam, run())
+
+    @pytest.mark.parametrize("n", [B - 1, B + 1])
+    def test_fused_join_groupby(self, env1, rng, monkeypatch, n):
+        ldf = pd.DataFrame({"k": rng.integers(0, 20, n).astype(np.int32),
+                            "v": rng.random(n)})
+        rdf = pd.DataFrame({"k": np.arange(20, dtype=np.int32),
+                            "b": rng.random(20)})
+
+        def run():
+            l = DataFrame(ldf, env=env1)
+            r = DataFrame(rdf, env=env1)
+            j = l.merge(r, on="k", how="inner")   # defers into pushdown
+            return j.groupby("k").agg({"v": "sum", "b": "max"}).to_pandas()
+
+        fam = run()
+        monkeypatch.setattr(config, "SHAPE_FAMILIES", False)
+        _bit_equal(fam, run())
+
+    @pytest.mark.parametrize("n", [B - 1, B + 1])
+    @pytest.mark.parametrize("op", ["union", "intersect", "subtract"])
+    def test_set_ops(self, env1, rng, monkeypatch, n, op):
+        adf = pd.DataFrame({"k": rng.integers(0, 30, n).astype(np.int32),
+                            "g": rng.integers(0, 4, n).astype(np.int32)})
+        bdf = pd.DataFrame({"k": rng.integers(0, 30, 57).astype(np.int32),
+                            "g": rng.integers(0, 4, 57).astype(np.int32)})
+
+        def run():
+            ta = ct.Table.from_pandas(adf, env1)
+            tb = ct.Table.from_pandas(bdf, env1)
+            return set_operation(ta, tb, op).to_pandas()
+
+        fam = run()
+        monkeypatch.setattr(config, "SHAPE_FAMILIES", False)
+        _bit_equal(fam, run())
+
+    def test_decision_recorded_on_plan(self, env1, rng):
+        # the canonicalization decision is auditable: EXPLAIN output
+        # carries the family bucket AND the true ingest row count
+        from cylon_tpu.obs import plan as obs_plan
+        n = B + 1
+        df = pd.DataFrame({"k": rng.integers(0, 9, n).astype(np.int32)})
+
+        def ingest():
+            with obs_plan.node("ingest"):
+                return ct.Table.from_pandas(df, env1)
+
+        qp = obs_plan.explain(ingest)
+        attrs = {}
+        for root in qp.roots:
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                attrs.update(node.attrs)
+                stack.extend(getattr(node, "children", ()))
+        assert attrs.get("shape_family") == compiler.family_cap(n)
+        assert attrs.get("ingest_rows") == n
+
+
+# ---------------------------------------------------------------------------
+# flat compiled-program population across same-family tenants
+# ---------------------------------------------------------------------------
+
+class TestFlatProgramCount:
+    def test_four_x_tenants_one_program_family(self, env1, rng):
+        # four tenants whose plans differ only by near-miss row counts —
+        # all bucket onto the 1024 family, so tenants 2..4 add ZERO
+        # compiled programs (the multi-tenant compile-cost contract).
+        # Unique right keys keep the data-dependent OUTPUT capacity in
+        # one bucket too (output rows == left rows <= 1020 -> 1024).
+        sizes = [530, 700, 860, 1020]
+        misses_after_first = None
+        for i, n in enumerate(sizes):
+            ldf = pd.DataFrame(
+                {"k": rng.integers(0, 40, n).astype(np.int32),
+                 "a": rng.random(n)})
+            rdf = pd.DataFrame({"k": np.arange(40, dtype=np.int32),
+                                "b": rng.random(40)})
+            lt = ct.Table.from_pandas(ldf, env1)
+            rt = ct.Table.from_pandas(rdf, env1)
+            join_tables(lt, rt, "k", "k", how="inner")
+            if i == 0:
+                misses_after_first = compiler.stats()["cache_misses"]
+        assert compiler.stats()["cache_misses"] == misses_after_first
+
+    def test_escape_hatch_recompiles_per_shape(self, env1, rng,
+                                               monkeypatch):
+        monkeypatch.setattr(config, "SHAPE_FAMILIES", False)
+        misses_after_first = None
+        for i, n in enumerate([531, 701, 861]):
+            ldf, rdf = _join_dfs(rng, n)
+            lt = ct.Table.from_pandas(ldf, env1)
+            rt = ct.Table.from_pandas(rdf, env1)
+            join_tables(lt, rt, "k", "k", how="inner")
+            if i == 0:
+                misses_after_first = compiler.stats()["cache_misses"]
+        # exact-shape placement: every distinct row count is a new
+        # program family — the cost the canonicalization removes
+        assert compiler.stats()["cache_misses"] > misses_after_first
+
+
+# ---------------------------------------------------------------------------
+# the bounded compile ledger
+# ---------------------------------------------------------------------------
+
+class TestCompileLedger:
+    def test_budget_evicts_lru(self, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_BUDGET", 4)
+        mesh = type("M", (), {})()
+        lru: OrderedDict = OrderedDict()
+        base = compiler.stats()["cache_evictions"]
+        for i in range(7):
+            lru[("k", i)] = object()
+            compiler.on_insert(mesh, "tests.fake.builder", ("k", i), lru)
+        assert list(lru) == [("k", i) for i in range(3, 7)]
+        assert compiler.stats()["cache_evictions"] - base == 3
+
+    def test_consensus_wire_builders_pinned(self, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_BUDGET", 2)
+        mesh = type("M", (), {})()
+        wire: OrderedDict = OrderedDict()
+        user: OrderedDict = OrderedDict()
+        wire[("w",)] = object()
+        compiler.on_insert(mesh, "cylon_tpu.exec.recovery._consensus_fn",
+                           ("w",), wire)
+        for i in range(4):
+            user[i] = object()
+            compiler.on_insert(mesh, "tests.user.builder", i, user)
+        # the wire survives every budget pass; the user LRU pays
+        assert ("w",) in wire
+        assert len(user) <= 2
+
+    def test_hit_refreshes_recency(self, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_BUDGET", 2)
+        mesh = type("M", (), {})()
+        lru: OrderedDict = OrderedDict()
+        for i in range(2):
+            lru[i] = object()
+            compiler.on_insert(mesh, "tests.recency.builder", i, lru)
+        compiler.on_hit(mesh, "tests.recency.builder", 0)   # 0 is MRU now
+        lru[2] = object()
+        compiler.on_insert(mesh, "tests.recency.builder", 2, lru)
+        assert 0 in lru and 1 not in lru
+
+    def test_mesh_table_evict_counted(self):
+        before = compiler.stats()
+        compiler.on_table_evict(0xDEAD, 5)
+        after = compiler.stats()
+        assert after["mesh_table_evictions"] \
+            == before["mesh_table_evictions"] + 1
+        assert after["cache_evictions"] == before["cache_evictions"] + 5
+
+    def test_live_gauge_tracks_ledger(self):
+        mesh = type("M", (), {})()
+        lru: OrderedDict = OrderedDict()
+        base = compiler.live_programs()
+        lru["x"] = object()
+        compiler.on_insert(mesh, "tests.gauge.builder", "x", lru)
+        assert compiler.live_programs() == base + 1
+        del lru["x"]   # program retired → gauge prunes the dead entry
+        assert compiler.live_programs() == base
+
+
+# ---------------------------------------------------------------------------
+# crash quarantine: orphaned compile intents
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_orphan_intent_adopts_and_raises_typed(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_CACHE_DIR", str(tmp_path))
+        compiler.rearm()
+        prog = compiler.jit(lambda x: x + 1)
+        x = np.zeros((4,), np.int32)
+        sig = compiler._sig_hash(prog._facade_label, (x,), {})
+        # the predecessor died mid-compile: its intent journal survives
+        (tmp_path / "intent.rank0.json").write_text(json.dumps(
+            {"builder": prog._facade_label, "sig": sig, "pid": 12345}))
+        compiler.rearm()
+        with pytest.raises(CompileQuarantinedError) as ei:
+            prog(x)
+        assert ei.value.signature == sig
+        assert sig in compiler.quarantined_signatures()
+        assert compiler.stats()["quarantine_adoptions"] == 1
+        # adoption consumed the orphan and persisted the quarantine
+        assert not (tmp_path / "intent.rank0.json").exists()
+        q = json.loads((tmp_path / "quarantine.json").read_text())
+        assert sig in q["signatures"]
+        # the recovery ladder's re-plan rung: a DIFFERENT shape (what
+        # the pad/cap-halving rungs produce) compiles fine
+        y = np.zeros((8,), np.int32)
+        np.testing.assert_array_equal(np.asarray(prog(y)), y + 1)
+
+    def test_quarantined_error_is_a_capacity_fault(self):
+        # the ladder contract: CapacityOverflowError's rung re-plans at
+        # a halved cap — a different shape — instead of re-crashing
+        e = CompileQuarantinedError("x", site="compile.build",
+                                    signature="ab")
+        assert isinstance(e, CapacityOverflowError)
+        assert e.signature == "ab"
+
+    def test_happy_path_clears_intent(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_CACHE_DIR", str(tmp_path))
+        compiler.rearm()
+        prog = compiler.jit(lambda x: x * 3)
+        x = np.arange(4, dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x * 3)
+        # the guarded compile journaled its intent and cleared it
+        assert not (tmp_path / "intent.rank0.json").exists()
+        man = json.loads((tmp_path / "manifest.json").read_text())
+        assert len(man) == 1
+        sig, ent = next(iter(man.items()))
+        assert ent["sha"] == compiler._entry_sha(sig, ent["builder"])
+        assert compiler.expected_warm() == 1
+
+
+# ---------------------------------------------------------------------------
+# compile watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_stall_surfaces_typed(self, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_TIMEOUT_S", 0.2)
+        recovery.install_faults("compile.build=stall")
+        compiler.rearm()
+        prog = compiler.jit(lambda x: x * 2)
+        with pytest.raises(CompileTimeoutError) as ei:
+            prog(np.arange(3, dtype=np.int32))
+        assert ei.value.site == "compile.build"
+        assert compiler.stats()["watchdog_timeouts"] == 1
+        # the one-shot spec is consumed: the same compile now finishes
+        # under a generous budget
+        monkeypatch.setattr(config, "COMPILE_TIMEOUT_S", 60.0)
+        compiler.rearm()
+        x = np.arange(3, dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x * 2)
+
+    @pytest.mark.slow
+    def test_stall_without_budget_still_types(self):
+        # no configured timeout: the injected stall defaults to a 2 s
+        # budget so chaos runs always surface typed, never hang
+        recovery.install_faults("compile.build=stall")
+        compiler.rearm()
+        prog = compiler.jit(lambda x: x + 7)
+        with pytest.raises(CompileTimeoutError):
+            prog(np.arange(5, dtype=np.int32))
+
+
+# ---------------------------------------------------------------------------
+# persistent manifest: poisoned entries drop to a clean miss
+# ---------------------------------------------------------------------------
+
+class TestCorruptManifest:
+    def test_poisoned_entry_drops_and_recompiles_bit_equal(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_CACHE_DIR", str(tmp_path))
+        recovery.install_faults("compile.build=corrupt")
+        compiler.rearm()
+        prog = compiler.jit(lambda x: x - 1)
+        x = np.arange(5, dtype=np.int32)
+        out = np.asarray(prog(x))
+        np.testing.assert_array_equal(out, x - 1)
+        man = json.loads((tmp_path / "manifest.json").read_text())
+        assert next(iter(man.values()))["sha"] == "0" * 16
+        # relaunch: arm-time hash validation drops the poisoned entry —
+        # a clean miss, never wrong code
+        recovery.install_faults("")
+        compiler.reset_stats()
+        with compiler._lock:
+            compiler._DIR_STATE.update(path=None, quarantine=set(),
+                                       manifest={}, adopted=[])
+        compiler.rearm()
+        assert compiler.expected_warm() == 0
+        assert compiler.stats()["manifest_drops"] == 1
+        # the recompile is bit-equal and re-manifests with a VALID hash
+        np.testing.assert_array_equal(np.asarray(prog(x)), out)
+        man = json.loads((tmp_path / "manifest.json").read_text())
+        sig, ent = next(iter(man.items()))
+        assert ent["sha"] == compiler._entry_sha(sig, ent["builder"])
+
+
+# ---------------------------------------------------------------------------
+# the unarmed overhead contract
+# ---------------------------------------------------------------------------
+
+class TestUnarmed:
+    def test_unarmed_never_enters_lifecycle(self, monkeypatch):
+        compiler.rearm()
+        assert compiler.cache_dir() == ""
+        assert not compiler.armed()
+
+        def boom(*a, **k):
+            raise AssertionError("guarded lifecycle entered while unarmed")
+
+        monkeypatch.setattr(compiler, "_lifecycle", boom)
+        monkeypatch.setattr(compiler, "_ensure_dir", boom)
+        prog = compiler.jit(lambda x: x + 3)
+        x = np.arange(4, dtype=np.int32)
+        np.testing.assert_array_equal(np.asarray(prog(x)), x + 3)
+
+    def test_pinned_bypasses_even_armed(self, monkeypatch):
+        monkeypatch.setattr(config, "COMPILE_TIMEOUT_S", 0.2)
+        recovery.install_faults("compile.build=stall")
+        compiler.rearm()
+        assert compiler.armed()
+        prog = compiler.jit(lambda x: x + 9, pinned=True)
+        x = np.arange(4, dtype=np.int32)
+        # the consensus wire never rides the guarded path: the armed
+        # stall spec must not fire through a pinned program
+        np.testing.assert_array_equal(np.asarray(prog(x)), x + 9)

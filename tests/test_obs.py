@@ -254,8 +254,15 @@ class TestBenchDetail:
             "disk_events", "bytes_to_disk",
             "checkpoint_events", "bytes_checkpointed",
             "resume_fast_forwarded_pieces", "resume_resharded_pieces",
-            "resume_world_mismatch"}
+            "resume_world_mismatch",
+            # the compile-lifecycle block (round 19): a bench number
+            # always says how many executables were live and how much
+            # wall-clock went to XLA
+            "compile"}
         assert isinstance(bd["recovery_events"], list)
+        assert set(bd["compile"]) == {
+            "programs_live", "cache_hits", "cache_misses",
+            "cache_evictions", "compile_seconds"}
 
     def test_q3q5_selection(self):
         bd = obs.bench_detail(spill_keys=("spill_events", "bytes_spilled",
@@ -265,7 +272,7 @@ class TestBenchDetail:
             "peak_ledger_bytes",
             "checkpoint_events", "bytes_checkpointed",
             "resume_fast_forwarded_pieces", "resume_resharded_pieces",
-            "resume_world_mismatch"}
+            "resume_world_mismatch", "compile"}
 
     def test_serving_selection(self):
         bd = obs.bench_detail(
@@ -275,13 +282,13 @@ class TestBenchDetail:
         assert set(bd) == {
             "recovery_events", "spill_events", "bytes_spilled",
             "readmit_events", "cross_session_evictions",
-            "peak_ledger_bytes"}
+            "peak_ledger_bytes", "compile"}
 
     def test_streaming_selection_no_events(self):
         bd = obs.bench_detail(spill_keys=("window_evictions",
                                           "bytes_spilled"),
                               ckpt_keys=(), events=None)
-        assert set(bd) == {"window_evictions", "bytes_spilled"}
+        assert set(bd) == {"window_evictions", "bytes_spilled", "compile"}
 
     def test_plan_section_opt_in(self):
         """The profiler satellite: bench_detail(plan=...) adds a "plan"
