@@ -120,6 +120,18 @@ function within the same module) — and flags:
   is only flagged when its receiver is a ``.lower(...)`` call, so
   ``re.compile`` and friends never match;
 
+* **TS118** integrity-audit decisions outside the ``exec/integrity``
+  facade — a fingerprint primitive (``table_fingerprint``/
+  ``partition_fingerprint``/``fingerprint_consensus``/the registered
+  ``_fingerprint_fn`` builder) called directly from ``relational/``,
+  ``parallel/`` or ``topo/``, or a ``DataIntegrityError``
+  constructed/raised there: the facade's verb wrappers
+  (``conserve_*``/``verify_*``/``audit_*``) are what guarantee the
+  rank-coherent fingerprint vote lands BEFORE the raise/proceed
+  decision — a rank that fingerprints or raises on its own can desert
+  the others mid-collective — and that every check is counted into the
+  audit stats whose armed-overhead contract the bench JSON reports;
+
 * **TS110** streaming state transitions outside ``cylon_tpu/stream/``:
   a GroupBySink's private partial state written or list-mutated
   directly (``X._parts``/``X._regs``/``X._adopted``/``X._pending``) —
@@ -272,6 +284,19 @@ _TOPO_PLAN_FUNCS = {"topo_plan_consensus", "TopologyPlan", "hop_counts",
 #: (a post-vote mutation desyncs the voted plan hash and the grouped
 #: collectives' membership)
 _TOPO_PLAN_FIELDS = {"n_slices", "ranks_per_slice", "route", "gateway"}
+
+#: integrity-audit primitives callable ONLY through the exec/integrity
+#: facade's verb wrappers (TS118): the facade is where fingerprints are
+#: computed over the registered (jaxpr-gated) builder, voted
+#: rank-coherently BEFORE any raise/proceed decision, and counted into
+#: the audit stats — an operator module that fingerprints or raises the
+#: typed integrity fault directly can desync ranks (one raising while
+#: the rest proceed) and bypasses the armed-overhead accounting the
+#: bench contract reports.  Scoped to the operator directories; the
+#: facade lives in exec/ and is exempt by construction.
+_INTEGRITY_DIRS = ("relational", "parallel", "topo")
+_INTEGRITY_FUNCS = {"table_fingerprint", "partition_fingerprint",
+                    "fingerprint_consensus", "_fingerprint_fn"}
 
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "n_lanes", "cols",
                  "names", "ops"}
@@ -552,6 +577,7 @@ class _ModuleLint:
         self._check_skew_plan()
         self._check_topo_plan()
         self._check_raw_jit()
+        self._check_integrity_facade()
         return self.findings
 
     def _emit(self, rule: str, node, msg: str) -> None:
@@ -1004,6 +1030,57 @@ class _ModuleLint:
                             "mutation desyncs the canonical plan hash "
                             "and the grouped collectives' membership "
                             "(docs/trace_safety.md, docs/topology.md)")
+
+    def _check_integrity_facade(self) -> None:
+        """TS118: fingerprint computation or a typed integrity fault
+        raised outside the exec/integrity audit facade — the fingerprint
+        primitives (``table_fingerprint``/``partition_fingerprint``/
+        ``fingerprint_consensus``/the registered ``_fingerprint_fn``
+        builder) called directly from an operator module, or a
+        ``DataIntegrityError`` constructed/raised there.  The facade's
+        verb wrappers (``conserve_*``/``verify_*``/``audit_*``) are what
+        guarantee the rank-coherent consensus vote lands BEFORE the
+        raise/proceed decision (a rank that raises alone deserts the
+        others mid-collective) and that every check lands in the audit
+        stats the bench overhead contract reports.  Scoped to the
+        operator directories (relational/, parallel/, topo/); exec/ —
+        where the facade and the recovery ladder live — is exempt."""
+        parts = self.path.replace(os.sep, "/").split("/")
+        if not any(d in parts for d in _INTEGRITY_DIRS):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                fname = _func_name(node.func)
+                last = fname.split(".")[-1]
+                if last in _INTEGRITY_FUNCS:
+                    self._emit(
+                        "TS118", node,
+                        f"`{fname}` computes or votes a content "
+                        "fingerprint outside the exec/integrity audit "
+                        "facade — fingerprints must go through the "
+                        "facade's verb wrappers (verify_exchange/"
+                        "audit_table/audit_restored_table) so the "
+                        "rank-coherent vote precedes the raise/proceed "
+                        "decision and the check is counted "
+                        "(docs/trace_safety.md, docs/robustness.md)")
+                elif last == "DataIntegrityError":
+                    self._emit(
+                        "TS118", node,
+                        "`DataIntegrityError` constructed outside the "
+                        "exec/integrity audit facade — an operator "
+                        "module that raises the typed integrity fault "
+                        "directly skips the consensus vote, so one rank "
+                        "can abort while the rest proceed into the next "
+                        "collective (docs/trace_safety.md)")
+            elif isinstance(node, ast.Raise):
+                exc = node.exc
+                if isinstance(exc, ast.Name) \
+                        and exc.id == "DataIntegrityError":
+                    self._emit(
+                        "TS118", node,
+                        "`raise DataIntegrityError` outside the "
+                        "exec/integrity audit facade — see the facade's "
+                        "verb wrappers (docs/trace_safety.md)")
 
     def _check_use_after_donate(self) -> None:
         """TS108: a name passed at a statically-known donated position

@@ -106,6 +106,15 @@ CONSENSUS_LEAVES = frozenset({
     "watermark_consensus", "_plan_hash_consensus", "skew_plan_consensus",
     "topo_plan_consensus", "ckpt_resume_consensus", "preempt_consensus",
     "_consensus_wire", "_ns_consensus", "_consensus_fn",
+    # the exec/integrity audit facade's rank-coherent verbs: each
+    # computes a REPLICATED fingerprint and votes it over the pmax wire
+    # (fingerprint_consensus → _plan_hash_consensus) BEFORE any
+    # raise/proceed decision — the facade contract lint rule TS118
+    # scopes to exec/integrity — so a call site is a sanctioned
+    # sanitizer between rank-local state and the next collective,
+    # exactly like the wires it rides
+    "fingerprint_consensus", "audit_table", "verify_exchange",
+    "audit_restored_table",
 })
 
 #: collective facades resolvable without the full tree (single-file

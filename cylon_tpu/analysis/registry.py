@@ -33,6 +33,7 @@ BUILDER_MODULES = (
     "cylon_tpu.relational.repart",
     "cylon_tpu.exec.pipeline",
     "cylon_tpu.exec.recovery",
+    "cylon_tpu.exec.integrity",
     "cylon_tpu.stream.window",
 )
 

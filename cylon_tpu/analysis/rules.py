@@ -94,6 +94,14 @@ RULES = {
              "(exec/compiler.jit via utils.cache, aot_compile) so the "
              "compile ledger, intent journal, watchdog and quarantine "
              "see every compile; a raw jit is invisible to all four",
+    "TS118": "fingerprint computation or DataIntegrityError raised "
+             "outside the exec/integrity audit facade — operator "
+             "modules must go through the facade's verb wrappers "
+             "(conserve_*/verify_*/audit_*) so the rank-coherent "
+             "fingerprint vote precedes the raise/proceed decision and "
+             "every check lands in the audit stats; a rank that "
+             "fingerprints or raises alone deserts the others "
+             "mid-collective",
     "JX201": "collective under lax.cond/switch — rank-divergent deadlock",
     "JX202": "collective under data-dependent lax.while_loop",
     "JX203": "int32→int64 widening of a row-scale array under x64",

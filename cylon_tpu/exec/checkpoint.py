@@ -97,7 +97,8 @@ import time
 
 import numpy as np
 
-from ..status import CheckpointCorruptError, InvalidError, ResumableAbort
+from ..status import (CheckpointCorruptError, DataIntegrityError,
+                      InvalidError, ResumableAbort)
 from ..utils import timing
 
 
@@ -559,14 +560,23 @@ class Stage:
         :meth:`_commit` returns — a kill mid-write leaves staged files
         that resume ignores."""
         from . import recovery
+        from . import integrity as _integrity
         corrupt = recovery.maybe_inject(
             "ckpt.write", intercept=("corrupt",)) == "corrupt"
         i = int(i)
+        # armed audit (CYLON_TPU_AUDIT=1, exec/integrity): the piece's
+        # order-invariant content fingerprint rides the manifest entry so
+        # a resume can audit restored — and topology-mismatched adopted —
+        # pieces beyond the page shas (the shas only prove the bytes on
+        # disk match what was written; the fingerprint proves what was
+        # written matches what the piece held).  None when unarmed: zero
+        # cost, and old manifests without the key stay readable.
+        fp = _integrity.manifest_fingerprint(table)
         with timing.region("ckpt.write"):
             nbytes, meta_sha, meta_file = self._write_pages(i, table,
                                                             corrupt)
             self.committed[i] = {"meta": meta_file, "sha": meta_sha,
-                                 "nbytes": nbytes}
+                                 "nbytes": nbytes, "fp": fp}
             self._commit()
         _STATS["checkpoint_events"] += 1
         _STATS["bytes_checkpointed"] += nbytes
@@ -662,6 +672,13 @@ class Stage:
                                       cm["dictionary"], bounds=cm["bounds"])
         out = Table(cols, self.env, meta["valid_counts"])
         out.grouped_by = meta["grouped_by"]
+        # armed resume audit (exec/integrity): recompute the restored
+        # piece's order-invariant fingerprint against the manifest-
+        # recorded one — catches what the shas cannot (a rewrite with
+        # self-consistent hashes); a mismatch raises a typed
+        # DataIntegrityError and the caller recomputes, never adopts
+        from . import integrity
+        integrity.audit_restored_table(out, entry.get("fp"))
         _STATS["resume_fast_forwarded_pieces"] += 1
         timing.bump("ckpt.piece_restored")
         return out
@@ -714,7 +731,7 @@ class Stage:
             for i in range(n):
                 try:
                     out.append(self._load_one_foreign(i))
-                except CheckpointCorruptError as e:
+                except (CheckpointCorruptError, DataIntegrityError) as e:
                     if not (prefix_ok and out):
                         raise
                     recovery._record("ckpt.reshard", "corrupt",
@@ -733,6 +750,7 @@ class Stage:
         from ..core.table import Table
         from . import memory
         meta = None
+        fp_rec = None
         merged: list[list] = []
         for rd, man in self.foreign["manifests"].items():
             entry = man["pieces"][str(i)]
@@ -742,6 +760,7 @@ class Stage:
                                     dir=stage_dir))
             if meta is None:
                 meta = meta_d
+                fp_rec = entry.get("fp")
                 merged = [[] for _ in meta["pages"]]
             for j, page in enumerate(meta_d["pages"]):
                 raw = self._read_verified(page["file"], page["sha"],
@@ -795,7 +814,14 @@ class Stage:
                                       cm["dictionary"], bounds=nb)
         # per-shard key contiguity does not survive re-blocking: the
         # grouped contract is deliberately dropped, consumers re-derive
-        return Table(cols, self.env, dest)
+        out = Table(cols, self.env, dest)
+        # armed adoption audit (exec/integrity): the order-invariant
+        # fingerprint is topology-independent — the XOR over per-row
+        # hashes survives the stitch + re-block — so the OLD world's
+        # recorded fp audits the table as adopted onto the NEW mesh
+        from . import integrity
+        integrity.audit_restored_table(out, fp_rec, site="ckpt.reshard")
+        return out
 
     def _read_verified(self, fname: str, want_sha: str,
                        dir: str | None = None) -> bytes:

@@ -993,7 +993,7 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
     outs = []
     adopted_whole = False
     if stage is not None and ckpt.resume_requested():
-        from ..status import CheckpointCorruptError
+        from ..status import CheckpointCorruptError, DataIntegrityError
         from . import recovery
         restored: list = []
         foreign = stage.foreign is not None
@@ -1002,7 +1002,9 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
                    and stage.has_piece(len(restored))):
                 try:
                     restored.append(stage.load_piece(len(restored)))
-                except CheckpointCorruptError as e:
+                except (CheckpointCorruptError, DataIntegrityError) as e:
+                    # an armed manifest-fingerprint miss degrades exactly
+                    # like page corruption: recompute, never adopt
                     ckpt.corrupt_fallback(stage, len(restored), e)
                     break
         elif foreign and stage.foreign_complete:
@@ -1012,7 +1014,7 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
             # onto this mesh; any corruption degrades to recompute
             try:
                 restored = stage.load_foreign_pieces()
-            except CheckpointCorruptError as e:
+            except (CheckpointCorruptError, DataIntegrityError) as e:
                 ckpt.corrupt_fallback(stage, len(restored), e)
                 restored = []
         # rank-coherent fast-forward: every rank adopts the MINIMUM
@@ -1102,7 +1104,8 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
         # dispatch span per piece — paired with the sink's async
         # in-flight span, the Perfetto timeline shows piece r+1's
         # dispatch overlapping piece r's consume
-        t_disp = _time.perf_counter() if _trace.armed() else None
+        trace_armed = _trace.armed()   # process-uniform (env-armed)
+        t_disp = _time.perf_counter() if trace_armed else 0.0
         piece_l, piece_r = nxt.get()
         nxt = None
         if i + 1 < len(live_ranges) and _prefetch_ok(live_ranges[i + 1]):
@@ -1118,7 +1121,7 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
                                 how=how, suffixes=suffixes,
                                 assume_colocated=True,
                                 allow_defer=(sink is not None))
-        if t_disp is not None:
+        if trace_armed:
             _trace.complete("pipe.piece_dispatch", t_disp,
                             piece=int(live_ranges[i]))
         with timing.region("pipe.consume"):
@@ -1175,6 +1178,15 @@ def _pipelined_join_impl(left: Table, right: Table, left_on, right_on,
     if sink is not None:
         return outs
     out = concat_tables(outs) if len(outs) > 1 else outs[0]
+    from . import integrity as _integrity
+    if _integrity.armed():
+        # armed audit (exec/integrity): vote the assembled pipeline
+        # output's order-invariant fingerprint rank-coherently at the
+        # stage boundary — a rank that stitched different bytes (a
+        # corrupted piece that slipped past the per-exchange checks)
+        # surfaces typed here instead of as a silently diverged answer
+        _integrity.audit_table(out, site="pipe.stitch",
+                               phase="post_pipeline")
     if left_on == right_on and not adopted_whole:
         # pieces are key-grouped (sorted merge order) in key-range order and
         # hash-colocated: the concatenation keeps the grouped contract —

@@ -89,9 +89,9 @@ from .. import config
 from ..ctx.context import ROW_AXIS
 from ..obs import trace as _trace
 from ..status import (CapacityOverflowError, CheckpointCorruptError, Code,
-                      CylonError, DeviceOOMError, FAULT_TYPES,
-                      PredictedResourceExhausted, RankDesyncError,
-                      ResumableAbort)
+                      CylonError, DataIntegrityError, DeviceOOMError,
+                      FAULT_TYPES, PredictedResourceExhausted,
+                      RankDesyncError, ResumableAbort)
 from ..utils.cache import program_cache
 
 shard_map = jax.shard_map
@@ -145,12 +145,22 @@ shard_map = jax.shard_map
 #: drill), and ``corrupt`` poisons the persistent warm-manifest entry
 #: the facade just wrote — the next process must drop it on the hash
 #: check (clean miss), never load wrong code.
+#: The integrity-audit sites (exec/integrity, docs/robustness.md
+#: "Integrity audit tier"): ``exchange.corrupt`` fires just AFTER an
+#: exchange delivered its buffers — kind ``corrupt`` is INTERCEPTED
+#: there and flips one element of one received column in place (rank/
+#: nth/``@session``-selectable), the silent-corruption drill the armed
+#: fingerprint layer must catch; and ``audit.verify`` wraps the armed
+#: fingerprint verification's consensus pull — ``stall`` there hangs
+#: the audit vote inside the exchange watchdog (typed RankDesyncError,
+#: never a hang).
 SITES = ("shuffle.recv_guard", "join.piece_cap", "groupby.device_oom",
          "exchange.stall", "spill.evict", "spill.upload",
          "disk.write", "disk.read",
          "ckpt.write", "ckpt.load", "ckpt.reshard", "pipe.phase_sync",
          "stream.append", "stream.watermark", "obs.export",
-         "sched.preempt", "compile.build")
+         "sched.preempt", "compile.build",
+         "exchange.corrupt", "audit.verify")
 
 #: fault kinds accepted by the injection grammar; ``spill_stall`` hangs
 #: a spill-tier host↔device transfer inside the watchdog (the spill
@@ -717,6 +727,12 @@ def _fault_from_wire(wire: int, msg: str) -> CylonError:
         # takes the identical recompute rung (the corrupt owner's data
         # exists nowhere else — recompute, never a wrong answer)
         return CheckpointCorruptError(msg, site="disk.read")
+    if code == Code.IntegrityFault:
+        # a peer's conservation law or armed fingerprint failed: every
+        # rank takes the identical one-recompute rung (silent corruption
+        # degrades to recompute, never to a wrong answer)
+        return DataIntegrityError(msg, site="audit.verify",
+                                  phase=_last_phase())
     return RankDesyncError(msg, phase=_last_phase())
 
 
@@ -944,6 +960,23 @@ def topo_plan_consensus(mesh: Mesh | None, plan_hash: int) -> None:
                          "topology-plan")
 
 
+def fingerprint_consensus(mesh: Mesh | None, fp: int) -> None:
+    """Rank-coherent verification of an order-invariant content
+    fingerprint (exec/integrity — the TS118 facade is the only
+    sanctioned caller; docs/robustness.md "Integrity audit tier"):
+    every rank computes the REPLICATED 64-bit mesh fingerprint for the
+    same stage boundary and votes :class:`Code.IntegrityFault` with two
+    20-bit slices of it over the four-round double-polarity hash wire
+    (:func:`_plan_hash_consensus`), so a rank whose device delivered
+    different bytes raises typed BEFORE anyone commits the stage —
+    identically on every rank, exactly like a plan vote.  Polled only
+    under ``CYLON_TPU_AUDIT=1`` in multiprocess sessions: the unarmed
+    path (and any single-controller session, where the replicated
+    fingerprint is trivially coherent) stays collective-free."""
+    _plan_hash_consensus(mesh, Code.IntegrityFault, fp, "audit.verify",
+                         "fingerprint-audit")
+
+
 def ckpt_resume_consensus(mesh: Mesh | None, n: int) -> int:
     """Min-agree the resume fast-forward count (exec/pipeline): each
     rank votes how many committed pieces IT restored and verified, and
@@ -1101,9 +1134,14 @@ def retry_io(fn, site: str, attempts: int = 3, base_delay_s: float = 0.05,
 #: corruption (Code.SerializationError from a ``disk.*`` site — a spill
 #: page failed its sha check, so that owner's data exists nowhere else)
 #: takes exactly one recompute of the stage at the base streaming
-#: configuration — corruption degrades to recompute, never a wrong answer
+#: configuration — corruption degrades to recompute, never a wrong answer;
+#: an INTEGRITY fault (Code.IntegrityFault — a conservation law or armed
+#: content fingerprint caught data in flight being mutated) mirrors the
+#: disk-corruption rung exactly: ONE recompute of the stage at the base
+#: streaming configuration, then a typed abort on repeat
 RETRY_RUNGS = {Code.OutOfMemory: (4, 16), Code.CapacityError: (8,),
-               Code.SerializationError: (4,)}
+               Code.SerializationError: (4,),
+               Code.IntegrityFault: (4,)}
 
 _tls = threading.local()
 

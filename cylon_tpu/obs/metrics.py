@@ -446,7 +446,13 @@ def maybe_write_snapshot() -> bool:
     _SNAP[1] = now + float(
         os.environ.get("CYLON_TPU_METRICS_INTERVAL_S", "30"))
     try:
-        write_snapshot(path)
+        # the periodic write rides the recovery tier's bounded
+        # transient-OSError backoff (a scrape sidecar racing the rename,
+        # a briefly-full tmpfs): one flaky write no longer drops a whole
+        # interval's telemetry.  Non-transient errnos re-raise
+        # immediately into the warn-once fallback below.
+        from ..exec.recovery import retry_io
+        retry_io(lambda: write_snapshot(path), "obs.snapshot")
     except OSError as e:
         if not _SNAP_WARNED[0]:
             # warn ONCE: the operator armed this path and would
@@ -518,10 +524,18 @@ BENCH_CKPT_KEYS = ("checkpoint_events", "bytes_checkpointed",
 #: rebuilt its program family (docs/robustness.md "Compile lifecycle")
 BENCH_COMPILE_KEYS = ("programs_live", "cache_hits", "cache_misses",
                       "cache_evictions", "compile_seconds")
+#: the data-integrity audit counters (exec/integrity.stats) every bench
+#: JSON carries — a bench number always says whether the audit tier was
+#: armed (nonzero fingerprint checks ⇒ its ≤10% overhead is included in
+#: the measurement) and whether it fired (docs/robustness.md "Integrity
+#: audit tier")
+BENCH_AUDIT_KEYS = ("conservation_checks", "fingerprint_checks",
+                    "violations")
 
 
 def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
                  compile_keys=BENCH_COMPILE_KEYS,
+                 audit_keys=BENCH_AUDIT_KEYS,
                  events: str | None = "drain", plan=None) -> dict:
     """The counter block every bench script previously hand-rolled:
     recovery events (``events="drain"`` empties the log like bench.py
@@ -548,6 +562,10 @@ def bench_detail(*, spill_keys=BENCH_SPILL_KEYS, ckpt_keys=BENCH_CKPT_KEYS,
     if compile_keys:
         comp = compiler.stats()
         out["compile"] = {k: comp[k] for k in compile_keys}
+    if audit_keys:
+        from ..exec import integrity
+        au = integrity.stats()
+        out["audit"] = {k: au[k] for k in audit_keys}
     if plan is not None:
         out["plan"] = plan.to_dict() if hasattr(plan, "to_dict") else plan
     return out

@@ -273,11 +273,18 @@ def export(path: str | None = None) -> str | None:
         return None
     from ..exec import recovery
     recovery.maybe_inject("obs.export")
-    try:
+
+    def _write() -> None:
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(rec.chrome_trace(), f)
         os.replace(tmp, path)
+
+    try:
+        # ride the recovery tier's bounded transient-OSError backoff
+        # (exec/recovery.retry_io) before the typed wrap below: a
+        # sidecar racing the rename costs a retry, not the trace
+        recovery.retry_io(_write, "obs.export")
     except OSError as e:
         from ..status import ExecutionError
         raise ExecutionError(

@@ -485,3 +485,45 @@ def np_result_dtype(op: str, src: np.dtype) -> np.dtype:
         return (np.dtype(np.float32) if src == np.dtype(np.float32)
                 else np.dtype(np.float64))
     return np.dtype(src)
+
+
+# ---------------------------------------------------------------------------
+# armed-audit saturation guard (the integrity tier's abort-not-wrong
+# satellite — docs/robustness.md "Integrity audit tier")
+# ---------------------------------------------------------------------------
+
+#: int64 accumulators past this magnitude count as saturated: 2**62
+#: leaves headroom for ONE more combine doubling, so the guard fires
+#: while the value is still meaningful — both pre-wrap (a huge positive
+#: one step from wrapping) and post-wrap (the wrapped negative) land
+#: outside the rail.  The ±rail form also avoids the int64 abs(INT64_MIN)
+#: trap (abs of the minimum is itself negative).
+SATURATION_RAIL = 1 << 62
+
+
+def guard_saturation(op: str, data, *, column=None,
+                     site: str = "groupby.finalize") -> None:
+    """Armed-audit overflow guard (``CYLON_TPU_AUDIT=1``): int64
+    ``sum``/``count`` accumulators wrap silently in XLA — a saturated
+    aggregate is a WRONG answer, not an error.  Called at the host
+    assembly boundary (concrete result columns, never inside a traced
+    builder); raises a typed
+    :class:`~cylon_tpu.status.NumericOverflowError` so the run aborts
+    instead of publishing the wrap.  Unarmed: one env-cached load."""
+    from ..exec import integrity
+    if not integrity.armed():
+        return
+    if op not in ("sum", "count"):
+        return
+    if np.dtype(getattr(data, "dtype", "f8")) != np.dtype(np.int64):
+        return
+    if not getattr(data, "size", 0):
+        return
+    hi, lo = int(jnp.max(data)), int(jnp.min(data))
+    if hi > SATURATION_RAIL or lo < -SATURATION_RAIL:
+        from ..status import NumericOverflowError
+        raise NumericOverflowError(
+            f"groupby {op} accumulator saturated int64 (|value| > 2**62; "
+            f"max={hi}, min={lo}): the aggregate has wrapped or is one "
+            "combine away from wrapping — aborting instead of returning "
+            "a silently wrong answer", site=site, column=column)

@@ -538,6 +538,23 @@ def exchange(mesh: Mesh, tgt, counts: np.ndarray, cols: tuple,
         # all rounds run in ONE compiled program (fori_loop if rounds>1)
         fn = _round_fn(mesh, w, block, out_cap, max(rounds, 1))
         outs = fn(tgt_s, perm, pos, counts_i, outs, tuple(cols))
+    # integrity audit tier (exec/integrity, docs/robustness.md): the
+    # corruption drill first (so the audit below is what catches it),
+    # then the always-on conservation laws — pure host math on the
+    # already-pulled sidecar, zero device work — then, ARMED only
+    # (CYLON_TPU_AUDIT=1), fingerprint conservation across the route:
+    # the XOR content fingerprint of the valid input rows must equal
+    # the delivered outputs', whichever route carried them
+    from ..exec import integrity as _integrity, recovery as _recovery
+    if _recovery.maybe_inject("exchange.corrupt",
+                              intercept=("corrupt",)) == "corrupt":
+        _recovery._record("exchange.corrupt", "corrupt", "flipped")
+        outs = _integrity.flip_one(mesh, outs, per_dest)
+    _integrity.conserve_exchange(counts, per_dest, total, row_bytes,
+                                 site=owner)
+    if _integrity.armed():
+        _integrity.verify_exchange(mesh, tgt, cols, outs, per_dest,
+                                   site=owner)
     if guard:
         # HBM-ledger accounting of the receive allocation (exec/memory):
         # one registration PER buffer, each anchored to its own array, so

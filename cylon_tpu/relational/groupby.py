@@ -554,6 +554,10 @@ def _result_table(env, by_names, by_cols, key_out, kval_out, res_names,
         phys = physical_np_dtype(t)
         if d.dtype != phys:  # f64 accumulators -> declared result dtype
             d = d.astype(phys)
+        # armed-audit overflow guard (result names are `{col}_{op}` — a
+        # public contract, so the op suffix is derivable here at the one
+        # host assembly point every groupby route funnels through)
+        gbk.guard_saturation(n.rsplit("_", 1)[-1], d, column=n)
         cols[n] = Column(d, t, v, dc)
     return Table(cols, env, np.asarray(n_groups, np.int64))
 
@@ -652,7 +656,13 @@ def combine_sink_partials(partial: Table, by, aggs, chunk_aggs,
         else:
             # non-derived ops (sum/count/min/max) ARE their own single
             # intermediate — the combined column passes through renamed
-            cols[name] = comb.column(part_name(col, op))
+            c = comb.column(part_name(col, op))
+            # armed-audit overflow guard at the COMBINE boundary: two
+            # partials each below the rail can wrap when folded, and the
+            # disjoint pass-through never reaches _result_table's guard
+            gbk.guard_saturation(op, c.data, column=name,
+                                 site="groupby.combine")
+            cols[name] = c
     out = Table(cols, env, np.asarray(comb.valid_counts, np.int64))
     out.grouped_by = None  # combine order is chunk-partial order
     return out

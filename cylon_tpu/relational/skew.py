@@ -860,7 +860,16 @@ def stitch_join_output(out: Table, key_out_names, plan: SkewPlan,
             per_row.astype(np.int64), plan.fanout.astype(np.int64),
             np.int64(total), *datas, *valids, *plan.tuple_args())
     with timing.region("skew.stitch_place"):
-        return place_by_global_pos(out, pos, total)
+        stitched = place_by_global_pos(out, pos, total)
+    from ..exec import integrity as _integrity
+    if _integrity.armed():
+        # armed audit (exec/integrity facade): the stitched table's
+        # order-invariant fingerprint is voted rank-coherently — a
+        # corrupted or mis-placed stitch surfaces typed at this stage
+        # boundary instead of as a silently reordered answer downstream
+        _integrity.audit_table(stitched, site="skew.stitch",
+                               phase="post_stitch")
+    return stitched
 
 
 # ---------------------------------------------------------------------------

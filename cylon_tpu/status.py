@@ -65,6 +65,18 @@ class Code(enum.IntEnum):
     #: a two-hop exchange its peers route differently.  Not an error
     #: class — never raised.
     TopoPlan = 50
+    #: data-integrity audit fault (exec/integrity + exec/recovery): a
+    #: conservation law or an armed content fingerprint failed — bytes
+    #: in flight were lost, duplicated or mutated.  Raised as
+    #: :class:`DataIntegrityError` and retried ONCE by the ladder's
+    #: recompute rung (mirroring the disk-corruption rung: corruption
+    #: degrades to recompute, never to a wrong answer); the fingerprint
+    #: verdict itself rides the double-polarity plan-hash wire with this
+    #: code so every rank agrees on the failing site before anyone
+    #: raises.  Must stay < 64: the wire packs ``code*4+sub`` under the
+    #: ladder's 1024 base and ``code << 20`` under the checkpoint
+    #: namespace base.
+    IntegrityFault = 51
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42
@@ -159,9 +171,52 @@ class RankDesyncError(CylonError):
         self.phase = phase
 
 
-#: the four recovery-fault types, in one tuple for isinstance dispatch
+class DataIntegrityError(CylonError):
+    """The integrity audit tier (exec/integrity) caught data in flight
+    being lost, duplicated or mutated: a conservation law over the
+    exchange count sidecar failed (always-on, pure host math), or an
+    armed order-invariant content fingerprint stopped matching across a
+    stage boundary (``CYLON_TPU_AUDIT=1``).  Carries the facade ``site``
+    (``exchange.conserve``, ``audit.verify``, ``ckpt.audit`` ...) and
+    the dataflow ``phase`` (``post_exchange``, ``post_stitch``,
+    ``stream_absorb``, ``resume``).  A fault type: the consensus ladder
+    recomputes the affected stage ONCE (the silent-corruption analogue
+    of the disk-corruption rung), then aborts typed on repeat — never a
+    wrong answer, never an unbounded retry loop."""
+
+    code = Code.IntegrityFault
+    kind = "integrity"
+
+    def __init__(self, msg: str = "", site: str | None = None,
+                 phase: str | None = None):
+        super().__init__(msg)
+        self.site = site
+        self.phase = phase
+
+
+#: the recovery-fault types, in one tuple for isinstance dispatch
 FAULT_TYPES = (PredictedResourceExhausted, DeviceOOMError,
-               CapacityOverflowError, RankDesyncError)
+               CapacityOverflowError, RankDesyncError,
+               DataIntegrityError)
+
+
+class NumericOverflowError(CylonError):
+    """An armed-audit accumulator check (ops/groupby finalize under
+    ``CYLON_TPU_AUDIT=1``) found an int64 sum/count at the saturation
+    rail: the combine tree wrapped (or is one combine away from
+    wrapping), so the aggregate would be silently wrong.  NOT a fault
+    type — no retry rung can un-wrap modular arithmetic, so the
+    contract is abort-not-wrong: classified typed, surfaced to the
+    caller, never retried."""
+
+    code = Code.ExecutionError
+    kind = "overflow"
+
+    def __init__(self, msg: str = "", site: str | None = None,
+                 column: str | None = None):
+        super().__init__(msg)
+        self.site = site
+        self.column = column
 
 
 class ResumableAbort(CylonError):

@@ -95,7 +95,7 @@ class IncrementalView:
         the new layout so the next resume is plain."""
         from ..exec import checkpoint as ckpt
         from ..exec import recovery
-        from ..status import CheckpointCorruptError
+        from ..status import CheckpointCorruptError, DataIntegrityError
         if not ckpt.enabled():
             return
         base = ckpt.plan_token(
@@ -111,7 +111,11 @@ class IncrementalView:
                 while stage.has_piece(len(restored)):
                     try:
                         restored.append(stage.load_piece(len(restored)))
-                    except CheckpointCorruptError as e:
+                    except (CheckpointCorruptError,
+                            DataIntegrityError) as e:
+                        # a manifest-fingerprint miss (armed audit)
+                        # degrades exactly like page corruption:
+                        # recompute, never adopt
                         ckpt.corrupt_fallback(stage, len(restored), e)
                         break
             elif foreign:
@@ -120,7 +124,7 @@ class IncrementalView:
                     # the verified 0..k-1 prefix instead of discarding
                     # the stream's whole committed history
                     restored = stage.load_foreign_pieces(prefix_ok=True)
-                except CheckpointCorruptError as e:
+                except (CheckpointCorruptError, DataIntegrityError) as e:
                     ckpt.corrupt_fallback(stage, len(restored), e)
                     restored = []
             n = recovery.ckpt_resume_consensus(
@@ -155,6 +159,15 @@ class IncrementalView:
         if self._skip > 0:
             self._skip -= 1
             return
+        from ..exec import integrity
+        if integrity.armed():
+            # armed audit (exec/integrity): vote the absorbed batch's
+            # order-invariant fingerprint rank-coherently BEFORE it is
+            # folded into the long-lived partials — a rank that ingested
+            # different bytes surfaces typed here, not as a silently
+            # diverged snapshot later
+            integrity.audit_table(batch, site="stream.absorb",
+                                  phase="stream_absorb")
         self.sink.absorb(batch)
         if (self.compact_every
                 and len(self.sink._parts) >= self.compact_every):

@@ -313,6 +313,12 @@ class HopPrep:
         #: whole slice's traffic for one local index
         self.cap1 = config.pow2ceil(int(self.per_gw.max())
                                     if self.per_gw.size else 1)
+        # always-on conservation laws over the derived hop matrices
+        # (exec/integrity — the audit facade owns the typed raise):
+        # host math on arrays this constructor just built, zero device
+        # work, checked ONCE per exchange at derivation time
+        from ..exec import integrity as _integrity
+        _integrity.conserve_hops(counts, self.c1, self.c2)
 
 
 def prepare(plan, counts: np.ndarray) -> HopPrep:

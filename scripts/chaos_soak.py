@@ -107,6 +107,25 @@ SAME dir reruns clean — a timeout does not poison the cache); and the
 unarmed contract leg (no compile env vars ⇒ the facade never arms,
 never creates its dir, and writes nothing).
 
+``--audit`` switches to the DATA-INTEGRITY AUDIT acceptance flow
+(cylon_tpu/exec/integrity, docs/robustness.md "Integrity audit tier"):
+a monolithic join+groupby whose unarmed run is the bit-equality oracle.
+Pinned legs: the armed clean run (``CYLON_TPU_AUDIT=1`` — bit-equal,
+fingerprint checks > 0, zero violations, and exactly the unarmed run's
+exchange rows/count: the audit adds no exchange traffic); an injected
+silent corruption (``exchange.corrupt=corrupt`` flips one exchanged
+byte) which the armed fingerprint must catch as a typed
+``DataIntegrityError`` the ladder converts into ONE recompute —
+bit-equal, with the ``integrity`` recovery event on the record;
+PERSISTENT corruption (``exchange.corrupt::*=corrupt``) which must end
+in a typed abort, never a silent wrong answer; the same one-shot
+corruption under the skew-split route (``CYLON_TPU_SKEW_SPLIT=1``) and
+under the two-tier topology route (``CYLON_TPU_SLICES=2`` +
+``CYLON_TPU_TOPO_SHUFFLE=1``) — caught at the post-exchange stage
+either way, recovered onto the same voted plan, bit-equal; and the
+UNARMED contract leg: zero fingerprint checks, zero fingerprint votes
+(the conservation laws still run — they are free host math).
+
 ``--skew`` switches to the ADAPTIVE-SKEW-SPLIT acceptance flow
 (docs/skew.md): a monolithic skewed-key join+groupby (one hot key on
 ~80% of probe rows) whose unsplit run (``CYLON_TPU_SKEW_SPLIT=0``) is
@@ -129,6 +148,7 @@ Usage::
     python scripts/chaos_soak.py --skew --rows 4000
     python scripts/chaos_soak.py --compile --rows 3000
     python scripts/chaos_soak.py --multislice --rows 3000
+    python scripts/chaos_soak.py --audit --rows 3000
 
 Exit status 0 = every schedule converged; 1 otherwise.  A trimmed soak
 runs in CI as a slow-marked test (tests/test_checkpoint.py); the
@@ -558,7 +578,7 @@ def _worker_skew(args, env) -> int:
     import numpy as np
 
     import cylon_tpu as ct
-    from cylon_tpu.exec import recovery
+    from cylon_tpu.exec import integrity, recovery
     from cylon_tpu.obs import metrics
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.relational import skew as skew_facade
@@ -593,6 +613,11 @@ def _worker_skew(args, env) -> int:
                       if plan is not None else None),
         "skew_split_joins": int(metrics.counter("skew_split_joins").value),
         "exchange_rows": int(metrics.counter("exchange_rows_total").value),
+        # integrity-audit counters: the --audit flow asserts these
+        **{f"audit_{k}": v for k, v in integrity.stats().items()
+           if k in ("conservation_checks", "fingerprint_checks",
+                    "fingerprint_votes", "violations",
+                    "corruptions_injected")},
     }), flush=True)
     return 0
 
@@ -609,7 +634,7 @@ def _worker_topo(args, env) -> int:
     import numpy as np
 
     import cylon_tpu as ct
-    from cylon_tpu.exec import recovery
+    from cylon_tpu.exec import integrity, recovery
     from cylon_tpu.obs import metrics
     from cylon_tpu.relational import groupby_aggregate, join_tables
     from cylon_tpu.topo import model as topo_model
@@ -642,6 +667,11 @@ def _worker_topo(args, env) -> int:
             metrics.counter("exchange_dcn_messages_total").value),
         "dcn_wire_bytes": int(
             metrics.counter("exchange_dcn_wire_bytes_total").value),
+        # integrity-audit counters: the --audit flow asserts these
+        **{f"audit_{k}": v for k, v in integrity.stats().items()
+           if k in ("conservation_checks", "fingerprint_checks",
+                    "fingerprint_votes", "violations",
+                    "corruptions_injected")},
     }), flush=True)
     return 0
 
@@ -780,6 +810,160 @@ def run_multislice(args) -> int:
     if own_workdir:
         shutil.rmtree(args.workdir, ignore_errors=True)
     print(json.dumps({"multislice": True, "failures": len(failures),
+                      "detail": failures[:10]}))
+    return 1 if failures else 0
+
+
+def run_audit(args) -> int:
+    """The ``--audit`` acceptance flow (pinned, not drawn) — see the
+    module docstring.  Drives the integrity audit tier
+    (cylon_tpu/exec/integrity) end to end: silent exchange corruption
+    injected via ``exchange.corrupt`` must be CAUGHT by the armed
+    fingerprint (typed, one recompute, bit-equal) on the flat, the
+    skew-split and the two-tier topology routes; persistent corruption
+    must end in a typed abort; and the unarmed path must do zero
+    fingerprint work."""
+    own_workdir = args.workdir is None
+    args.workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_audit_")
+    failures: list = []
+
+    def spawn(tag, faults, extra=None, skew=False):
+        workdir = os.path.join(args.workdir, tag)
+        return _spawn(args, workdir, faults, resume=False,
+                      extra_env=extra or {}, world=4,
+                      skew=skew, multislice=not skew)
+
+    def integrity_event(info):
+        # the ladder's recompute of a caught corruption records an
+        # event with kind="integrity"
+        return any(ev.get("kind") == "integrity"
+                   for ev in (info or {}).get("event_list") or [])
+
+    # unarmed baseline: the bit-equality oracle AND the zero-overhead
+    # contract — no fingerprint checks, no fingerprint votes (the
+    # conservation laws still run; they are free host math)
+    p, base = spawn("base", "")
+    if p.returncode != 0 or not base or not base.get("sha"):
+        print((p.stdout + p.stderr)[-3000:], file=sys.stderr)
+        print("chaos-soak: audit baseline failed", file=sys.stderr)
+        return 1
+    print(f"# audit unarmed baseline sha={base['sha'][:16]} "
+          f"conservation_checks={base['audit_conservation_checks']}",
+          flush=True)
+    if base.get("audit_fingerprint_checks") \
+            or base.get("audit_fingerprint_votes"):
+        failures.append(f"UNARMED run did fingerprint work: {base}")
+    if not base.get("audit_conservation_checks"):
+        failures.append(f"conservation laws not always-on: {base}")
+
+    # armed clean run: bit-equal, fingerprints checked, zero
+    # violations, and exactly the unarmed run's exchange traffic (the
+    # audit's all_gather is not an exchange — armed adds no exchange
+    # collectives, and the checks are stage-boundary, not per-row)
+    p, info = spawn("armed", "", extra={"CYLON_TPU_AUDIT": "1"})
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"armed clean run diverged (rc={p.returncode}): "
+                        f"{info}\n{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("audit_fingerprint_checks") \
+            or not info.get("audit_fingerprint_votes"):
+        failures.append(f"armed run never fingerprinted: {info}")
+    elif info.get("audit_violations"):
+        failures.append(f"armed clean run reported violations: {info}")
+    elif (info.get("exchange_rows") != base.get("exchange_rows")
+          or info.get("exchange_count") != base.get("exchange_count")):
+        failures.append(
+            f"arming the audit changed exchange traffic: {info} != {base}")
+    else:
+        print(f"# audit armed clean -> ok (fp_checks="
+              f"{info['audit_fingerprint_checks']})", flush=True)
+
+    # one-shot silent corruption, armed: the flipped byte must surface
+    # as a typed DataIntegrityError the ladder converts into ONE
+    # recompute — bit-equal, with the integrity event on the record
+    p, info = spawn("corrupt", "exchange.corrupt=corrupt",
+                    extra={"CYLON_TPU_AUDIT": "1"})
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"caught-corruption leg diverged "
+                        f"(rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("audit_violations") \
+            or not info.get("audit_corruptions_injected"):
+        failures.append(f"corruption not injected/detected: {info}")
+    elif not integrity_event(info):
+        failures.append(f"no integrity recovery event recorded: {info}")
+    elif info.get("events", 0) > MAX_RECOVERY_EVENTS:
+        failures.append(f"corruption recovery events out of range: {info}")
+    else:
+        print("# audit one-shot corruption -> ok (caught, one recompute, "
+              "bit-equal)", flush=True)
+
+    # PERSISTENT corruption: every recompute re-flips, so the ladder
+    # must exhaust its single rung and abort TYPED — a wrong answer or
+    # a clean exit here is the silent-corruption disaster this tier
+    # exists to prevent
+    p, info = spawn("persist", "exchange.corrupt::*=corrupt",
+                    extra={"CYLON_TPU_AUDIT": "1"})
+    if p.returncode == 0:
+        failures.append(f"persistent corruption returned a result: {info}")
+    elif "DataIntegrityError" not in (p.stderr or ""):
+        failures.append(f"persistent corruption died untyped "
+                        f"(rc={p.returncode}): "
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    else:
+        print("# audit persistent corruption -> typed abort (ok)",
+              flush=True)
+
+    # corruption under the SKEW-SPLIT route: the fingerprint must catch
+    # it at the post-exchange stage inside the split join, and the
+    # recompute must land on the same voted plan, bit-equal
+    skew_env = {"CYLON_TPU_SKEW_SPLIT": "1", "CYLON_TPU_AUDIT": "1"}
+    p, sbase = spawn("skew_base", "", extra=skew_env, skew=True)
+    if p.returncode != 0 or not sbase or not sbase.get("sha") \
+            or not sbase.get("skew_split_joins"):
+        failures.append(f"audit skew baseline failed (rc={p.returncode}, "
+                        f"did it split?): {sbase}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    else:
+        p, info = spawn("skew_corrupt", "exchange.corrupt=corrupt",
+                        extra=skew_env, skew=True)
+        if p.returncode != 0 or not info \
+                or info.get("sha") != sbase["sha"]:
+            failures.append(f"skew-route corruption leg diverged "
+                            f"(rc={p.returncode}): {info}\n"
+                            f"{(p.stdout + p.stderr)[-2000:]}")
+        elif not info.get("audit_violations") or not integrity_event(info):
+            failures.append(f"skew-route corruption not caught: {info}")
+        elif info.get("plan_hash") != sbase.get("plan_hash"):
+            failures.append(f"skew-route recompute changed the voted "
+                            f"plan: {info.get('plan_hash')} != "
+                            f"{sbase.get('plan_hash')}")
+        else:
+            print("# audit corruption under skew-split -> ok (caught, "
+                  "same plan, bit-equal)", flush=True)
+
+    # corruption under the TWO-TIER topology route: the hierarchical
+    # exchange's delivered bytes are fingerprint-verified exactly like
+    # the flat route's — caught post-exchange, bit-equal to the flat
+    # oracle (route bit-equality is the topo tier's own invariant)
+    topo_env = {"CYLON_TPU_SLICES": "2", "CYLON_TPU_TOPO_SHUFFLE": "1",
+                "CYLON_TPU_AUDIT": "1"}
+    p, info = spawn("topo_corrupt", "exchange.corrupt=corrupt",
+                    extra=topo_env)
+    if p.returncode != 0 or not info or info.get("sha") != base["sha"]:
+        failures.append(f"topo-route corruption leg diverged "
+                        f"(rc={p.returncode}): {info}\n"
+                        f"{(p.stdout + p.stderr)[-2000:]}")
+    elif not info.get("topo_plans_voted"):
+        failures.append(f"topo-route leg never voted a plan: {info}")
+    elif not info.get("audit_violations") or not integrity_event(info):
+        failures.append(f"topo-route corruption not caught: {info}")
+    else:
+        print("# audit corruption under two-tier route -> ok (caught, "
+              "bit-equal)", flush=True)
+
+    if own_workdir:
+        shutil.rmtree(args.workdir, ignore_errors=True)
+    print(json.dumps({"audit": True, "failures": len(failures),
                       "detail": failures[:10]}))
     return 1 if failures else 0
 
@@ -1467,7 +1651,7 @@ def _spawn(args, workdir: str, faults: str, resume: bool,
               "CYLON_TPU_TOPO_SHUFFLE", "CYLON_TPU_FLEET_CASE",
               "CYLON_TPU_FLEET_TARGET", "CYLON_TPU_ADMISSION_TIMEOUT_S",
               "CYLON_TPU_COMPILE_CACHE_DIR", "CYLON_TPU_COMPILE_TIMEOUT_S",
-              "CYLON_TPU_COMPILE_BUDGET"):
+              "CYLON_TPU_COMPILE_BUDGET", "CYLON_TPU_AUDIT"):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -1783,6 +1967,14 @@ def main() -> int:
                          "DCN messages; whole-slice kill resumes via "
                          "elastic reshard; unarmed single-slice leg "
                          "adds zero collectives)")
+    ap.add_argument("--audit", action="store_true",
+                    help="run the data-integrity audit acceptance flow "
+                         "(armed silent-corruption drill caught as a "
+                         "typed DataIntegrityError and recomputed "
+                         "bit-equal on the flat, skew-split and "
+                         "two-tier routes; persistent corruption "
+                         "aborts typed; the unarmed leg does zero "
+                         "fingerprint work)")
     ap.add_argument("--fleet", action="store_true",
                     help="run the fleet-survival acceptance flow "
                          "(preemptive drain/requeue with in-process "
@@ -1803,6 +1995,9 @@ def main() -> int:
 
     if args.skew:
         return run_skew(args)
+
+    if args.audit:
+        return run_audit(args)
 
     if args.multislice:
         return run_multislice(args)

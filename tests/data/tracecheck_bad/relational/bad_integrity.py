@@ -1,0 +1,40 @@
+"""TS118 fixture: integrity-audit decisions outside the exec/integrity
+facade — fingerprint primitives called directly from an operator module,
+or the typed ``DataIntegrityError`` constructed/raised there.  The
+facade's verb wrappers are what guarantee the rank-coherent fingerprint
+vote lands BEFORE the raise/proceed decision."""
+
+
+def my_audit(mesh, table, tgt, cols, integ, DataIntegrityError):
+    # flagged: the whole-table fingerprint primitive called directly —
+    # skips the consensus vote and the audit-stats accounting
+    fp = integ.table_fingerprint(table)
+    # flagged: the partition primitive, same hazard
+    fp2 = integ.partition_fingerprint(mesh, cols, targets=tgt)
+    # flagged: a direct vote out of sequence
+    integ.fingerprint_consensus(mesh, fp)
+    # flagged: the registered builder invoked outside the facade
+    integ._fingerprint_fn(mesh, 4, 2, "prefix")
+    if fp != fp2:
+        # flagged: a rank-local raise — deserts the other ranks
+        # mid-collective instead of voting first
+        raise DataIntegrityError("mismatch", site="shuffle.recv")
+    return fp
+
+
+def my_check(ok, DataIntegrityError):
+    if not ok:
+        # flagged: constructing the typed fault outside the facade
+        err = DataIntegrityError("bad", site="topo.exchange")
+        return err
+    return None
+
+
+def fine_route(table, outs, per_dest, mesh, tgt, cols, integ):
+    # NOT flagged: the sanctioned facade verbs — the vote precedes the
+    # raise/proceed decision inside them
+    integ.conserve_exchange(None, per_dest, 0, 8)
+    if integ.armed():
+        integ.verify_exchange(mesh, tgt, cols, outs, per_dest)
+        integ.audit_table(table, site="skew.stitch", phase="post_stitch")
+    return outs

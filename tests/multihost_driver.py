@@ -426,6 +426,51 @@ _topo_model._reslice()
 print(f"TOPO_OK pid={pid} plan={format(tplan.plan_hash(), '016x')} "
       f"dcn_messages={tt['dcn_messages']}", flush=True)
 
+# Integrity audit tier (cylon_tpu/exec/integrity, docs/robustness.md
+# "Integrity audit tier"): arm the fingerprint layer mid-process and
+# re-run the join — every post-exchange fingerprint vote rides the REAL
+# cross-process consensus wire here.  The armed run must stay bit-equal
+# with zero violations, the final table's order-invariant fingerprint
+# must be identical across ranks (allgathered crc — order-invariance
+# makes it shard-layout-independent), and a corruption injected on RANK
+# 0 ONLY must surface rank-coherently: the gathered fingerprint matrix
+# is the same everywhere, so EVERY rank raises the typed
+# DataIntegrityError and retries identically — no deadlock, exactly one
+# integrity recovery event per rank, bit-equal after the recompute.
+from cylon_tpu.exec import integrity as _integrity
+
+env.barrier()
+os.environ["CYLON_TPU_AUDIT"] = "1"
+_integrity.rearm()
+_integrity.reset_stats()
+aj = join_tables(lt, rt, "k", "k", how="inner")
+audit_got = (aj.to_pandas().sort_values(["k", "a", "b"])
+             .reset_index(drop=True))
+pd.testing.assert_frame_equal(audit_got, baseline, check_dtype=False)
+ist = _integrity.stats()
+assert ist["fingerprint_checks"] >= 1, ist
+assert ist["fingerprint_votes"] >= 1, ist
+assert ist["violations"] == 0, ist
+afp = _integrity.table_fingerprint(aj)
+assert afp is not None
+fp_sig = np.int64(zlib.crc32(format(afp, "016x").encode()))
+fp_sigs = np.atleast_1d(multihost_utils.process_allgather(fp_sig))
+assert len({int(s) for s in fp_sigs}) == 1, fp_sigs
+
+env.barrier()
+recovery.reset_events()
+recovery.install_faults("exchange.corrupt:0:1=corrupt")
+cj = join_tables(lt, rt, "k", "k", how="inner")
+cdf = cj.to_pandas().sort_values(["k", "a", "b"]).reset_index(drop=True)
+pd.testing.assert_frame_equal(cdf, baseline, check_dtype=False)
+ievs = [e for e in recovery.recovery_events() if e["kind"] == "integrity"]
+assert len(ievs) == 1, recovery.recovery_events()
+recovery.install_faults("")
+del os.environ["CYLON_TPU_AUDIT"]
+_integrity.rearm()
+print(f"AUDIT_OK pid={pid} fp={format(afp, '016x')} "
+      f"checks={ist['fingerprint_checks']}", flush=True)
+
 env.barrier()
 print(f"MULTIHOST_OK pid={pid} world={env.world_size} rows={j.row_count}",
       flush=True)

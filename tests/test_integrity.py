@@ -1,0 +1,479 @@
+"""End-to-end data-integrity audit tier (cylon_tpu.exec.integrity,
+docs/robustness.md "Integrity audit tier"): the always-on conservation
+laws over the exchange count sidecar, the armed order-invariant content
+fingerprints and their stage-boundary votes, the manifest-fingerprint
+resume audit, the ``Code.IntegrityFault`` recompute rung, the
+``audit.verify`` stall drill, the armed-only int64 saturation guard,
+and the retry_io routing of the obs snapshot/trace writers."""
+
+import errno
+import glob
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import config
+from cylon_tpu.exec import checkpoint, integrity, pipelined_join, recovery
+from cylon_tpu.obs import metrics
+from cylon_tpu.relational import groupby_aggregate, join_tables
+from cylon_tpu.relational.setops import set_operation
+from cylon_tpu.status import (Code, DataIntegrityError,
+                              NumericOverflowError, RankDesyncError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    """Every test starts disarmed with empty event/occurrence state."""
+    recovery.install_faults("")
+    recovery.reset_events()
+    yield
+    recovery.install_faults("")
+    recovery.reset_events()
+
+
+@pytest.fixture()
+def audit_armed():
+    """Arm the fingerprint layer for one test (the cached env read is
+    re-read on both edges so neighbours stay unarmed)."""
+    old = os.environ.get("CYLON_TPU_AUDIT")
+    os.environ["CYLON_TPU_AUDIT"] = "1"
+    integrity.rearm()
+    yield
+    if old is None:
+        os.environ.pop("CYLON_TPU_AUDIT", None)
+    else:
+        os.environ["CYLON_TPU_AUDIT"] = old
+    integrity.rearm()
+
+
+def _tables(env, rng, n=1500, card=150):
+    ldf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                        "a": rng.integers(0, 50, n).astype(np.int64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, card, n).astype(np.int64),
+                        "b": rng.integers(0, 50, n).astype(np.int64)})
+    return (ldf, rdf, ct.Table.from_pandas(ldf, env),
+            ct.Table.from_pandas(rdf, env))
+
+
+def _sorted(t, cols):
+    return t.to_pandas().sort_values(cols).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# layer 2 primitive: the order-invariant content fingerprint
+# ---------------------------------------------------------------------------
+
+class TestFingerprint:
+    def _df(self, rng, n=600):
+        df = pd.DataFrame({
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "x": rng.random(n),
+            "v": rng.integers(0, 9, n).astype("float64")})
+        df.loc[df.index % 7 == 0, "v"] = np.nan   # validity lanes too
+        return df
+
+    def test_order_and_placement_invariant(self, env8, rng):
+        df = self._df(rng)
+        fp0 = integrity.table_fingerprint(ct.Table.from_pandas(df, env8))
+        shuffled = df.sample(frac=1.0, random_state=3) \
+            .reset_index(drop=True)
+        fp1 = integrity.table_fingerprint(
+            ct.Table.from_pandas(shuffled, env8))
+        assert fp0 is not None and fp0 == fp1
+
+    def test_world_invariant(self, env8, env4, rng):
+        # the resume-audit property: a piece re-blocked onto a
+        # different world fingerprints identically
+        df = self._df(rng)
+        fp8 = integrity.table_fingerprint(ct.Table.from_pandas(df, env8))
+        fp4 = integrity.table_fingerprint(ct.Table.from_pandas(df, env4))
+        assert fp8 == fp4
+
+    def test_content_sensitive(self, env8, rng):
+        df = self._df(rng)
+        fp0 = integrity.table_fingerprint(ct.Table.from_pandas(df, env8))
+        bumped = df.copy()
+        bumped.loc[1, "k"] += 1
+        assert integrity.table_fingerprint(
+            ct.Table.from_pandas(bumped, env8)) != fp0
+        # a low-mantissa float flip must change it too (nothing is
+        # canonicalized or downcast on the audit lanes)
+        tiny = df.copy()
+        tiny.loc[2, "x"] += 1e-12
+        assert integrity.table_fingerprint(
+            ct.Table.from_pandas(tiny, env8)) != fp0
+
+    def test_validity_sensitive(self, env8, rng):
+        df = self._df(rng)
+        fp0 = integrity.table_fingerprint(ct.Table.from_pandas(df, env8))
+        nulled = df.copy()
+        nulled.loc[3, "v"] = np.nan
+        assert not np.isnan(df.loc[3, "v"])   # the flip is real
+        assert integrity.table_fingerprint(
+            ct.Table.from_pandas(nulled, env8)) != fp0
+
+    def test_world1_deterministic(self, env1):
+        # even a local 1-device mesh fingerprints (and twice the same)
+        t = ct.Table.from_pydict(
+            {"k": np.arange(8, dtype=np.int64)}, env1)
+        fp = integrity.table_fingerprint(t)
+        assert isinstance(fp, int)
+        assert integrity.table_fingerprint(t) == fp
+
+
+# ---------------------------------------------------------------------------
+# layer 1: conservation laws (pure host math, unit-level)
+# ---------------------------------------------------------------------------
+
+class TestConservation:
+    def _good(self, **kw):
+        # mirror what a real exchange does: bump the registry, then audit
+        counts = np.array([[1, 2], [3, 4]], np.int64)
+        per_dest = counts.sum(axis=0)
+        metrics.counter("exchange_rows_total").inc(10)
+        metrics.counter("exchange_bytes_total").inc(80)
+        integrity.conserve_exchange(counts, per_dest, 10, 8, **kw)
+
+    def test_good_sidecar_passes(self):
+        before = integrity.stats()["conservation_checks"]
+        self._good()
+        assert integrity.stats()["conservation_checks"] == before + 1
+
+    def test_negative_count_raises_typed(self):
+        counts = np.array([[1, -2], [3, 4]], np.int64)
+        with pytest.raises(DataIntegrityError) as ei:
+            integrity.conserve_exchange(counts, counts.sum(axis=0), 6, 8,
+                                        site="shuffle.recv")
+        assert ei.value.code == Code.IntegrityFault
+        assert ei.value.site == "shuffle.recv"
+        assert ei.value.phase == "post_exchange"
+
+    def test_delivery_mismatch_raises(self):
+        counts = np.array([[1, 2], [3, 4]], np.int64)
+        with pytest.raises(DataIntegrityError, match="rows-received"):
+            integrity.conserve_exchange(counts, np.array([4, 7]), 10, 8)
+
+    def test_total_mismatch_raises(self):
+        counts = np.array([[1, 2], [3, 4]], np.int64)
+        with pytest.raises(DataIntegrityError, match="logical row total"):
+            integrity.conserve_exchange(counts, counts.sum(axis=0), 11, 8)
+
+    def test_counter_running_ahead_raises_then_resync(self):
+        # rows accounted outside the audited exchange path are a drift
+        metrics.counter("exchange_rows_total").inc(999)
+        try:
+            with pytest.raises(DataIntegrityError,
+                               match="ran ahead"):
+                self._good()
+        finally:
+            # reset_stats re-seeds the mirror from the live counters so
+            # the always-on audit of later tests stays green
+            integrity.reset_stats()
+        self._good()
+
+    def test_registry_reset_resyncs_not_raises(self):
+        metrics.reset("exchange_rows_total")
+        metrics.reset("exchange_bytes_total")
+        before = integrity.stats()["reconcile_resyncs"]
+        self._good()
+        assert integrity.stats()["reconcile_resyncs"] == before + 1
+
+    def test_hops_identities(self):
+        c = np.array([[1, 2], [3, 4]], np.int64)
+        c1 = np.diag(c.sum(axis=1))
+        integrity.conserve_hops(c, c1, c)   # exact identities: passes
+        with pytest.raises(DataIntegrityError, match="before ICI"):
+            integrity.conserve_hops(c, 2 * c1, c)
+        with pytest.raises(DataIntegrityError, match="lost on DCN"):
+            integrity.conserve_hops(c, c1, np.zeros_like(c))
+        bad_gw = np.array([[2, 2], [2, 4]], np.int64)   # col sums ok
+        with pytest.raises(DataIntegrityError, match="gateway"):
+            integrity.conserve_hops(c, c1, bad_gw)
+        with pytest.raises(DataIntegrityError) as ei:
+            integrity.conserve_hops(c, -c1, c)
+        assert ei.value.site == "topo.exchange"
+
+
+# ---------------------------------------------------------------------------
+# armed stage-boundary audits across operators
+# ---------------------------------------------------------------------------
+
+class TestArmedOperators:
+    @pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+    def test_joins_bit_equal_and_audited(self, env8, rng, how,
+                                         audit_armed):
+        ldf, rdf, lt, rt = _tables(env8, rng)
+        s0 = integrity.stats()
+        got = _sorted(join_tables(lt, rt, "k", "k", how=how),
+                      ["k", "a", "b"])
+        s1 = integrity.stats()
+        assert s1["fingerprint_checks"] > s0["fingerprint_checks"]
+        assert s1["fingerprint_votes"] > s0["fingerprint_votes"]
+        assert s1["violations"] == s0["violations"]
+        exp = (ldf.merge(rdf, on="k", how=how)
+               .sort_values(["k", "a", "b"]).reset_index(drop=True))
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(
+            got["k"].to_numpy(na_value=-1).astype(np.int64),
+            exp["k"].to_numpy(na_value=-1).astype(np.int64))
+
+    def test_set_op_bit_equal_and_audited(self, env8, rng, audit_armed):
+        _, _, lt, rt = _tables(env8, rng, n=800)
+        la = lt.project(["k"])
+        rb = rt.project(["k"])
+        s0 = integrity.stats()["fingerprint_checks"]
+        got = _sorted(set_operation(la, rb, "union"), ["k"])
+        assert integrity.stats()["fingerprint_checks"] > s0
+        integrity.rearm()
+        os.environ["CYLON_TPU_AUDIT"] = "0"
+        try:
+            base = _sorted(set_operation(la, rb, "union"), ["k"])
+        finally:
+            os.environ["CYLON_TPU_AUDIT"] = "1"
+            integrity.rearm()
+        pd.testing.assert_frame_equal(got, base)
+
+    def test_stream_absorb_audited(self, env4, audit_armed):
+        from cylon_tpu.stream import IncrementalView, StreamTable
+        rng = np.random.default_rng(5)
+        st = StreamTable(env4, key="k", name="t_audit")
+        view = IncrementalView(st, "k", [("v", "sum")], env=env4)
+        s0 = integrity.stats()["fingerprint_checks"]
+        batches = []
+        for _ in range(2):
+            b = {"k": rng.integers(0, 16, 400).astype(np.int64),
+                 "v": rng.integers(0, 9, 400).astype(np.int64)}
+            batches.append(b)
+            st.append(dict(b))
+        # one audit vote per absorbed batch
+        assert integrity.stats()["fingerprint_checks"] >= s0 + 2
+        got = _sorted(view.read(), ["k"])
+        full = ct.Table.from_pydict(
+            {c: np.concatenate([b[c] for b in batches])
+             for c in ("k", "v")}, env4)
+        exp = _sorted(groupby_aggregate(full, "k", [("v", "sum")]), ["k"])
+        pd.testing.assert_frame_equal(got, exp, check_exact=True)
+
+    def test_unarmed_zero_fingerprint_work(self, env8, rng):
+        _, _, lt, rt = _tables(env8, rng)
+        s0 = integrity.stats()
+        join_tables(lt, rt, "k", "k", how="inner")
+        s1 = integrity.stats()
+        assert s1["fingerprint_checks"] == s0["fingerprint_checks"]
+        assert s1["fingerprint_votes"] == s0["fingerprint_votes"]
+        # the conservation laws stay on — they are free host math
+        assert s1["conservation_checks"] > s0["conservation_checks"]
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the IntegrityFault recompute rung
+# ---------------------------------------------------------------------------
+
+class TestRecoveryRung:
+    def test_one_shot_corruption_recomputed_bit_equal(self, env8, rng,
+                                                      audit_armed):
+        ldf, rdf, lt, rt = _tables(env8, rng)
+        base = _sorted(join_tables(lt, rt, "k", "k", how="inner"),
+                       ["k", "a", "b"])
+        recovery.reset_events()
+        recovery.install_faults("exchange.corrupt=corrupt")
+        got = _sorted(join_tables(lt, rt, "k", "k", how="inner"),
+                      ["k", "a", "b"])
+        pd.testing.assert_frame_equal(got, base)
+        evs = [e for e in recovery.recovery_events()
+               if e["kind"] == "integrity"]
+        assert len(evs) == 1, recovery.recovery_events()
+        assert evs[0]["action"].startswith("retry"), evs
+
+    def test_persistent_corruption_aborts_typed(self, env8, rng,
+                                                audit_armed):
+        _, _, lt, rt = _tables(env8, rng)
+        recovery.reset_events()
+        recovery.install_faults("exchange.corrupt::*=corrupt")
+        with pytest.raises(DataIntegrityError) as ei:
+            join_tables(lt, rt, "k", "k", how="inner")
+        assert ei.value.code == Code.IntegrityFault
+        assert ei.value.site == "shuffle.recv"
+        assert ei.value.phase == "post_exchange"
+        acts = [e["action"] for e in recovery.recovery_events()
+                if e["kind"] == "integrity"]
+        # exactly ONE recompute rung, then the typed abort
+        assert acts.count("abort") == 1, acts
+        assert sum(a.startswith("retry") for a in acts) == 1, acts
+
+    def test_audit_verify_stall_surfaces_typed(self, env8, rng,
+                                               audit_armed, monkeypatch):
+        _, _, lt, rt = _tables(env8, rng, n=600)
+        monkeypatch.setattr(config, "EXCHANGE_WATCHDOG_S", 0.2)
+        recovery.install_faults("audit.verify=stall")
+        with pytest.raises(RankDesyncError):
+            join_tables(lt, rt, "k", "k", how="inner")
+
+
+# ---------------------------------------------------------------------------
+# manifest fingerprints: the resume audit
+# ---------------------------------------------------------------------------
+
+class TestManifestAudit:
+    @pytest.fixture(autouse=True)
+    def _ckpt(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CYLON_TPU_CKPT_DIR", str(tmp_path / "ckpt"))
+        monkeypatch.delenv("CYLON_TPU_RESUME", raising=False)
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        yield
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+
+    def test_unit_fp_recorded_and_audited(self, env4, rng, audit_armed):
+        _, _, lt, _ = _tables(env4, rng, n=800)
+        stage = checkpoint.open_stage(env4, "unit_fp", "tok")
+        stage.save_piece(0, lt)
+        entry = stage.committed[0]
+        assert entry["fp"] is not None
+        stage.load_piece(0)   # clean round trip passes the audit
+        assert integrity.stats()["manifest_audits"] >= 1
+        # pages + shas intact, recorded fingerprint off by one bit:
+        # ONLY the content audit can catch this
+        entry["fp"] ^= 1
+        with pytest.raises(DataIntegrityError, match="refusing to adopt"):
+            stage.load_piece(0)
+
+    def test_unarmed_saves_record_none(self, env4, rng):
+        _, _, lt, _ = _tables(env4, rng, n=600)
+        stage = checkpoint.open_stage(env4, "unit_nofp", "tok")
+        stage.save_piece(0, lt)
+        assert stage.committed[0]["fp"] is None
+        # a None recording never audits, armed or not
+        integrity.audit_restored_table(lt, None)
+
+    def test_tampered_manifest_fp_recomputes_never_adopts(
+            self, env4, rng, audit_armed, monkeypatch):
+        ldf, rdf, lt, rt = _tables(env4, rng, n=1200)
+        base = (pipelined_join(lt, rt, "k", "k", how="inner", n_chunks=3)
+                .to_pandas().sort_values(["k", "a", "b"])
+                .reset_index(drop=True))
+        mans = sorted(glob.glob(os.path.join(
+            checkpoint.ckpt_dir(), "rank*", "stage*", "MANIFEST.json")))
+        assert mans
+        with open(mans[0], encoding="utf-8") as f:
+            man = json.load(f)
+        # tamper the LAST piece: the earlier ones must still
+        # fast-forward (a fingerprint miss poisons the piece, not the
+        # stage prefix before it)
+        piece = sorted(man["pieces"], key=int)[-1]
+        assert man["pieces"][piece]["fp"] is not None
+        man["pieces"][piece]["fp"] ^= 1   # shas all still valid
+        with open(mans[0], "w", encoding="utf-8") as f:
+            json.dump(man, f)
+        monkeypatch.setenv("CYLON_TPU_RESUME", "1")
+        checkpoint.reset_stages()
+        checkpoint.reset_stats()
+        recovery.reset_events()
+        resumed = (pipelined_join(lt, rt, "k", "k", how="inner",
+                                  n_chunks=3)
+                   .to_pandas().sort_values(["k", "a", "b"])
+                   .reset_index(drop=True))
+        pd.testing.assert_frame_equal(resumed, base)
+        # the tampered piece was recomputed, the rest fast-forwarded
+        st = checkpoint.stats()
+        assert st["resume_fast_forwarded_pieces"] == 2, st
+        assert any(e["site"] == "ckpt.load" and e["action"] == "recompute"
+                   for e in recovery.recovery_events())
+
+
+# ---------------------------------------------------------------------------
+# armed int64 saturation guard (groupby finalize + combine)
+# ---------------------------------------------------------------------------
+
+class TestSaturationGuard:
+    def test_finalize_guard_raises_typed(self, env8, audit_armed):
+        t = ct.Table.from_pydict(
+            {"k": np.zeros(3, np.int64),
+             "v": np.full(3, np.int64(1) << 61)}, env8)
+        with pytest.raises(NumericOverflowError) as ei:
+            groupby_aggregate(t, "k", [("v", "sum")])
+        assert ei.value.site == "groupby.finalize"
+        assert ei.value.column == "v_sum"
+
+    def test_unarmed_returns_exact_value(self, env8):
+        t = ct.Table.from_pydict(
+            {"k": np.zeros(3, np.int64),
+             "v": np.full(3, np.int64(1) << 61)}, env8)
+        out = groupby_aggregate(t, "k", [("v", "sum")]).to_pandas()
+        assert int(out["v_sum"].iloc[0]) == 3 * (1 << 61)
+
+    def test_overflow_at_combine_boundary(self, env8, audit_armed):
+        # regression: two partials each BELOW the rail wrap when folded;
+        # the disjoint pass-through never reaches the finalize guard
+        from cylon_tpu.relational.groupby import combine_sink_partials
+        partial = ct.Table.from_pydict(
+            {"k": np.arange(2, dtype=np.int64),
+             "v_sum": np.full(2, (np.int64(1) << 62) + 7)}, env8)
+        with pytest.raises(NumericOverflowError) as ei:
+            combine_sink_partials(partial, ["k"], [("v", "sum")],
+                                  [("v", "sum")], {"sum": "sum"},
+                                  disjoint=True)
+        assert ei.value.site == "groupby.combine"
+
+    def test_mean_and_small_sums_unguarded(self, env8, audit_armed):
+        t = ct.Table.from_pydict(
+            {"k": np.zeros(4, np.int64),
+             "v": np.arange(4, dtype=np.int64)}, env8)
+        out = groupby_aggregate(t, "k", [("v", "sum"), ("v", "mean")])
+        assert int(out.to_pandas()["v_sum"].iloc[0]) == 6
+
+
+# ---------------------------------------------------------------------------
+# obs writers ride retry_io (flaky-then-ok regression)
+# ---------------------------------------------------------------------------
+
+class TestObsRetryIO:
+    def test_snapshot_flaky_then_ok(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "metrics.json")
+        monkeypatch.setenv("CYLON_TPU_METRICS_JSON", path)
+        monkeypatch.setattr(metrics, "_SNAP", [None, 0.0])
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        real_replace = os.replace
+        calls = {"n": 0}
+
+        def flaky_replace(src, dst):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError(errno.EAGAIN, "scrape sidecar racing")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr(os, "replace", flaky_replace)
+        assert metrics.maybe_write_snapshot() is True
+        monkeypatch.setattr(os, "replace", real_replace)
+        assert calls["n"] == 2   # one transient miss, one retry, done
+        with open(path, encoding="utf-8") as f:
+            assert "metrics" in json.load(f)
+
+    def test_trace_export_flaky_then_ok(self, tmp_path, monkeypatch):
+        from cylon_tpu.obs import trace
+        path = str(tmp_path / "trace.json")
+        monkeypatch.setattr("time.sleep", lambda s: None)
+        trace.arm(path)
+        try:
+            real_replace = os.replace
+            calls = {"n": 0}
+
+            def flaky_replace(src, dst):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise OSError(errno.EAGAIN, "transient")
+                return real_replace(src, dst)
+
+            monkeypatch.setattr(os, "replace", flaky_replace)
+            out = trace.export()
+            monkeypatch.setattr(os, "replace", real_replace)
+            assert out == path and calls["n"] == 2
+            with open(path, encoding="utf-8") as f:
+                assert "traceEvents" in json.load(f)
+        finally:
+            trace.disarm()

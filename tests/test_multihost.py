@@ -181,3 +181,9 @@ def test_multi_process_join_groupby_sort(nproc):
         # the two-hop topology leg: identical voted plan hash on every
         # rank + bit/order-equal to the flat route (asserted in-driver)
         assert f"TOPO_OK pid={i} plan=" in out, out[-2000:]
+        # the integrity-audit leg: armed fingerprints voted over the
+        # real cross-process wire (identical order-invariant fp on
+        # every rank, allgather-checked in-driver), and a corruption
+        # injected on rank 0 only made EVERY rank raise typed and
+        # retry identically — one integrity event per rank, bit-equal
+        assert f"AUDIT_OK pid={i} fp=" in out, out[-2000:]

@@ -258,11 +258,16 @@ class TestBenchDetail:
             # the compile-lifecycle block (round 19): a bench number
             # always says how many executables were live and how much
             # wall-clock went to XLA
-            "compile"}
+            "compile",
+            # the integrity block (round 20): a bench number always says
+            # whether the audit was armed and whether it saw violations
+            "audit"}
         assert isinstance(bd["recovery_events"], list)
         assert set(bd["compile"]) == {
             "programs_live", "cache_hits", "cache_misses",
             "cache_evictions", "compile_seconds"}
+        assert set(bd["audit"]) == {
+            "conservation_checks", "fingerprint_checks", "violations"}
 
     def test_q3q5_selection(self):
         bd = obs.bench_detail(spill_keys=("spill_events", "bytes_spilled",
@@ -272,7 +277,7 @@ class TestBenchDetail:
             "peak_ledger_bytes",
             "checkpoint_events", "bytes_checkpointed",
             "resume_fast_forwarded_pieces", "resume_resharded_pieces",
-            "resume_world_mismatch", "compile"}
+            "resume_world_mismatch", "compile", "audit"}
 
     def test_serving_selection(self):
         bd = obs.bench_detail(
@@ -282,13 +287,14 @@ class TestBenchDetail:
         assert set(bd) == {
             "recovery_events", "spill_events", "bytes_spilled",
             "readmit_events", "cross_session_evictions",
-            "peak_ledger_bytes", "compile"}
+            "peak_ledger_bytes", "compile", "audit"}
 
     def test_streaming_selection_no_events(self):
         bd = obs.bench_detail(spill_keys=("window_evictions",
                                           "bytes_spilled"),
                               ckpt_keys=(), events=None)
-        assert set(bd) == {"window_evictions", "bytes_spilled", "compile"}
+        assert set(bd) == {"window_evictions", "bytes_spilled", "compile",
+                           "audit"}
 
     def test_plan_section_opt_in(self):
         """The profiler satellite: bench_detail(plan=...) adds a "plan"

@@ -538,13 +538,55 @@ def test_ts117_scoping():
         "cylon_tpu/relational/join.py", clean))
 
 
+def test_ts118_integrity_fixture():
+    found = [f for f in ast_lint.lint_file(
+        os.path.join(BAD, "relational", "bad_integrity.py"))
+        if f.rule == "TS118"]
+    # table/partition fingerprint primitives, direct vote, raw builder,
+    # rank-local raise + constructor — the facade verbs stay clean
+    assert len(found) == 6, found
+    assert all("exec/integrity" in f.message for f in found)
+
+
+def test_ts118_scoping():
+    prim = ("def f(integ, table):\n"
+            "    return integ.table_fingerprint(table)\n")
+    raised = ("def f(DataIntegrityError):\n"
+              "    raise DataIntegrityError('x', site='s')\n")
+    # fires in the operator/transport/topo dirs the audit tier covers
+    for src in (prim, raised):
+        assert any(f.rule == "TS118" for f in ast_lint.lint_source(
+            "cylon_tpu/relational/join.py", src))
+        assert any(f.rule == "TS118" for f in ast_lint.lint_source(
+            "cylon_tpu/parallel/shuffle.py", src))
+        assert any(f.rule == "TS118" for f in ast_lint.lint_source(
+            "cylon_tpu/topo/exchange.py", src))
+    # the defining facade and the rest of exec/ are exempt (the
+    # checkpoint/pipeline callers route through the facade's verbs and
+    # the facade itself must hash/raise)
+    for src in (prim, raised):
+        assert not any(f.rule == "TS118" for f in ast_lint.lint_source(
+            "cylon_tpu/exec/integrity.py", src))
+        assert not any(f.rule == "TS118" for f in ast_lint.lint_source(
+            "cylon_tpu/exec/checkpoint.py", src))
+    # the sanctioned facade verbs stay clean where the rule applies
+    clean = ("def f(integ, mesh, tgt, cols, outs, per_dest, table):\n"
+             "    integ.conserve_exchange(None, per_dest, 0, 8)\n"
+             "    if integ.armed():\n"
+             "        integ.verify_exchange(mesh, tgt, cols, outs, "
+             "per_dest)\n"
+             "        integ.audit_table(table, site='s', phase='p')\n")
+    assert not any(f.rule == "TS118" for f in ast_lint.lint_source(
+        "cylon_tpu/relational/join.py", clean))
+
+
 def test_fixture_package_is_dirty():
     found = ast_lint.lint_paths([BAD])
     assert {f.rule for f in found} >= {"TS101", "TS102", "TS103", "TS104",
                                        "TS105", "TS106", "TS107", "TS108",
                                        "TS109", "TS110", "TS111", "TS112",
                                        "TS113", "TS114", "TS115", "TS116",
-                                       "TS117"}
+                                       "TS117", "TS118"}
 
 
 # ---------------------------------------------------------------------------
